@@ -1,0 +1,16 @@
+"""spark_timeseries_tpu: a TPU-native time-series framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of Cloudera's
+spark-timeseries (reference at /root/reference): date-time indices, panels of
+keyed univariate series, vectorized series transforms, batched classical model
+fitting (AR/ARX/ARIMA/ARIMAX/EWMA/GARCH/Holt-Winters/RegressionARIMA), and
+batched statistical tests — with the panel stored as a sharded
+(n_series, n_obs) array on a `jax.sharding.Mesh` and all per-series scalar
+loops replaced by vmapped, XLA-compiled kernels.
+"""
+
+__version__ = "0.1.0"
+
+from . import time  # noqa: F401
+
+__all__ = ["time", "__version__"]

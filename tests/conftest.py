@@ -1,0 +1,35 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's `LocalSparkContext` philosophy
+(ref /root/reference/src/test/scala/com/cloudera/sparkts/LocalSparkContext.scala:23-61):
+distributed code paths execute in-process so CI needs no real cluster — here,
+no real TPUs.  Must set flags before jax initializes.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+# float64 for numerical-parity tests (reference is all float64 on JVM);
+# kernels run float32 on TPU in production.
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh(cpu_devices):
+    from jax.sharding import Mesh
+    return Mesh(np.array(cpu_devices).reshape(8), ("series",))

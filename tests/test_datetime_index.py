@@ -1,0 +1,184 @@
+"""DateTimeIndex semantics, mirroring ref DateTimeIndexSuite.scala contracts."""
+
+import datetime as dt
+
+import numpy as np
+
+from spark_timeseries_tpu.time import (
+    BusinessDayFrequency,
+    DayFrequency,
+    HourFrequency,
+    MinuteFrequency,
+    datetime_to_nanos,
+    from_string,
+    hybrid,
+    irregular,
+    nanos_to_datetime,
+    uniform,
+    uniform_from_interval,
+)
+
+UTC = dt.timezone.utc
+
+
+def nanos(y, m, d, h=0, mi=0, s=0):
+    return datetime_to_nanos(dt.datetime(y, m, d, h, mi, s, tzinfo=UTC))
+
+
+class TestUniformIndex:
+    def test_basic_lookups(self):
+        ix = uniform(nanos(2015, 4, 10), 5, DayFrequency(2))
+        assert ix.size == 5
+        assert ix.first_nanos == nanos(2015, 4, 10)
+        assert ix.last_nanos == nanos(2015, 4, 18)
+        assert ix.loc_at_datetime(nanos(2015, 4, 14)) == 2
+        assert ix.loc_at_datetime(nanos(2015, 4, 13)) == -1
+        assert ix.loc_at_datetime(nanos(2015, 4, 20)) == -1
+        assert ix.nanos_at_loc(3) == nanos(2015, 4, 16)
+
+    def test_islice_and_slice(self):
+        ix = uniform(nanos(2015, 4, 10), 5, DayFrequency(1))
+        sub = ix.islice(1, 4)
+        assert sub.size == 3 and sub.first_nanos == nanos(2015, 4, 11)
+        sub2 = ix.slice(nanos(2015, 4, 11), nanos(2015, 4, 13))
+        assert sub2.size == 3 and sub2.first_nanos == nanos(2015, 4, 11)
+
+    def test_uniform_from_interval(self):
+        ix = uniform_from_interval(nanos(2015, 4, 10), nanos(2015, 4, 14), DayFrequency(2))
+        assert ix.size == 3
+
+    def test_at_or_before_after(self):
+        ix = uniform(nanos(2015, 4, 10), 5, DayFrequency(2))
+        mid = nanos(2015, 4, 13)
+        assert ix.loc_at_or_before(mid) == 1
+        assert ix.loc_at_or_after(mid) == 2
+        exact = nanos(2015, 4, 14)
+        assert ix.loc_at_or_before(exact) == 2
+        assert ix.loc_at_or_after(exact) == 2
+
+    def test_insertion_loc(self):
+        ix = uniform(nanos(2015, 4, 10), 5, DayFrequency(2))
+        assert ix.insertion_loc(nanos(2015, 4, 9)) == 0
+        assert ix.insertion_loc(nanos(2015, 4, 10)) == 1
+        assert ix.insertion_loc(nanos(2015, 4, 13)) == 2
+        assert ix.insertion_loc(nanos(2015, 4, 18)) == 5
+        assert ix.insertion_loc(nanos(2015, 4, 28)) == 5
+
+    def test_locs_at_vectorized(self):
+        ix = uniform(nanos(2015, 4, 10), 5, DayFrequency(2))
+        queries = np.array([nanos(2015, 4, 10), nanos(2015, 4, 13),
+                            nanos(2015, 4, 18), nanos(2015, 4, 30)], dtype=np.int64)
+        assert list(ix.locs_at(queries)) == [0, -1, 4, -1]
+
+    def test_business_day_index(self):
+        # Friday start; next entries skip the weekend
+        ix = uniform(nanos(2015, 4, 10), 3, BusinessDayFrequency(1))
+        arr = [nanos_to_datetime(int(n)).day for n in ix.to_nanos_array()]
+        assert arr == [10, 13, 14]
+        assert ix.loc_at_datetime(nanos(2015, 4, 13)) == 1
+
+
+class TestIrregularIndex:
+    def make(self):
+        return irregular([nanos(2015, 4, 10), nanos(2015, 4, 12),
+                          nanos(2015, 4, 15), nanos(2015, 4, 25)])
+
+    def test_lookups(self):
+        ix = self.make()
+        assert ix.size == 4
+        assert ix.loc_at_datetime(nanos(2015, 4, 12)) == 1
+        assert ix.loc_at_datetime(nanos(2015, 4, 13)) == -1
+        assert ix.loc_at_or_before(nanos(2015, 4, 13)) == 1
+        assert ix.loc_at_or_after(nanos(2015, 4, 13)) == 2
+        assert ix.loc_at_or_before(nanos(2015, 4, 9)) == -1
+        assert ix.loc_at_or_after(nanos(2015, 4, 26)) == 4
+        assert ix.insertion_loc(nanos(2015, 4, 12)) == 2
+        assert ix.insertion_loc(nanos(2015, 4, 11)) == 1
+
+    def test_slice(self):
+        ix = self.make()
+        sub = ix.slice(nanos(2015, 4, 11), nanos(2015, 4, 15))
+        assert sub.size == 2 and sub.first_nanos == nanos(2015, 4, 12)
+        sub2 = ix.islice(1, 3)
+        assert sub2.size == 2
+
+
+class TestHybridIndex:
+    def make(self):
+        a = uniform(nanos(2015, 4, 10), 5, DayFrequency(2))       # 10,12,14,16,18
+        b = irregular([nanos(2015, 4, 19), nanos(2015, 4, 21)])
+        c = uniform(nanos(2015, 5, 1), 4, HourFrequency(1))
+        return hybrid([a, b, c])
+
+    def test_size_and_lookup(self):
+        ix = self.make()
+        assert ix.size == 11
+        assert ix.loc_at_datetime(nanos(2015, 4, 14)) == 2
+        assert ix.loc_at_datetime(nanos(2015, 4, 19)) == 5
+        assert ix.loc_at_datetime(nanos(2015, 5, 1, 2)) == 9
+        assert ix.loc_at_datetime(nanos(2015, 4, 13)) == -1
+        assert ix.nanos_at_loc(6) == nanos(2015, 4, 21)
+        assert ix.nanos_at_loc(7) == nanos(2015, 5, 1)
+
+    def test_before_after_across_subindices(self):
+        ix = self.make()
+        gap = nanos(2015, 4, 25)
+        assert ix.loc_at_or_before(gap) == 6
+        assert ix.loc_at_or_after(gap) == 7
+        assert ix.insertion_loc(gap) == 7
+
+    def test_islice_across_subindices(self):
+        ix = self.make()
+        sub = ix.islice(3, 9)
+        assert sub.size == 6
+        assert sub.first_nanos == nanos(2015, 4, 16)
+        assert sub.nanos_at_loc(5) == nanos(2015, 5, 1, 1)
+
+    def test_slice_by_time(self):
+        ix = self.make()
+        sub = ix.slice(nanos(2015, 4, 15), nanos(2015, 4, 22))
+        assert sub.first_nanos == nanos(2015, 4, 16)
+        assert sub.last_nanos == nanos(2015, 4, 21)
+
+    def test_locs_at_vectorized(self):
+        ix = self.make()
+        q = np.array([nanos(2015, 4, 10), nanos(2015, 4, 21),
+                      nanos(2015, 5, 1, 3), nanos(2015, 6, 1)], dtype=np.int64)
+        assert list(ix.locs_at(q)) == [0, 6, 10, -1]
+
+
+class TestStringRoundTrip:
+    # ref DateTimeIndexSuite.scala:37-73
+    def test_uniform(self):
+        ix = uniform(nanos(2015, 4, 10), 5, DayFrequency(2))
+        assert from_string(ix.to_string()) == ix
+
+    def test_uniform_business(self):
+        ix = uniform(nanos(2015, 4, 10), 5, BusinessDayFrequency(1))
+        assert from_string(ix.to_string()) == ix
+
+    def test_uniform_with_zone(self):
+        ix = uniform(nanos(2015, 4, 10), 5, DayFrequency(1), zone="America/New_York")
+        rt = from_string(ix.to_string())
+        assert rt == ix and rt.zone == "America/New_York"
+
+    def test_irregular(self):
+        ix = irregular([nanos(2015, 4, 10), nanos(2015, 4, 12, 6, 30),
+                        nanos(2015, 4, 15, 1, 2, 3)])
+        assert from_string(ix.to_string()) == ix
+
+    def test_irregular_nanosecond_precision(self):
+        ix = irregular([nanos(2015, 4, 10) + 123456789, nanos(2015, 4, 11) + 1])
+        rt = from_string(ix.to_string())
+        assert np.array_equal(rt.to_nanos_array(), ix.to_nanos_array())
+
+    def test_hybrid(self):
+        a = uniform(nanos(2015, 4, 10), 5, DayFrequency(2))
+        b = irregular([nanos(2015, 4, 19), nanos(2015, 4, 21)])
+        ix = hybrid([a, b])
+        rt = from_string(ix.to_string())
+        assert np.array_equal(rt.to_nanos_array(), ix.to_nanos_array())
+
+    def test_minute_frequency_roundtrip(self):
+        ix = uniform(nanos(2015, 4, 10, 9, 30), 100, MinuteFrequency(5))
+        assert from_string(ix.to_string()) == ix

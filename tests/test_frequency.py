@@ -1,0 +1,137 @@
+"""Frequency semantics, mirroring ref FrequencySuite.scala contracts."""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu.time import (
+    BusinessDayFrequency,
+    DayFrequency,
+    HourFrequency,
+    MinuteFrequency,
+    MonthFrequency,
+    SecondFrequency,
+    YearFrequency,
+    datetime_to_nanos,
+    frequency_from_string,
+    nanos_to_datetime,
+)
+
+UTC = dt.timezone.utc
+
+
+def nanos(y, m, d, h=0, mi=0, s=0):
+    return datetime_to_nanos(dt.datetime(y, m, d, h, mi, s, tzinfo=UTC))
+
+
+class TestDurationFrequencies:
+    def test_hour_advance(self):
+        start = nanos(2015, 4, 10)
+        f = HourFrequency(1)
+        assert nanos_to_datetime(f.advance(start, 5)).hour == 5
+        assert f.difference(start, f.advance(start, 5)) == 5
+
+    def test_difference_rounds_down(self):
+        start = nanos(2015, 4, 10)
+        f = MinuteFrequency(10)
+        end = start + int(25 * 60 * 1e9)
+        assert f.difference(start, end) == 2
+        assert f.difference(end, start) == -2
+
+    def test_vectorized_advance(self):
+        start = nanos(2015, 4, 10)
+        f = SecondFrequency(2)
+        arr = f.advance_array(start, np.arange(4))
+        assert list(arr - start) == [0, int(2e9), int(4e9), int(6e9)]
+
+
+class TestDayFrequency:
+    def test_advance_plain(self):
+        start = nanos(2015, 4, 10)
+        f = DayFrequency(1)
+        out = nanos_to_datetime(f.advance(start, 3))
+        assert (out.year, out.month, out.day) == (2015, 4, 13)
+
+    def test_difference(self):
+        f = DayFrequency(2)
+        assert f.difference(nanos(2015, 4, 10), nanos(2015, 4, 15)) == 2
+
+    def test_dst_preserves_wall_clock(self):
+        # Crossing the US spring-forward (Mar 8 2015) keeps local midnight
+        z = "America/New_York"
+        start = datetime_to_nanos(
+            dt.datetime(2015, 3, 8, 0, 0, tzinfo=__import__("zoneinfo").ZoneInfo(z)))
+        f = DayFrequency(1)
+        out = nanos_to_datetime(f.advance(start, 1, z), z)
+        assert (out.hour, out.day) == (0, 9)
+        # the instant moved 23h, not 24h
+        assert f.advance(start, 1, z) - start == int(23 * 3600 * 1e9)
+        assert f.difference(start, f.advance(start, 2, z), z) == 2
+
+
+class TestMonthYearFrequency:
+    def test_advance_clamps_day(self):
+        f = MonthFrequency(1)
+        out = nanos_to_datetime(f.advance(nanos(2015, 1, 31), 1))
+        assert (out.month, out.day) == (2, 28)
+
+    def test_difference_partial_months(self):
+        f = MonthFrequency(1)
+        assert f.difference(nanos(2015, 1, 15), nanos(2015, 3, 14)) == 1
+        assert f.difference(nanos(2015, 1, 15), nanos(2015, 3, 15)) == 2
+
+    def test_year(self):
+        f = YearFrequency(1)
+        assert f.difference(nanos(2012, 2, 29), nanos(2016, 2, 29)) == 4
+        out = nanos_to_datetime(f.advance(nanos(2012, 2, 29), 1))
+        assert (out.year, out.month, out.day) == (2013, 2, 28)
+
+
+class TestBusinessDayFrequency:
+    # ref FrequencySuite.scala business-day cases
+    def test_advance_within_week(self):
+        # Friday 2015-04-10 + 1 business day -> Monday 2015-04-13
+        f = BusinessDayFrequency(1)
+        out = nanos_to_datetime(f.advance(nanos(2015, 4, 10), 1))
+        assert (out.day, out.isoweekday()) == (13, 1)
+
+    def test_advance_multiple_weeks(self):
+        f = BusinessDayFrequency(1)
+        out = nanos_to_datetime(f.advance(nanos(2015, 4, 6), 10))  # Monday + 10bd
+        assert (out.month, out.day) == (4, 20)
+
+    def test_difference_roundtrip(self):
+        f = BusinessDayFrequency(1)
+        start = nanos(2015, 4, 6)
+        for n in range(0, 15):
+            assert f.difference(start, f.advance(start, n)) == n
+
+    def test_negative_advance(self):
+        f = BusinessDayFrequency(1)
+        # Monday - 1 business day -> previous Friday
+        out = nanos_to_datetime(f.advance(nanos(2015, 4, 13), -1))
+        assert (out.day, out.isoweekday()) == (10, 5)
+
+    def test_non_business_day_raises(self):
+        f = BusinessDayFrequency(1)
+        with pytest.raises(ValueError):
+            f.advance(nanos(2015, 4, 11), 1)  # Saturday
+
+    def test_custom_first_day_of_week(self):
+        # week starting Sunday: Friday becomes the 6th day -> weekend
+        f = BusinessDayFrequency(1, first_day_of_week=7)
+        # Thursday 2015-04-09 + 1 bd skips Fri+Sat -> Sunday? No:
+        # with first day Sunday, days 6,7 are Friday & Saturday.
+        out = nanos_to_datetime(f.advance(nanos(2015, 4, 9), 1))
+        assert out.isoweekday() == 7  # Sunday
+
+
+class TestSerialization:
+    @pytest.mark.parametrize("f", [
+        DayFrequency(3), BusinessDayFrequency(2), MonthFrequency(6),
+        YearFrequency(1), HourFrequency(12), MinuteFrequency(30),
+        SecondFrequency(15),
+    ])
+    def test_roundtrip(self, f):
+        assert frequency_from_string(str(f)) == f

@@ -1,0 +1,140 @@
+"""Union/simplify + rebase semantics (ref DateTimeIndexUtilsSuite / RebaseSuite)."""
+
+import datetime as dt
+
+import numpy as np
+
+from spark_timeseries_tpu.time import (
+    DayFrequency,
+    HybridDateTimeIndex,
+    IrregularDateTimeIndex,
+    UniformDateTimeIndex,
+    datetime_to_nanos,
+    irregular,
+    rebase,
+    rebaser,
+    simplify,
+    uniform,
+    union,
+)
+
+UTC = dt.timezone.utc
+
+
+def nanos(y, m, d, h=0):
+    return datetime_to_nanos(dt.datetime(y, m, d, h, tzinfo=UTC))
+
+
+DAY = int(86400 * 1e9)
+
+
+class TestUnion:
+    def test_disjoint(self):
+        a = uniform(nanos(2015, 4, 10), 3, DayFrequency(1))
+        b = irregular([nanos(2015, 5, 1), nanos(2015, 5, 3)])
+        u = union([a, b])
+        expected = np.concatenate([a.to_nanos_array(), b.to_nanos_array()])
+        assert np.array_equal(u.to_nanos_array(), expected)
+
+    def test_overlapping_dedup(self):
+        a = uniform(nanos(2015, 4, 10), 4, DayFrequency(1))  # 10..13
+        b = uniform(nanos(2015, 4, 12), 4, DayFrequency(1))  # 12..15
+        u = union([a, b])
+        got = u.to_nanos_array()
+        expected = np.array([nanos(2015, 4, d) for d in range(10, 16)], dtype=np.int64)
+        assert np.array_equal(got, expected)
+
+    def test_interleaved(self):
+        a = irregular([nanos(2015, 4, 10), nanos(2015, 4, 14)])
+        b = irregular([nanos(2015, 4, 12), nanos(2015, 4, 16)])
+        u = union([a, b])
+        expected = np.array([nanos(2015, 4, d) for d in (10, 12, 14, 16)], dtype=np.int64)
+        assert np.array_equal(u.to_nanos_array(), expected)
+
+    def test_contained_duplicate(self):
+        a = uniform(nanos(2015, 4, 10), 5, DayFrequency(1))
+        b = irregular([nanos(2015, 4, 11), nanos(2015, 4, 12)])
+        u = union([a, b])
+        assert np.array_equal(u.to_nanos_array(), a.to_nanos_array())
+
+
+class TestSimplify:
+    def test_merge_irregular_runs(self):
+        parts = [
+            irregular([nanos(2015, 4, 1)]),
+            irregular([nanos(2015, 4, 2), nanos(2015, 4, 3)]),
+            uniform(nanos(2015, 5, 1), 5, DayFrequency(1)),
+            irregular([nanos(2015, 6, 1)]),
+        ]
+        out = simplify(parts)
+        assert len(out) == 3
+        assert isinstance(out[0], IrregularDateTimeIndex) and out[0].size == 3
+        assert isinstance(out[1], UniformDateTimeIndex)
+        assert isinstance(out[2], IrregularDateTimeIndex)
+
+    def test_size1_uniform_merges(self):
+        parts = [
+            uniform(nanos(2015, 4, 1), 1, DayFrequency(1)),
+            irregular([nanos(2015, 4, 5)]),
+        ]
+        out = simplify(parts)
+        assert len(out) == 1 and out[0].size == 2
+
+
+class TestRebase:
+    # ref RebaseSuite.scala source/target overlap cases
+    def test_uniform_source_equals_target(self):
+        ix = uniform(nanos(2015, 4, 10), 4, DayFrequency(1))
+        vals = np.array([1.0, 2.0, 3.0, 4.0])
+        assert np.array_equal(rebase(ix, ix, vals), vals)
+
+    def test_target_inside_source(self):
+        src = uniform(nanos(2015, 4, 10), 6, DayFrequency(1))
+        tgt = uniform(nanos(2015, 4, 12), 3, DayFrequency(1))
+        vals = np.arange(6.0)
+        assert np.array_equal(rebase(src, tgt, vals), np.array([2.0, 3.0, 4.0]))
+
+    def test_target_overhangs_both_sides(self):
+        src = uniform(nanos(2015, 4, 10), 3, DayFrequency(1))
+        tgt = uniform(nanos(2015, 4, 9), 6, DayFrequency(1))
+        vals = np.array([1.0, 2.0, 3.0])
+        out = rebase(src, tgt, vals, default_value=np.nan)
+        assert np.isnan(out[0]) and np.isnan(out[4]) and np.isnan(out[5])
+        assert list(out[1:4]) == [1.0, 2.0, 3.0]
+
+    def test_irregular_source_uniform_target(self):
+        src = irregular([nanos(2015, 4, 10), nanos(2015, 4, 12), nanos(2015, 4, 13)])
+        tgt = uniform(nanos(2015, 4, 10), 4, DayFrequency(1))
+        out = rebase(src, tgt, np.array([1.0, 2.0, 3.0]))
+        assert out[0] == 1.0 and np.isnan(out[1]) and out[2] == 2.0 and out[3] == 3.0
+
+    def test_irregular_to_irregular(self):
+        src = irregular([nanos(2015, 4, 10), nanos(2015, 4, 12)])
+        tgt = irregular([nanos(2015, 4, 10), nanos(2015, 4, 11), nanos(2015, 4, 12)])
+        out = rebase(src, tgt, np.array([5.0, 6.0]))
+        assert out[0] == 5.0 and np.isnan(out[1]) and out[2] == 6.0
+
+    def test_panel_rebase_2d(self):
+        # the TPU path: one gather applies to the whole panel
+        src = uniform(nanos(2015, 4, 10), 4, DayFrequency(1))
+        tgt = uniform(nanos(2015, 4, 11), 4, DayFrequency(1))
+        panel = np.arange(8.0).reshape(2, 4)
+        out = rebase(src, tgt, panel)
+        assert out.shape == (2, 4)
+        assert list(out[0, :3]) == [1.0, 2.0, 3.0] and np.isnan(out[0, 3])
+        assert list(out[1, :3]) == [5.0, 6.0, 7.0] and np.isnan(out[1, 3])
+
+    def test_rebaser_reusable_default_value(self):
+        src = uniform(nanos(2015, 4, 10), 2, DayFrequency(1))
+        tgt = uniform(nanos(2015, 4, 9), 4, DayFrequency(1))
+        rb = rebaser(src, tgt, default_value=0.0)
+        out = rb(np.array([7.0, 8.0]))
+        assert list(out) == [0.0, 7.0, 8.0, 0.0]
+
+    def test_hybrid_source(self):
+        a = uniform(nanos(2015, 4, 10), 2, DayFrequency(1))
+        b = irregular([nanos(2015, 4, 20)])
+        src = HybridDateTimeIndex([a, b])
+        tgt = irregular([nanos(2015, 4, 11), nanos(2015, 4, 20), nanos(2015, 4, 21)])
+        out = rebase(src, tgt, np.array([1.0, 2.0, 3.0]))
+        assert out[0] == 2.0 and out[1] == 3.0 and np.isnan(out[2])
