@@ -1,0 +1,43 @@
+"""Vectorized series ops (L1/L2): lag matrices, univariate kernels, resample, OLS."""
+
+from .lag import lag_matrix, lag_matrix_multi
+from .linalg import OLSResult, ols, ols_beta, r_squared, t_statistics
+from .resample import bucket_assignments, resample
+from .univariate import (
+    autocorr,
+    differences_at_lag,
+    differences_of_order_d,
+    downsample,
+    fill_linear,
+    fill_nearest,
+    fill_next,
+    fill_previous,
+    fill_spline,
+    fill_value,
+    fill_with_default,
+    fill_zero,
+    fillts,
+    first_not_nan,
+    inverse_differences_at_lag,
+    inverse_differences_of_order_d,
+    last_not_nan,
+    price2ret,
+    quotients,
+    roll_mean,
+    roll_sum,
+    trim_leading,
+    trim_trailing,
+    upsample,
+)
+
+__all__ = [
+    "lag_matrix", "lag_matrix_multi",
+    "OLSResult", "ols", "ols_beta", "r_squared", "t_statistics",
+    "bucket_assignments", "resample",
+    "autocorr", "differences_at_lag", "differences_of_order_d", "downsample",
+    "fill_linear", "fill_nearest", "fill_next", "fill_previous", "fill_spline",
+    "fill_value", "fill_with_default", "fill_zero", "fillts", "first_not_nan",
+    "inverse_differences_at_lag", "inverse_differences_of_order_d",
+    "last_not_nan", "price2ret", "quotients", "roll_mean", "roll_sum",
+    "trim_leading", "trim_trailing", "upsample",
+]

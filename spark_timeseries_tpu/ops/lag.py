@@ -1,0 +1,43 @@
+"""Lag-matrix construction — the design-matrix builder for every OLS-based fit.
+
+Capability parity with the reference's ``Lag.scala``
+(``/root/reference/src/main/scala/com/cloudera/sparkts/Lag.scala:20-130``), but
+tensorized: operates on ``(..., n)`` batches and returns ``(..., rows, cols)``
+stacks, so one XLA gather builds the design matrices for an entire panel at
+once instead of per-series scalar loops.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def lag_matrix(x: jnp.ndarray, max_lag: int,
+               include_original: bool = False) -> jnp.ndarray:
+    """Trimmed lag matrix (ref ``Lag.scala:25-48``).
+
+    For input ``(..., n)`` returns ``(..., n - max_lag, cols)`` where
+    ``cols = max_lag (+1 if include_original)``.  Row ``r`` holds
+    ``[x[r+max_lag] (optional), x[r+max_lag-1], ..., x[r]]`` — column ``c``
+    is the series lagged ``c + (0 if include_original else 1)`` steps.
+    """
+    n = x.shape[-1]
+    if max_lag >= n:
+        raise ValueError(f"max_lag {max_lag} must be < series length {n}")
+    initial = 0 if include_original else 1
+    cols = [x[..., max_lag - lag:n - lag] for lag in range(initial, max_lag + 1)]
+    return jnp.stack(cols, axis=-1)
+
+
+def lag_matrix_multi(x: jnp.ndarray, max_lag: int,
+                     include_original: bool = False) -> jnp.ndarray:
+    """Lag each column of a multi-column input and concatenate
+    (ref ``Lag.scala:107-129``).
+
+    For ``(..., n, k)`` input returns ``(..., n - max_lag, k * cols)`` in the
+    reference's ordering ``[a_-1 a_-2 b_-1 b_-2 ...]``.
+    """
+    per_col = lag_matrix(jnp.moveaxis(x, -1, -2), max_lag, include_original)
+    # per_col: (..., k, rows, cols) -> (..., rows, k, cols) -> flatten last two
+    per_col = jnp.moveaxis(per_col, -3, -2)
+    return per_col.reshape(*per_col.shape[:-2], -1)

@@ -1,0 +1,71 @@
+"""Batched dense linear algebra for model fitting.
+
+Replaces the reference's Breeze / Commons-Math ``OLSMultipleLinearRegression``
+scalar path (ref ``/root/reference/src/main/scala/com/cloudera/sparkts/models/Autoregression.scala:47-50``
+and the OLS uses across stats/models) with QR-based least squares batched over
+a leading series axis — the MXU does the heavy lifting for the whole panel at
+once.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax.scipy.linalg import solve_triangular
+
+
+class OLSResult(NamedTuple):
+    """Batched OLS fit artifacts (shapes with leading batch dims ``...``)."""
+    beta: jnp.ndarray        # (..., p)   coefficients (intercept first if added)
+    residuals: jnp.ndarray   # (..., n)
+    fitted: jnp.ndarray      # (..., n)
+    sigma2: jnp.ndarray      # (...,)     residual variance (n - p denominator)
+    xtx_inv: jnp.ndarray     # (..., p, p) (X'X)^-1 for standard errors / tests
+
+
+def ols(X: jnp.ndarray, y: jnp.ndarray, add_intercept: bool = False) -> OLSResult:
+    """Least squares via batched QR: ``X (..., n, p)``, ``y (..., n)``.
+
+    With ``add_intercept`` a ones column is prepended (reference convention:
+    Commons-Math estimates the intercept first).
+    """
+    if add_intercept:
+        ones = jnp.ones((*X.shape[:-1], 1), dtype=X.dtype)
+        X = jnp.concatenate([ones, X], axis=-1)
+    n, p = X.shape[-2], X.shape[-1]
+    q, r = jnp.linalg.qr(X)
+    qty = jnp.einsum("...np,...n->...p", q, y)
+    beta = solve_triangular(r, qty, lower=False)
+    fitted = jnp.einsum("...np,...p->...n", X, beta)
+    resid = y - fitted
+    dof = max(n - p, 1)
+    sigma2 = jnp.sum(resid * resid, axis=-1) / dof
+    r_inv = solve_triangular(r, jnp.broadcast_to(jnp.eye(p, dtype=X.dtype),
+                                                 r.shape), lower=False)
+    xtx_inv = jnp.einsum("...ij,...kj->...ik", r_inv, r_inv)
+    return OLSResult(beta, resid, fitted, sigma2, xtx_inv)
+
+
+def ols_beta(X: jnp.ndarray, y: jnp.ndarray, add_intercept: bool = False) -> jnp.ndarray:
+    """Coefficients only: QR + one triangular solve, skipping residual stats."""
+    if add_intercept:
+        ones = jnp.ones((*X.shape[:-1], 1), dtype=X.dtype)
+        X = jnp.concatenate([ones, X], axis=-1)
+    q, r = jnp.linalg.qr(X)
+    qty = jnp.einsum("...np,...n->...p", q, y)
+    return solve_triangular(r, qty, lower=False)
+
+
+def t_statistics(res: OLSResult) -> jnp.ndarray:
+    """Per-coefficient t statistics ``beta / se(beta)``."""
+    se = jnp.sqrt(res.sigma2[..., None]
+                  * jnp.diagonal(res.xtx_inv, axis1=-2, axis2=-1))
+    return res.beta / se
+
+
+def r_squared(res: OLSResult, y: jnp.ndarray) -> jnp.ndarray:
+    """Coefficient of determination of the fit."""
+    ss_res = jnp.sum(res.residuals ** 2, axis=-1)
+    ss_tot = jnp.sum((y - jnp.mean(y, axis=-1, keepdims=True)) ** 2, axis=-1)
+    return 1.0 - ss_res / ss_tot

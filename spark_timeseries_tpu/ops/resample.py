@@ -1,0 +1,109 @@
+"""Window resampling with pandas-style closed/stamp semantics.
+
+Capability parity with the reference's ``Resample.scala``
+(``/root/reference/src/main/scala/com/cloudera/sparkts/Resample.scala:47-121``).
+The reference walks source/target instant streams with a merge iterator; here
+bucket assignment is one vectorized ``searchsorted`` on the host (int64 nanos)
+and aggregation is a batched segment reduction on device, so one call
+resamples an entire ``(..., n)`` panel.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..time.index import DateTimeIndex
+
+
+def bucket_assignments(source_nanos: np.ndarray, target_nanos: np.ndarray,
+                       closed_right: bool, stamp_right: bool) -> np.ndarray:
+    """Bucket index for each source instant; -1 where the observation falls in
+    no window.  Vectorized equivalent of the reference's end-predicate walk
+    (ref ``Resample.scala:78-119``).
+
+    Window semantics (m = len(target)):
+      - ``stamp_right``: stamp i labels the window *ending* at target[i];
+        bucket 0 is unbounded below, observations after the last stamp drop.
+      - ``not stamp_right``: stamp i labels the window *starting* at target[i];
+        observations before the first stamp drop, the last window is unbounded
+        above.
+      - ``closed_right``: windows are (lo, hi] instead of [lo, hi).
+    """
+    side = "left" if closed_right else "right"
+    pos = np.searchsorted(target_nanos, source_nanos, side=side)
+    if stamp_right:
+        bucket = pos
+    else:
+        bucket = pos - 1
+    m = target_nanos.size
+    return np.where((bucket >= 0) & (bucket < m), bucket, -1).astype(np.int64)
+
+
+def _seg_reduce(values: jnp.ndarray, bucket: jnp.ndarray, m: int,
+                how: str) -> jnp.ndarray:
+    """Batched segment reduction along the last axis.  Empty buckets -> NaN."""
+    seg = jnp.where(bucket < 0, m, bucket)  # park dropped obs in a spill bucket
+
+    def one(v):
+        count = jax.ops.segment_sum(jnp.ones_like(v), seg, num_segments=m + 1)
+        if how in ("mean", "sum"):
+            s = jax.ops.segment_sum(v, seg, num_segments=m + 1)
+            out = s / count if how == "mean" else s
+        elif how == "min":
+            out = jax.ops.segment_min(v, seg, num_segments=m + 1)
+        elif how == "max":
+            out = jax.ops.segment_max(v, seg, num_segments=m + 1)
+        elif how == "first":
+            n = v.shape[-1]
+            first_pos = jax.ops.segment_min(jnp.arange(n), seg, num_segments=m + 1)
+            out = v[jnp.clip(first_pos, 0, n - 1)]
+        elif how == "last":
+            n = v.shape[-1]
+            last_pos = jax.ops.segment_max(jnp.arange(n), seg, num_segments=m + 1)
+            out = v[jnp.clip(last_pos, 0, n - 1)]
+        elif how == "count":
+            out = count
+        else:
+            raise ValueError(f"unknown aggregator {how!r}")
+        return jnp.where(count > 0, out, jnp.nan)[:m]
+
+    flat = values.reshape(-1, values.shape[-1])
+    out = jax.vmap(one)(flat)
+    return out.reshape(*values.shape[:-1], m)
+
+
+def resample(values, source_index: DateTimeIndex, target_index: DateTimeIndex,
+             aggr: Union[str, Callable] = "mean",
+             closed_right: bool = False, stamp_right: bool = False):
+    """Resample ``(..., n)`` values from ``source_index`` onto ``target_index``
+    (ref ``Resample.scala:47-121``).
+
+    ``aggr`` is one of ``mean|sum|min|max|first|last|count`` (device segment
+    reduction), or a Python callable ``(np.ndarray, start, end) -> float``
+    applied per bucket on the host for parity with the reference's arbitrary
+    aggregator signature.
+    """
+    src = source_index.to_nanos_array()
+    tgt = target_index.to_nanos_array()
+    bucket = bucket_assignments(src, tgt, closed_right, stamp_right)
+
+    if callable(aggr):
+        # host fallback: contiguous bucket ranges, arbitrary aggregator
+        arr = np.asarray(values)
+        m = tgt.size
+        out = np.full((*arr.shape[:-1], m), np.nan)
+        flat = arr.reshape(-1, arr.shape[-1])
+        out_flat = out.reshape(-1, m)
+        valid = bucket >= 0
+        for b in range(m):
+            locs = np.flatnonzero(valid & (bucket == b))
+            if locs.size:
+                start, end = int(locs[0]), int(locs[-1]) + 1
+                out_flat[:, b] = [aggr(row, start, end) for row in flat]
+        return out
+
+    return _seg_reduce(jnp.asarray(values), jnp.asarray(bucket), tgt.size, aggr)

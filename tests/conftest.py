@@ -8,18 +8,26 @@ no real TPUs.  Must set flags before jax initializes.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force-set: the ambient environment pins JAX_PLATFORMS to the real TPU,
+# but the test tier must run on the virtual CPU mesh
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 # float64 for numerical-parity tests (reference is all float64 on JVM);
 # kernels run float32 on TPU in production.
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+os.environ["JAX_ENABLE_X64"] = "1"
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# pytest entry-point plugins (jaxtyping) import jax before this conftest runs,
+# so the env vars above may be read too late — force the config directly;
+# this is safe as long as no backend has been initialized yet.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,84 @@
+"""Resample closed/stamp semantics (ref ResampleSuite.scala contracts)."""
+
+import datetime as dt
+
+import numpy as np
+
+from spark_timeseries_tpu.ops import bucket_assignments, resample
+from spark_timeseries_tpu.time import DayFrequency, datetime_to_nanos, uniform
+
+UTC = dt.timezone.utc
+
+
+def nanos(y, m, d, h=0):
+    return datetime_to_nanos(dt.datetime(y, m, d, h, tzinfo=UTC))
+
+
+class TestBucketAssignments:
+    # source at days 0..7, target stamps at days 0, 4
+    def setup_method(self):
+        self.src = np.array([nanos(2015, 4, 10 + i) for i in range(8)], dtype=np.int64)
+        self.tgt = np.array([nanos(2015, 4, 10), nanos(2015, 4, 14)], dtype=np.int64)
+
+    def test_open_left_stamp_left(self):
+        # windows: [t0, t1), [t1, inf)
+        b = list(bucket_assignments(self.src, self.tgt, False, False))
+        assert b == [0, 0, 0, 0, 1, 1, 1, 1]
+
+    def test_closed_right_stamp_left(self):
+        # windows: (t0, t1], (t1, inf); obs == t0 dropped
+        b = list(bucket_assignments(self.src, self.tgt, True, False))
+        assert b == [-1, 0, 0, 0, 0, 1, 1, 1]
+
+    def test_open_left_stamp_right(self):
+        # windows: (-inf, t0), [t0, t1); obs at/after t1 dropped
+        b = list(bucket_assignments(self.src, self.tgt, False, True))
+        assert b == [1, 1, 1, 1, -1, -1, -1, -1]
+
+    def test_closed_right_stamp_right(self):
+        # windows: (-inf, t0], (t0, t1]
+        b = list(bucket_assignments(self.src, self.tgt, True, True))
+        assert b == [0, 1, 1, 1, 1, -1, -1, -1]
+
+
+class TestResample:
+    def test_mean_downsample(self):
+        src_ix = uniform(nanos(2015, 4, 10), 8, DayFrequency(1))
+        tgt_ix = uniform(nanos(2015, 4, 10), 2, DayFrequency(4))
+        vals = np.arange(8.0)
+        out = np.asarray(resample(vals, src_ix, tgt_ix, "mean",
+                                  closed_right=False, stamp_right=False))
+        np.testing.assert_allclose(out, [1.5, 5.5])
+
+    def test_sum_and_empty_bucket_nan(self):
+        src_ix = uniform(nanos(2015, 4, 10), 3, DayFrequency(1))
+        tgt_ix = uniform(nanos(2015, 4, 10), 2, DayFrequency(4))  # 2nd window empty
+        out = np.asarray(resample(np.array([1.0, 2.0, 3.0]), src_ix, tgt_ix, "sum"))
+        assert out[0] == 6.0 and np.isnan(out[1])
+
+    def test_min_max_first_last(self):
+        src_ix = uniform(nanos(2015, 4, 10), 8, DayFrequency(1))
+        tgt_ix = uniform(nanos(2015, 4, 10), 2, DayFrequency(4))
+        vals = np.array([3.0, 1.0, 4.0, 1.0, 5.0, 9.0, 2.0, 6.0])
+        assert list(np.asarray(resample(vals, src_ix, tgt_ix, "min"))) == [1.0, 2.0]
+        assert list(np.asarray(resample(vals, src_ix, tgt_ix, "max"))) == [4.0, 9.0]
+        assert list(np.asarray(resample(vals, src_ix, tgt_ix, "first"))) == [3.0, 5.0]
+        assert list(np.asarray(resample(vals, src_ix, tgt_ix, "last"))) == [1.0, 6.0]
+
+    def test_batched_panel(self):
+        src_ix = uniform(nanos(2015, 4, 10), 4, DayFrequency(1))
+        tgt_ix = uniform(nanos(2015, 4, 10), 2, DayFrequency(2))
+        panel = np.array([[1.0, 2.0, 3.0, 4.0], [10.0, 20.0, 30.0, 40.0]])
+        out = np.asarray(resample(panel, src_ix, tgt_ix, "mean"))
+        np.testing.assert_allclose(out, [[1.5, 3.5], [15.0, 35.0]])
+
+    def test_callable_aggregator_host_path(self):
+        src_ix = uniform(nanos(2015, 4, 10), 4, DayFrequency(1))
+        tgt_ix = uniform(nanos(2015, 4, 10), 2, DayFrequency(2))
+        vals = np.array([1.0, 2.0, 3.0, 4.0])
+
+        def spread(arr, start, end):
+            return arr[start:end].max() - arr[start:end].min()
+
+        out = resample(vals, src_ix, tgt_ix, spread)
+        np.testing.assert_allclose(out, [1.0, 1.0])

@@ -1,0 +1,243 @@
+"""Univariate kernels vs scalar reference semantics (ref FillSuite /
+UnivariateTimeSeriesSuite contracts), exercised both single-series and batched."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu.ops import (
+    autocorr,
+    differences_at_lag,
+    differences_of_order_d,
+    downsample,
+    fill_linear,
+    fill_nearest,
+    fill_next,
+    fill_previous,
+    fill_spline,
+    fillts,
+    first_not_nan,
+    inverse_differences_at_lag,
+    inverse_differences_of_order_d,
+    lag_matrix,
+    lag_matrix_multi,
+    last_not_nan,
+    ols,
+    price2ret,
+    quotients,
+    roll_mean,
+    roll_sum,
+    trim_leading,
+    trim_trailing,
+    upsample,
+)
+
+nan = np.nan
+
+
+def arr(*vals):
+    return jnp.asarray(vals, dtype=jnp.float64)
+
+
+class TestFills:
+    def test_fill_previous(self):
+        # ref: 1 NaN NaN 2 NaN -> 1 1 1 2 2
+        out = fill_previous(arr(1, nan, nan, 2, nan))
+        assert list(np.asarray(out)) == [1, 1, 1, 2, 2]
+
+    def test_fill_previous_leading_nan(self):
+        out = np.asarray(fill_previous(arr(nan, 3, nan)))
+        assert np.isnan(out[0]) and out[1] == 3 and out[2] == 3
+
+    def test_fill_next(self):
+        # ref: 1 NaN NaN 2 NaN -> 1 2 2 2 NaN
+        out = np.asarray(fill_next(arr(1, nan, nan, 2, nan)))
+        assert list(out[:4]) == [1, 2, 2, 2] and np.isnan(out[4])
+
+    def test_fill_nearest(self):
+        # ref FillSuite: ties prefer next
+        out = np.asarray(fill_nearest(arr(1, nan, nan, nan, 2)))
+        assert list(out) == [1, 1, 2, 2, 2]
+
+    def test_fill_nearest_edges(self):
+        out = np.asarray(fill_nearest(arr(nan, nan, 5, nan)))
+        assert list(out) == [5, 5, 5, 5]
+
+    def test_fill_linear(self):
+        out = np.asarray(fill_linear(arr(1, nan, nan, 4, nan)))
+        np.testing.assert_allclose(out[:4], [1, 2, 3, 4])
+        assert np.isnan(out[4])  # trailing NaN untouched
+
+    def test_fill_linear_leading_untouched(self):
+        out = np.asarray(fill_linear(arr(nan, 2, nan, 4)))
+        assert np.isnan(out[0]) and out[2] == 3
+
+    def test_fill_spline_matches_knots(self):
+        x = np.array([1.0, nan, 9.0, nan, 25.0, nan])
+        out = fill_spline(x)
+        # knots preserved; interior filled; trailing outside knots untouched
+        assert out[0] == 1 and out[2] == 9 and out[4] == 25
+        assert not np.isnan(out[1]) and not np.isnan(out[3])
+        assert np.isnan(out[5])
+
+    def test_fillts_dispatch_and_batch(self):
+        x = jnp.stack([arr(1, nan, 3), arr(nan, 2, nan)])
+        out = np.asarray(fillts(x, "previous"))
+        assert out[0, 1] == 1 and np.isnan(out[1, 0]) and out[1, 2] == 2
+        with pytest.raises(ValueError):
+            fillts(x, "bogus")
+
+    def test_fill_under_jit_vmap(self):
+        x = jnp.stack([arr(1, nan, 2, nan), arr(nan, 5, nan, 7)])
+        jit_fill = jax.jit(jax.vmap(fill_linear))
+        out = np.asarray(jit_fill(x))
+        assert out[0, 1] == 1.5
+
+
+class TestTrim:
+    def test_first_last_not_nan(self):
+        x = arr(nan, nan, 1, 2, nan)
+        assert int(first_not_nan(x)) == 2
+        assert int(last_not_nan(x)) == 4
+        assert int(first_not_nan(arr(nan, nan))) == 2
+        assert int(last_not_nan(arr(nan, nan))) == 0
+
+    def test_trim(self):
+        x = np.array([nan, 1.0, 2.0, nan])
+        out = trim_leading(x)
+        assert out[0] == 1.0 and len(out) == 3
+        out2 = trim_trailing(x)
+        assert len(out2) == 3 and np.isnan(out2[0])
+
+
+class TestDifferencing:
+    def test_diff_at_lag(self):
+        x = arr(1, 2, 4, 7, 11)
+        out = np.asarray(differences_at_lag(x, 1))
+        assert list(out) == [1, 1, 2, 3, 4]
+
+    def test_diff_inverse_roundtrip(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(30))
+        for lag in (1, 2, 5):
+            d = differences_at_lag(x, lag)
+            back = inverse_differences_at_lag(d, lag)
+            np.testing.assert_allclose(np.asarray(back), np.asarray(x), atol=1e-10)
+
+    def test_diff_inverse_roundtrip_start_index(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(20))
+        d = differences_at_lag(x, 3, 7)
+        back = inverse_differences_at_lag(d, 3, 7)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x), atol=1e-10)
+
+    def test_order_d_roundtrip(self):
+        # ref ARIMASuite differencing property
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(50))
+        for d in (1, 2, 3):
+            diffed = differences_of_order_d(x, d)
+            back = inverse_differences_of_order_d(diffed, d)
+            np.testing.assert_allclose(np.asarray(back), np.asarray(x), atol=1e-9)
+
+    def test_order_d_matches_scalar_loop(self):
+        # independent scalar implementation of the reference recursion
+        rng = np.random.RandomState(3)
+        x = rng.randn(25)
+
+        def scalar_diff(ts, lag, start):
+            out = ts.copy()
+            for i in range(len(ts)):
+                out[i] = ts[i] - ts[i - lag] if i >= start else ts[i]
+            return out
+
+        expect = x.copy()
+        for i in range(1, 3):
+            expect = scalar_diff(expect, 1, i)
+        got = np.asarray(differences_of_order_d(jnp.asarray(x), 2))
+        np.testing.assert_allclose(got, expect, atol=1e-12)
+
+    def test_batched(self):
+        x = jnp.stack([arr(1, 2, 4), arr(10, 20, 40)])
+        out = np.asarray(differences_at_lag(x, 1))
+        assert list(out[1]) == [10, 10, 20]
+
+
+class TestMisc:
+    def test_quotients_price2ret(self):
+        x = arr(1, 2, 4, 8)
+        assert list(np.asarray(quotients(x, 1))) == [2, 2, 2]
+        assert list(np.asarray(price2ret(x, 2))) == [3, 3]
+
+    def test_autocorr_vs_numpy(self):
+        rng = np.random.RandomState(4)
+        x = rng.randn(100)
+        got = np.asarray(autocorr(jnp.asarray(x), 3))
+        for lag in range(1, 4):
+            s1, s2 = x[lag:], x[:-lag]
+            d1, d2 = s1 - s1.mean(), s2 - s2.mean()
+            expect = (d1 * d2).sum() / np.sqrt((d1 ** 2).sum() * (d2 ** 2).sum())
+            np.testing.assert_allclose(got[lag - 1], expect, atol=1e-10)
+
+    def test_down_up_sample(self):
+        x = arr(0, 1, 2, 3, 4, 5)
+        assert list(np.asarray(downsample(x, 2))) == [0, 2, 4]
+        assert list(np.asarray(downsample(x, 2, phase=1))) == [1, 3, 5]
+        up = np.asarray(upsample(arr(1, 2), 3))
+        assert up[0] == 1 and np.isnan(up[1]) and up[3] == 2 and len(up) == 6
+        up0 = np.asarray(upsample(arr(1, 2), 3, use_zero=True))
+        assert list(up0) == [1, 0, 0, 2, 0, 0]
+
+    def test_roll_sum_mean(self):
+        x = arr(1, 2, 3, 4, 5)
+        assert list(np.asarray(roll_sum(x, 2))) == [3, 5, 7, 9]
+        assert list(np.asarray(roll_mean(x, 2))) == [1.5, 2.5, 3.5, 4.5]
+
+
+class TestLagMatrix:
+    def test_docstring_example(self):
+        # ref UnivariateTimeSeries.scala:30-38
+        x = arr(1, 2, 3, 4, 5)
+        m = np.asarray(lag_matrix(x, 2, include_original=True))
+        expect = np.array([[3, 2, 1], [4, 3, 2], [5, 4, 3]], dtype=float)
+        np.testing.assert_array_equal(m, expect)
+
+    def test_without_original(self):
+        x = arr(1, 2, 3, 4, 5)
+        m = np.asarray(lag_matrix(x, 2))
+        expect = np.array([[2, 1], [3, 2], [4, 3]], dtype=float)
+        np.testing.assert_array_equal(m, expect)
+
+    def test_multi_column(self):
+        # ref Lag.scala:101-106: [a b] lag 2 -> [a_-1 a_-2 b_-1 b_-2]
+        a = np.arange(1.0, 6.0)
+        b = np.arange(10.0, 60.0, 10.0)
+        x = jnp.asarray(np.stack([a, b], axis=-1))
+        m = np.asarray(lag_matrix_multi(x, 2))
+        assert m.shape == (3, 4)
+        np.testing.assert_array_equal(m[0], [2, 1, 20, 10])
+
+    def test_batched(self):
+        x = jnp.stack([arr(1, 2, 3, 4), arr(5, 6, 7, 8)])
+        m = lag_matrix(x, 1, include_original=True)
+        assert m.shape == (2, 3, 2)
+
+
+class TestOLS:
+    def test_recovers_coefficients(self):
+        rng = np.random.RandomState(5)
+        X = rng.randn(200, 3)
+        beta = np.array([2.0, -1.0, 0.5])
+        y = X @ beta + 1.5 + rng.randn(200) * 0.01
+        res = ols(jnp.asarray(X), jnp.asarray(y), add_intercept=True)
+        np.testing.assert_allclose(np.asarray(res.beta), [1.5, 2.0, -1.0, 0.5],
+                                   atol=0.01)
+
+    def test_batched_fit(self):
+        rng = np.random.RandomState(6)
+        X = rng.randn(4, 100, 2)
+        betas = rng.randn(4, 2)
+        y = np.einsum("bnp,bp->bn", X, betas)
+        res = ols(jnp.asarray(X), jnp.asarray(y))
+        np.testing.assert_allclose(np.asarray(res.beta), betas, atol=1e-8)
