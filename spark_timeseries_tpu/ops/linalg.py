@@ -24,15 +24,17 @@ class OLSResult(NamedTuple):
     xtx_inv: jnp.ndarray     # (..., p, p) (X'X)^-1 for standard errors / tests
 
 
-def ols(X: jnp.ndarray, y: jnp.ndarray, add_intercept: bool = False) -> OLSResult:
-    """Least squares via batched QR: ``X (..., n, p)``, ``y (..., n)``.
+def _maybe_add_intercept(X: jnp.ndarray, add_intercept: bool) -> jnp.ndarray:
+    """Prepend a ones column (reference convention: intercept first)."""
+    if not add_intercept:
+        return X
+    ones = jnp.ones((*X.shape[:-1], 1), dtype=X.dtype)
+    return jnp.concatenate([ones, X], axis=-1)
 
-    With ``add_intercept`` a ones column is prepended (reference convention:
-    Commons-Math estimates the intercept first).
-    """
-    if add_intercept:
-        ones = jnp.ones((*X.shape[:-1], 1), dtype=X.dtype)
-        X = jnp.concatenate([ones, X], axis=-1)
+
+def ols(X: jnp.ndarray, y: jnp.ndarray, add_intercept: bool = False) -> OLSResult:
+    """Least squares via batched QR: ``X (..., n, p)``, ``y (..., n)``."""
+    X = _maybe_add_intercept(X, add_intercept)
     n, p = X.shape[-2], X.shape[-1]
     q, r = jnp.linalg.qr(X)
     qty = jnp.einsum("...np,...n->...p", q, y)
@@ -49,9 +51,7 @@ def ols(X: jnp.ndarray, y: jnp.ndarray, add_intercept: bool = False) -> OLSResul
 
 def ols_beta(X: jnp.ndarray, y: jnp.ndarray, add_intercept: bool = False) -> jnp.ndarray:
     """Coefficients only: QR + one triangular solve, skipping residual stats."""
-    if add_intercept:
-        ones = jnp.ones((*X.shape[:-1], 1), dtype=X.dtype)
-        X = jnp.concatenate([ones, X], axis=-1)
+    X = _maybe_add_intercept(X, add_intercept)
     q, r = jnp.linalg.qr(X)
     qty = jnp.einsum("...np,...n->...p", q, y)
     return solve_triangular(r, qty, lower=False)

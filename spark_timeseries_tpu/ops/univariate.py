@@ -321,12 +321,17 @@ def upsample(x: jnp.ndarray, n: int, phase: int = 0,
 
 
 def roll_sum(x: jnp.ndarray, window: int) -> jnp.ndarray:
-    """Sliding-window sum; output length ``n - window + 1`` (ref ``:497-499``)."""
-    c = jnp.cumsum(x, axis=-1)
-    lead = c[..., window - 1:]
-    lag_ = jnp.concatenate(
-        [jnp.zeros((*x.shape[:-1], 1), dtype=x.dtype), c[..., :-window]], axis=-1)
-    return lead - lag_
+    """Sliding-window sum; output length ``n - window + 1`` (ref ``:497-499``).
+
+    Stacked-slice sum rather than a cumsum difference so a NaN only poisons
+    the windows that actually contain it, matching the reference's per-window
+    loop; XLA fuses the ``window`` adds into one pass.
+    """
+    n = x.shape[-1]
+    out = x[..., :n - window + 1]
+    for i in range(1, window):
+        out = out + x[..., i:n - window + 1 + i]
+    return out
 
 
 def roll_mean(x: jnp.ndarray, window: int) -> jnp.ndarray:

@@ -1,0 +1,466 @@
+"""Panel: the central container — a keyed panel of time series on one index.
+
+This one class replaces BOTH of the reference's containers:
+
+- the local multivariate ``TimeSeries[K]`` (ref
+  ``/root/reference/src/main/scala/com/cloudera/sparkts/TimeSeries.scala:28-403``)
+- the distributed ``TimeSeriesRDD[K]`` (ref
+  ``/root/reference/src/main/scala/com/cloudera/sparkts/TimeSeriesRDD.scala:52-648``)
+
+because on TPU the "distributed collection of (key, vector) pairs" is simply a
+single ``(n_series, n_obs)`` array sharded over the series axis of a
+``jax.sharding.Mesh``.  Every per-series ``map`` in the reference becomes a
+batched XLA kernel over axis 0; Spark's shuffle/aggregate machinery becomes
+XLA collectives inserted automatically by ``jit`` on the sharded array.
+
+Layout choice: series-major ``(n_series, n_obs)`` (the reference's DenseMatrix
+is time-major obs x series).  Series-major puts the batch dimension first for
+``vmap``/sharding and makes each series a contiguous HBM row.
+
+Calendar logic (index arithmetic, key bookkeeping) stays host-side; only
+resolved integer locations and float arrays enter jitted code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ops import univariate as uv
+from .ops.lag import lag_matrix
+from .ops.resample import resample as _resample_values
+from .time import DateTimeIndex, Frequency, IrregularDateTimeIndex, UniformDateTimeIndex
+from .time.rebase import rebaser as _rebaser
+
+
+def lagged_string_key(key: str, lag_order: int) -> str:
+    """Key-naming convention for lagged series (ref ``TimeSeries.scala:406-407``)."""
+    return f"lag{lag_order}({key})" if lag_order > 0 else key
+
+
+def lagged_pair_key(key: Any, lag_order: int) -> Tuple[Any, int]:
+    """(key, lag) pair convention (ref ``TimeSeries.scala:409``)."""
+    return (key, lag_order)
+
+
+class Panel:
+    """A keyed panel of univariate series sharing one ``DateTimeIndex``.
+
+    Attributes:
+      index: the shared time index (host-side).
+      values: ``(n_series, n_obs)`` jax array; may carry a ``NamedSharding``
+        over the series axis (see :meth:`shard`).
+      keys: list of per-series keys (host-side).
+    """
+
+    def __init__(self, index: DateTimeIndex, values, keys: Sequence[Any]):
+        values = jnp.asarray(values)
+        if values.ndim != 2:
+            raise ValueError(f"values must be (n_series, n_obs), got {values.shape}")
+        if values.shape[1] != len(index):
+            raise ValueError(
+                f"values has {values.shape[1]} observations but index has "
+                f"{len(index)} instants")
+        if values.shape[0] != len(keys):
+            raise ValueError(
+                f"values has {values.shape[0]} series but {len(keys)} keys given")
+        self.index = index
+        self.values = values
+        self.keys = list(keys)
+
+    # -- basic introspection ------------------------------------------------
+
+    @property
+    def n_series(self) -> int:
+        return self.values.shape[0]
+
+    @property
+    def n_obs(self) -> int:
+        return self.values.shape[1]
+
+    def __len__(self) -> int:
+        return self.n_series
+
+    def __repr__(self) -> str:
+        return (f"Panel(n_series={self.n_series}, n_obs={self.n_obs}, "
+                f"index={self.index!r})")
+
+    def _with(self, values=None, index=None, keys=None) -> "Panel":
+        return Panel(self.index if index is None else index,
+                     self.values if values is None else values,
+                     self.keys if keys is None else keys)
+
+    # -- sharding (the L4 "distribution" tier) ------------------------------
+
+    def shard(self, mesh, axis_name: str = "series") -> "Panel":
+        """Place ``values`` on ``mesh`` sharded over the series axis.
+
+        TPU-native equivalent of partitioning the RDD across executors
+        (ref ``TimeSeriesRDD.scala:52-59``): one line of sharding metadata,
+        after which every op in this class runs SPMD with XLA inserting any
+        needed collectives over ICI.
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        sharding = NamedSharding(mesh, P(axis_name, None))
+        return self._with(values=jax.device_put(self.values, sharding))
+
+    def to_time_major(self) -> jnp.ndarray:
+        """``(n_obs, n_series)`` view — the reference's ``toInstants`` shuffle
+        transpose (ref ``TimeSeriesRDD.scala:276-391``) collapses to one
+        transpose; under ``jit`` on a sharded panel XLA lowers the resharding
+        to an ``all_to_all`` over ICI instead of a Spark shuffle."""
+        return self.values.T
+
+    # -- per-series iteration & lookup (ref TimeSeries.scala:273-293) -------
+
+    def __iter__(self) -> Iterator[Tuple[Any, np.ndarray]]:
+        host = np.asarray(self.values)
+        for i, k in enumerate(self.keys):
+            yield k, host[i]
+
+    def head(self) -> Tuple[Any, np.ndarray]:
+        """First (key, series) pair (ref ``TimeSeries.scala:365-368``)."""
+        return self.keys[0], np.asarray(self.values[0])
+
+    def find_series(self, key: Any) -> np.ndarray:
+        """Series for ``key`` (ref ``TimeSeriesRDD.scala:265-273`` findSeries)."""
+        return np.asarray(self.values[self.keys.index(key)])
+
+    def select(self, keys: Sequence[Any]) -> "Panel":
+        """Sub-panel with the given keys, in the given order."""
+        locs = [self.keys.index(k) for k in keys]
+        return self._with(values=self.values[jnp.array(locs)], keys=list(keys))
+
+    def filter_keys(self, predicate: Callable[[Any], bool]) -> "Panel":
+        """Keep series whose key satisfies ``predicate``
+        (ref ``TimeSeriesRDD.scala:133-138`` filter/findSeries family)."""
+        locs = [i for i, k in enumerate(self.keys) if predicate(k)]
+        return self._with(values=self.values[jnp.array(locs)],
+                          keys=[self.keys[i] for i in locs])
+
+    def filter_start_with(self, prefix: str) -> "Panel":
+        """(ref ``TimeSeriesRDD.scala:140-145`` filterStartingWith)."""
+        return self.filter_keys(lambda k: str(k).startswith(prefix))
+
+    def filter_end_with(self, suffix: str) -> "Panel":
+        """(ref ``TimeSeriesRDD.scala:147-151`` filterEndingWith)."""
+        return self.filter_keys(lambda k: str(k).endswith(suffix))
+
+    def union(self, other: "Panel") -> "Panel":
+        """Stack another panel's series on the same index
+        (ref ``TimeSeries.scala:163-168`` union)."""
+        if len(other.index) != len(self.index):
+            raise ValueError("union requires identical index lengths")
+        return self._with(values=jnp.concatenate([self.values, other.values]),
+                          keys=self.keys + other.keys)
+
+    def add_series(self, key: Any, series) -> "Panel":
+        return self.union(Panel(self.index, jnp.asarray(series)[None, :], [key]))
+
+    # -- time slicing (ref TimeSeriesRDD.scala:218-243) ----------------------
+
+    def islice(self, start: int, end: int) -> "Panel":
+        """Slice by integer location range [start, end)."""
+        return self._with(values=self.values[:, start:end],
+                          index=self.index.islice(start, end))
+
+    def slice(self, start, end) -> "Panel":
+        """Slice by datetimes (inclusive, like the reference's ``slice``)."""
+        lo = self.index.loc_at_or_after(start)
+        hi = self.index.loc_at_or_before(end) + 1
+        return self.islice(lo, hi)
+
+    # -- elementwise / per-series transforms ---------------------------------
+
+    def map_values(self, f: Callable[[jnp.ndarray], jnp.ndarray]) -> "Panel":
+        """Apply an index-preserving batched transform to the value matrix
+        (ref ``TimeSeriesRDD.scala:249-254`` mapSeries — but batched, not
+        per-series closures)."""
+        return self._with(values=f(self.values))
+
+    def map_series(self, f: Callable[[jnp.ndarray], jnp.ndarray],
+                   new_index: Optional[DateTimeIndex] = None) -> "Panel":
+        """``vmap`` a single-series function over the panel
+        (ref ``TimeSeries.scala:332-363`` mapSeries).  ``f`` takes ``(n,)`` and
+        returns ``(m,)`` with ``m == len(new_index or index)``."""
+        out = jax.vmap(f)(self.values)
+        idx = self.index if new_index is None else new_index
+        if out.shape[1] != len(idx):
+            raise ValueError(
+                f"mapped series length {out.shape[1]} != index size {len(idx)}")
+        return self._with(values=out, index=idx)
+
+    def fill(self, method: str) -> "Panel":
+        """NaN imputation (ref ``TimeSeriesRDD.scala:241-243``)."""
+        return self._with(values=uv.fillts(self.values, method))
+
+    def differences(self, lag: int = 1) -> "Panel":
+        """Order-``lag`` differencing, dropping the first ``lag`` instants
+        (ref ``TimeSeries.scala:241-249``)."""
+        vals = self.values[:, lag:] - self.values[:, :-lag]
+        return self._with(values=vals, index=self.index.islice(lag, len(self.index)))
+
+    def quotients(self, lag: int = 1) -> "Panel":
+        """(ref ``TimeSeries.scala:255-263``)."""
+        return self._with(values=uv.quotients(self.values, lag),
+                          index=self.index.islice(lag, len(self.index)))
+
+    def price2ret(self) -> "Panel":
+        """Periodic returns (ref ``TimeSeries.scala:269-271``)."""
+        return self._with(values=uv.price2ret(self.values, 1),
+                          index=self.index.islice(1, len(self.index)))
+
+    return_rates = price2ret  # ref TimeSeriesRDD.scala:126-131 returnRates
+
+    def roll_sum(self, window: int) -> "Panel":
+        """Sliding sum; drops the first ``window-1`` instants
+        (ref ``TimeSeriesRDD.scala:611-620`` rollSum)."""
+        return self._with(values=uv.roll_sum(self.values, window),
+                          index=self.index.islice(window - 1, len(self.index)))
+
+    def roll_mean(self, window: int) -> "Panel":
+        """(ref ``TimeSeriesRDD.scala:629-647`` rollMean)."""
+        return self._with(values=uv.roll_mean(self.values, window),
+                          index=self.index.islice(window - 1, len(self.index)))
+
+    def differences_by_frequency(self, frequency: Frequency) -> "Panel":
+        """Difference each series against the value one ``frequency`` earlier,
+        falling back to the most recent earlier observation
+        (ref ``TimeSeries.scala:200-235`` differencesByFrequency).
+
+        NaN semantics match the reference: if x[t] is NaN the output is NaN;
+        if the looked-up earlier value is NaN, walk back to the most recent
+        non-NaN (per series).  The calendar lookups are host-side; the
+        per-series NaN walk-back is a batched cummax gather on device.
+        """
+        zone = self.index.zone
+        start_nanos = frequency.advance(self.index.first_nanos, 1, zone)
+        start = self.index.loc_at_or_after(start_nanos)
+        if start == 0:
+            start = 1
+        n = len(self.index)
+        new_index = self.index.islice(start, n)
+        # host: for each kept instant, the location of (t - frequency), at or
+        # before; -1 clamps to 0 like the reference
+        prev_locs = np.empty(n - start, dtype=np.int64)
+        for j, i in enumerate(range(start, n)):
+            prev_nanos = frequency.advance(self.index.nanos_at_loc(i), -1, zone)
+            prev_locs[j] = max(self.index.loc_at_or_before(prev_nanos), 0)
+
+        vals = self.values
+        valid = ~jnp.isnan(vals)
+        iota = jnp.arange(n)
+        prev_valid = jax.lax.cummax(jnp.where(valid, iota, -1), axis=1)
+        # per series: most recent non-NaN at or before prev_locs
+        cand = prev_valid[:, jnp.asarray(prev_locs)]            # (s, m)
+        base = jnp.take_along_axis(vals, jnp.clip(cand, 0, None), axis=1)
+        base = jnp.where(cand < 0, jnp.nan, base)
+        cur = vals[:, start:]
+        return self._with(values=cur - base, index=new_index)
+
+    # -- lagging (ref TimeSeries.scala:58-158, TimeSeriesRDD.scala:86-100) ---
+
+    def lags(self, max_lag: int, include_original: bool,
+             lagged_key: Callable[[Any, int], Any] = lagged_pair_key) -> "Panel":
+        """Lagged panel: for each series, columns lag0 (optional), lag1..lagK,
+        dropping the first ``max_lag`` instants.  Key layout matches the
+        reference (per-series blocks, original first)."""
+        if not isinstance(self.index, UniformDateTimeIndex):
+            raise ValueError("lags requires a UniformDateTimeIndex")
+        n = self.n_obs
+        start = 0 if include_original else 1
+        # (s, n - max_lag, cols) -> (s, cols, n - max_lag) -> flatten blocks
+        lm = lag_matrix(self.values, max_lag, include_original)
+        new_vals = jnp.moveaxis(lm, -1, -2).reshape(-1, n - max_lag)
+        new_keys = [lagged_key(k, l)
+                    for k in self.keys for l in range(start, max_lag + 1)]
+        return self._with(values=new_vals, keys=new_keys,
+                          index=self.index.islice(max_lag, n))
+
+    def lags_per_key(self, lags_per_key: dict,
+                     lagged_key: Callable[[Any, int], Any] = lagged_pair_key
+                     ) -> "Panel":
+        """Per-key (include_original, max_lag) lagging
+        (ref ``TimeSeries.scala:117-158``)."""
+        if not isinstance(self.index, UniformDateTimeIndex):
+            raise ValueError("lags requires a UniformDateTimeIndex")
+        max_lag = max(ml for _, ml in lags_per_key.values())
+        n = self.n_obs
+        rows, new_keys = [], []
+        for i, k in enumerate(self.keys):
+            include, ml = lags_per_key[k]
+            for l in range(0 if include else 1, ml + 1):
+                rows.append(self.values[i, max_lag - l:n - l])
+                new_keys.append(lagged_key(k, l))
+        return self._with(values=jnp.stack(rows), keys=new_keys,
+                          index=self.index.islice(max_lag, n))
+
+    # -- cross-series instant filters (ref TimeSeriesRDD.scala:158-210) ------
+
+    def filter_by_instant(self, predicate: Callable[[jnp.ndarray], jnp.ndarray],
+                          filter_keys: Optional[Sequence[Any]] = None) -> "Panel":
+        """Keep instants where ``predicate`` holds for at least one of the
+        selected series (ref ``TimeSeries.scala:305-327`` /
+        ``TimeSeriesRDD.scala:158-177``).  ``predicate`` must be an
+        elementwise jax-traceable function; the OR-reduction over the sharded
+        series axis is XLA's psum equivalent of the reference's distributed
+        ``aggregate``.  The result carries an irregular index (shape is
+        data-dependent, so the gather is host-side).
+        """
+        sub = self if filter_keys is None else self.select(filter_keys)
+        keep = np.asarray(jnp.any(predicate(sub.values), axis=0))
+        locs = np.flatnonzero(keep)
+        nanos = self.index.to_nanos_array()[locs]
+        return self._with(values=self.values[:, jnp.asarray(locs)],
+                          index=IrregularDateTimeIndex(nanos, self.index.zone))
+
+    def remove_instants_with_nans(self) -> "Panel":
+        """Drop instants where any series is NaN
+        (ref ``TimeSeriesRDD.scala:184-210``)."""
+        keep = np.asarray(~jnp.any(jnp.isnan(self.values), axis=0))
+        locs = np.flatnonzero(keep)
+        nanos = self.index.to_nanos_array()[locs]
+        return self._with(values=self.values[:, jnp.asarray(locs)],
+                          index=IrregularDateTimeIndex(nanos, self.index.zone))
+
+    # -- resampling ----------------------------------------------------------
+
+    def resample(self, target_index: DateTimeIndex, aggr: str = "mean",
+                 closed_right: bool = False, stamp_right: bool = False) -> "Panel":
+        """Window resampling onto ``target_index``
+        (ref ``TimeSeries.scala:370-402`` / ``Resample.scala:47-121``)."""
+        vals = _resample_values(self.values, self.index, target_index, aggr,
+                                closed_right, stamp_right)
+        return self._with(values=vals, index=target_index)
+
+    def with_index(self, new_index: DateTimeIndex,
+                   default_value: float = np.nan) -> "Panel":
+        """Rebase every series onto a new index, NaN-filling missing instants
+        (ref ``TimeSeriesRDD.scala:657-666`` constructor rebase path)."""
+        rb = _rebaser(self.index, new_index, default_value)
+        return self._with(values=jnp.asarray(rb(np.asarray(self.values))),
+                          index=new_index)
+
+    # -- summary stats (ref TimeSeriesRDD.scala:265-267 seriesStats) ----------
+
+    def series_stats(self) -> dict:
+        """Per-series count/mean/stdev/min/max, NaN-aware — the StatCounter
+        equivalent.  Returns a dict of ``(n_series,)`` numpy arrays."""
+        v = self.values
+        m = ~jnp.isnan(v)
+        cnt = jnp.sum(m, axis=1)
+        safe_cnt = jnp.maximum(cnt, 1)
+        mean = jnp.sum(jnp.where(m, v, 0.0), axis=1) / safe_cnt
+        var = jnp.sum(jnp.where(m, (v - mean[:, None]) ** 2, 0.0), axis=1) \
+            / jnp.maximum(safe_cnt - 1, 1)
+        big = jnp.inf
+        return {
+            "count": np.asarray(cnt),
+            "mean": np.asarray(mean),
+            "stdev": np.asarray(jnp.sqrt(var)),
+            "min": np.asarray(jnp.min(jnp.where(m, v, big), axis=1)),
+            "max": np.asarray(jnp.max(jnp.where(m, v, -big), axis=1)),
+        }
+
+    # -- instants / pandas bridges -------------------------------------------
+
+    def to_instants(self) -> List[Tuple[Any, np.ndarray]]:
+        """List of (datetime, cross-section vector) pairs
+        (ref ``TimeSeries.scala:295-298`` / ``TimeSeriesRDD.scala:276-391``)."""
+        tm = np.asarray(self.to_time_major())
+        return [(self.index.datetime_at_loc(i), tm[i]) for i in range(self.n_obs)]
+
+    def to_instants_dataframe(self):
+        """Wide DataFrame: one row per instant, one column per key
+        (ref ``TimeSeriesRDD.scala:399-413``)."""
+        import pandas as pd
+        df = pd.DataFrame(np.asarray(self.to_time_major()),
+                          columns=[str(k) for k in self.keys])
+        df.insert(0, "instant", self.index.to_datetime_array())
+        return df
+
+    def to_observations_dataframe(self, ts_col: str = "timestamp",
+                                  key_col: str = "key",
+                                  value_col: str = "value"):
+        """Long-format DataFrame of (timestamp, key, value) observations,
+        NaNs dropped (ref ``TimeSeriesRDD.scala:419-443``)."""
+        import pandas as pd
+        host = np.asarray(self.values)
+        dts = np.array(self.index.to_datetime_array(), dtype=object)
+        mask = ~np.isnan(host)
+        s_idx, t_idx = np.nonzero(mask)
+        return pd.DataFrame({
+            ts_col: dts[t_idx],
+            key_col: np.array([str(k) for k in self.keys], dtype=object)[s_idx],
+            value_col: host[mask],
+        })
+
+    def to_pandas(self):
+        """Wide pandas DataFrame indexed by datetime (keys as columns)."""
+        import pandas as pd
+        return pd.DataFrame(np.asarray(self.to_time_major()),
+                            index=pd.DatetimeIndex(self.index.to_datetime_array()),
+                            columns=[str(k) for k in self.keys])
+
+    def collect(self) -> Tuple[List[Any], np.ndarray]:
+        """Materialize (keys, values) on host
+        (ref ``TimeSeriesRDD.scala:61-75`` collectAsTimeSeries)."""
+        return self.keys, np.asarray(self.values)
+
+    # -- constructors --------------------------------------------------------
+
+    @staticmethod
+    def from_series(pairs: Iterable[Tuple[Any, DateTimeIndex, np.ndarray]],
+                    target_index: DateTimeIndex) -> "Panel":
+        """Build from (key, index, values) triples, rebasing each onto
+        ``target_index`` (ref ``TimeSeriesRDD.scala:657-666``)."""
+        keys, rows = [], []
+        for key, idx, vals in pairs:
+            rb = _rebaser(idx, target_index, np.nan)
+            keys.append(key)
+            rows.append(rb(np.asarray(vals, dtype=np.float64)))
+        return Panel(target_index, jnp.asarray(np.stack(rows)), keys)
+
+    @staticmethod
+    def from_observations(df, target_index: DateTimeIndex,
+                          ts_col: str = "timestamp", key_col: str = "key",
+                          value_col: str = "value") -> "Panel":
+        """Long-format observations DataFrame → panel
+        (ref ``TimeSeriesRDD.scala:694-745`` timeSeriesRDDFromObservations).
+
+        The reference's key-hash shuffle + secondary sort + per-observation
+        index lookup becomes three vectorized host steps: factorize keys,
+        bulk-resolve timestamp locations, one scatter into the dense panel.
+        """
+        keys_arr = np.asarray(df[key_col])
+        uniq_keys, key_codes = np.unique(keys_arr, return_inverse=True)
+        ts = df[ts_col]
+        nanos = _timestamps_to_nanos(ts)
+        locs = target_index.locs_at(nanos)
+        vals = np.asarray(df[value_col], dtype=np.float64)
+        data = np.full((len(uniq_keys), len(target_index)), np.nan)
+        ok = locs >= 0
+        data[key_codes[ok], locs[ok]] = vals[ok]
+        return Panel(target_index, jnp.asarray(data), list(uniq_keys))
+
+    @staticmethod
+    def from_pandas(df, target_index: Optional[DateTimeIndex] = None) -> "Panel":
+        """Wide DataFrame (datetime index, one column per key) → panel."""
+        if target_index is None:
+            nanos = _timestamps_to_nanos(df.index)
+            target_index = IrregularDateTimeIndex(nanos)
+        return Panel(target_index,
+                     jnp.asarray(df.to_numpy(dtype=np.float64).T),
+                     list(df.columns))
+
+
+def _timestamps_to_nanos(ts) -> np.ndarray:
+    """Vectorized datetime-like → epoch-nanos int64."""
+    import pandas as pd
+    dtindex = pd.DatetimeIndex(ts)
+    if dtindex.tz is not None:
+        dtindex = dtindex.tz_convert("UTC").tz_localize(None)
+    return dtindex.as_unit("ns").asi8.astype(np.int64)

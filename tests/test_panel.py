@@ -1,0 +1,281 @@
+"""Panel container tests.
+
+Contracts from the reference's TimeSeriesSuite
+(/root/reference/src/test/scala/com/cloudera/sparkts/TimeSeriesSuite.scala)
+and TimeSeriesRDDSuite
+(/root/reference/src/test/scala/com/cloudera/sparkts/TimeSeriesRDDSuite.scala),
+re-expressed against the batched Panel API.
+"""
+
+import datetime as dt
+
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu import Panel, lagged_string_key
+from spark_timeseries_tpu.time import (
+    DayFrequency, HourFrequency, IrregularDateTimeIndex, UniformDateTimeIndex,
+    irregular, uniform,
+)
+
+UTC = dt.timezone.utc
+
+
+def _uniform_panel(n_series=3, n_obs=10, start="2015-04-09T00:00Z", freq=None):
+    idx = uniform(start, n_obs, freq or DayFrequency(1))
+    rng = np.random.RandomState(42)
+    vals = rng.randn(n_series, n_obs)
+    keys = [f"k{i}" for i in range(n_series)]
+    return Panel(idx, vals, keys)
+
+
+class TestConstruction:
+    def test_shape_validation(self):
+        idx = uniform("2015-04-09T00:00Z", 4, DayFrequency(1))
+        with pytest.raises(ValueError):
+            Panel(idx, np.zeros((2, 5)), ["a", "b"])
+        with pytest.raises(ValueError):
+            Panel(idx, np.zeros((2, 4)), ["a"])
+
+    def test_iteration_and_lookup(self):
+        p = _uniform_panel()
+        pairs = list(p)
+        assert [k for k, _ in pairs] == ["k0", "k1", "k2"]
+        np.testing.assert_allclose(p.find_series("k1"), np.asarray(p.values)[1])
+        k, v = p.head()
+        assert k == "k0" and v.shape == (10,)
+
+
+class TestLags:
+    def test_uniform_lags_string_keys(self):
+        # mirror of TimeSeriesSuite "lags" example (ref TimeSeries.scala:44-55)
+        idx = uniform("2015-04-09T00:00Z", 5, DayFrequency(1))
+        vals = np.array([[1.0, 2, 3, 4, 5], [6.0, 7, 8, 9, 10]])
+        p = Panel(idx, vals, ["a", "b"])
+        lagged = p.lags(2, True, lagged_string_key)
+        assert lagged.keys == ["a", "lag1(a)", "lag2(a)",
+                               "b", "lag1(b)", "lag2(b)"]
+        assert lagged.n_obs == 3
+        expect = np.array([
+            [3.0, 4, 5], [2.0, 3, 4], [1.0, 2, 3],
+            [8.0, 9, 10], [7.0, 8, 9], [6.0, 7, 8],
+        ])
+        np.testing.assert_allclose(np.asarray(lagged.values), expect)
+        assert lagged.index.first == dt.datetime(2015, 4, 11, tzinfo=UTC)
+
+    def test_lags_without_originals(self):
+        idx = uniform("2015-04-09T00:00Z", 5, DayFrequency(1))
+        vals = np.array([[1.0, 2, 3, 4, 5]])
+        p = Panel(idx, vals, ["a"])
+        lagged = p.lags(2, False, lagged_string_key)
+        assert lagged.keys == ["lag1(a)", "lag2(a)"]
+        np.testing.assert_allclose(np.asarray(lagged.values),
+                                   [[2.0, 3, 4], [1.0, 2, 3]])
+
+    def test_lags_per_key(self):
+        # ref TimeSeriesSuite custom lags test: a keeps original w/ lag1,
+        # b only lag2
+        idx = uniform("2015-04-09T00:00Z", 5, DayFrequency(1))
+        vals = np.array([[1.0, 2, 3, 4, 5], [6.0, 7, 8, 9, 10]])
+        p = Panel(idx, vals, ["a", "b"])
+        lagged = p.lags_per_key({"a": (True, 1), "b": (False, 2)},
+                                lagged_string_key)
+        assert lagged.keys == ["a", "lag1(a)", "lag1(b)", "lag2(b)"]
+        expect = np.array([
+            [3.0, 4, 5], [2.0, 3, 4], [7.0, 8, 9], [6.0, 7, 8]])
+        np.testing.assert_allclose(np.asarray(lagged.values), expect)
+
+
+class TestTransforms:
+    def test_differences(self):
+        p = _uniform_panel()
+        d = p.differences(2)
+        assert d.n_obs == 8
+        host = np.asarray(p.values)
+        np.testing.assert_allclose(np.asarray(d.values),
+                                   host[:, 2:] - host[:, :-2])
+
+    def test_quotients_and_returns(self):
+        idx = uniform("2015-04-09T00:00Z", 3, DayFrequency(1))
+        p = Panel(idx, np.array([[2.0, 4.0, 6.0]]), ["a"])
+        np.testing.assert_allclose(np.asarray(p.quotients().values),
+                                   [[2.0, 1.5]])
+        np.testing.assert_allclose(np.asarray(p.price2ret().values),
+                                   [[1.0, 0.5]])
+
+    def test_fill(self):
+        idx = uniform("2015-04-09T00:00Z", 4, DayFrequency(1))
+        p = Panel(idx, np.array([[1.0, np.nan, 3.0, np.nan]]), ["a"])
+        np.testing.assert_allclose(np.asarray(p.fill("linear").values),
+                                   [[1.0, 2.0, 3.0, np.nan]])
+
+    def test_roll_sum_mean(self):
+        idx = uniform("2015-04-09T00:00Z", 5, DayFrequency(1))
+        p = Panel(idx, np.array([[1.0, 2, 3, 4, 5]]), ["a"])
+        rs = p.roll_sum(3)
+        assert rs.n_obs == 3
+        np.testing.assert_allclose(np.asarray(rs.values), [[6.0, 9, 12]])
+        np.testing.assert_allclose(np.asarray(p.roll_mean(3).values),
+                                   [[2.0, 3, 4]])
+        assert rs.index.first == dt.datetime(2015, 4, 11, tzinfo=UTC)
+
+    def test_map_series_with_new_index(self):
+        p = _uniform_panel()
+        d = p.map_series(lambda v: v[1:] * 2.0, p.index.islice(1, 10))
+        np.testing.assert_allclose(np.asarray(d.values),
+                                   np.asarray(p.values)[:, 1:] * 2)
+
+    def test_differences_by_frequency(self):
+        # ref TimeSeries.scala:174-199 docstring example
+        nanos_h = 3_600_000_000_000
+        base = 1_000_000_000_000_000_000
+        times = np.array([1, 2, 10, 11, 12]) * nanos_h + base
+        idx = irregular(times)
+        p = Panel(idx, np.array([[3.5, 3.6, 4.6, 5.9, 6.6]]), ["v"])
+        d = p.differences_by_frequency(HourFrequency(10))
+        assert d.n_obs == 2
+        np.testing.assert_allclose(np.asarray(d.values), [[2.4, 3.0]], atol=1e-12)
+
+    def test_differences_by_frequency_nan_walkback(self):
+        nanos_h = 3_600_000_000_000
+        base = 1_000_000_000_000_000_000
+        times = np.array([1, 2, 10, 11, 12]) * nanos_h + base
+        idx = irregular(times)
+        # value at 2h is NaN: differencing at 11h must walk back to 1h
+        p = Panel(idx, np.array([[3.5, np.nan, 4.6, 5.9, 6.6]]), ["v"])
+        d = p.differences_by_frequency(HourFrequency(10))
+        np.testing.assert_allclose(np.asarray(d.values),
+                                   [[5.9 - 3.5, 6.6 - 3.5]], atol=1e-12)
+
+
+class TestSliceFilter:
+    def test_slice_by_datetime_inclusive(self):
+        p = _uniform_panel()
+        s = p.slice(dt.datetime(2015, 4, 10, tzinfo=UTC),
+                    dt.datetime(2015, 4, 14, tzinfo=UTC))
+        assert s.n_obs == 5
+        assert s.index.first == dt.datetime(2015, 4, 10, tzinfo=UTC)
+        assert s.index.last == dt.datetime(2015, 4, 14, tzinfo=UTC)
+
+    def test_filter_by_instant(self):
+        idx = uniform("2015-04-09T00:00Z", 4, DayFrequency(1))
+        vals = np.array([[1.0, -1.0, 2.0, -2.0],
+                         [-1.0, -1.0, -1.0, 3.0]])
+        p = Panel(idx, vals, ["a", "b"])
+        f = p.filter_by_instant(lambda x: x > 0, ["a"])
+        assert f.n_obs == 2
+        assert isinstance(f.index, IrregularDateTimeIndex)
+        np.testing.assert_allclose(np.asarray(f.values),
+                                   [[1.0, 2.0], [-1.0, -1.0]])
+
+    def test_remove_instants_with_nans(self):
+        # ref TimeSeriesRDDSuite "removeInstantsWithNaNs"
+        idx = uniform("2015-04-09T00:00Z", 4, DayFrequency(1))
+        vals = np.array([[1.0, 2, np.nan, 4], [5.0, np.nan, 7, 8]])
+        p = Panel(idx, vals, ["a", "b"])
+        r = p.remove_instants_with_nans()
+        assert r.n_obs == 2
+        np.testing.assert_allclose(np.asarray(r.values), [[1.0, 4], [5.0, 8]])
+
+    def test_filter_keys(self):
+        p = _uniform_panel()
+        assert p.filter_start_with("k").n_series == 3
+        assert p.filter_end_with("1").keys == ["k1"]
+        assert p.select(["k2", "k0"]).keys == ["k2", "k0"]
+
+
+class TestUnionStats:
+    def test_union_and_add_series(self):
+        p = _uniform_panel(n_series=2)
+        q = p.add_series("new", np.zeros(10))
+        assert q.n_series == 3 and q.keys[-1] == "new"
+
+    def test_series_stats(self):
+        idx = uniform("2015-04-09T00:00Z", 4, DayFrequency(1))
+        p = Panel(idx, np.array([[1.0, 2, 3, np.nan]]), ["a"])
+        st = p.series_stats()
+        assert st["count"][0] == 3
+        np.testing.assert_allclose(st["mean"][0], 2.0)
+        np.testing.assert_allclose(st["min"][0], 1.0)
+        np.testing.assert_allclose(st["max"][0], 3.0)
+
+
+class TestBridges:
+    def test_to_instants(self):
+        p = _uniform_panel(n_series=2, n_obs=3)
+        inst = p.to_instants()
+        assert len(inst) == 3
+        assert inst[0][0] == dt.datetime(2015, 4, 9, tzinfo=UTC)
+        np.testing.assert_allclose(inst[1][1], np.asarray(p.values)[:, 1])
+
+    def test_instants_dataframe(self):
+        p = _uniform_panel(n_series=2, n_obs=3)
+        df = p.to_instants_dataframe()
+        assert list(df.columns) == ["instant", "k0", "k1"]
+        assert len(df) == 3
+
+    def test_observations_roundtrip(self):
+        # ref TimeSeriesRDDSuite "toObservationsDataFrame" round trip
+        p = _uniform_panel(n_series=3, n_obs=5)
+        obs = p.to_observations_dataframe()
+        assert len(obs) == 15
+        back = Panel.from_observations(obs, p.index)
+        assert back.keys == p.keys
+        np.testing.assert_allclose(np.asarray(back.values),
+                                   np.asarray(p.values))
+
+    def test_observations_with_nans_roundtrip(self):
+        idx = uniform("2015-04-09T00:00Z", 3, DayFrequency(1))
+        p = Panel(idx, np.array([[1.0, np.nan, 3.0], [4.0, 5.0, np.nan]]),
+                  ["a", "b"])
+        obs = p.to_observations_dataframe()
+        assert len(obs) == 4  # NaNs dropped
+        back = Panel.from_observations(obs, idx)
+        np.testing.assert_allclose(np.asarray(back.values),
+                                   np.asarray(p.values))
+
+    def test_pandas_roundtrip(self):
+        p = _uniform_panel(n_series=2, n_obs=4)
+        df = p.to_pandas()
+        back = Panel.from_pandas(df)
+        np.testing.assert_allclose(np.asarray(back.values),
+                                   np.asarray(p.values))
+        np.testing.assert_array_equal(back.index.to_nanos_array(),
+                                      p.index.to_nanos_array())
+
+    def test_from_series_rebases(self):
+        target = uniform("2015-04-09T00:00Z", 4, DayFrequency(1))
+        src1 = uniform("2015-04-09T00:00Z", 3, DayFrequency(1))
+        src2 = uniform("2015-04-10T00:00Z", 3, DayFrequency(1))
+        p = Panel.from_series(
+            [("a", src1, np.array([1.0, 2, 3])),
+             ("b", src2, np.array([4.0, 5, 6]))], target)
+        np.testing.assert_allclose(
+            np.asarray(p.values),
+            [[1.0, 2, 3, np.nan], [np.nan, 4, 5, 6]])
+
+
+class TestSharded:
+    def test_ops_on_sharded_panel(self, mesh):
+        p = _uniform_panel(n_series=8, n_obs=16).shard(mesh)
+        assert len(p.values.sharding.device_set) == 8
+        d = p.differences(1).fill("zero").roll_mean(2)
+        assert d.n_obs == 14
+        # time-major transpose works on the sharded array (all_to_all path)
+        tm = np.asarray(d.to_time_major())
+        assert tm.shape == (14, 8)
+
+    def test_remove_instants_sharded(self, mesh):
+        idx = uniform("2015-04-09T00:00Z", 4, DayFrequency(1))
+        vals = np.random.RandomState(0).randn(8, 4)
+        vals[3, 2] = np.nan
+        p = Panel(idx, vals, [f"k{i}" for i in range(8)]).shard(mesh)
+        r = p.remove_instants_with_nans()
+        assert r.n_obs == 3
+
+    def test_resample(self):
+        idx = uniform("2015-04-09T00:00Z", 6, HourFrequency(12))
+        p = Panel(idx, np.array([[1.0, 2, 3, 4, 5, 6]]), ["a"])
+        tgt = uniform("2015-04-09T00:00Z", 3, DayFrequency(1))
+        r = p.resample(tgt, "mean")
+        np.testing.assert_allclose(np.asarray(r.values), [[1.5, 3.5, 5.5]])
