@@ -1,0 +1,113 @@
+"""Headline benchmark: batched model fitting throughput (series fitted/sec/chip).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md), so the baseline is measured
+in-process: the reference's per-series fit path — a scalar optimizer loop per
+series (Breeze + Commons-Math CGD, ref
+``/root/reference/src/main/scala/com/cloudera/sparkts/models/EWMA.scala:45-69``)
+— is emulated with an equivalent per-series scipy/numpy CGD loop on CPU, timed
+on a subsample, and extrapolated.  ``vs_baseline`` = batched-TPU rate divided
+by that per-series CPU rate.
+
+Current flagship config: EWMA fit on a synthetic AR(1) panel (BASELINE.json
+config #1).  Switches to ARIMA(2,1,2) when the ARIMA tier lands.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _synthetic_ar1_panel(n_series: int, n_obs: int, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    phi = rng.uniform(0.5, 0.95, size=(n_series, 1))
+    eps = rng.normal(size=(n_series, n_obs))
+    out = np.empty((n_series, n_obs))
+    out[:, 0] = eps[:, 0]
+    for t in range(1, n_obs):
+        out[:, t] = phi[:, 0] * out[:, t - 1] + eps[:, t]
+    return out + 100.0
+
+
+def _ewma_sse_and_grad(alpha: float, x: np.ndarray):
+    """Scalar-loop SSE + analytic gradient — the per-series objective shape
+    of the reference (ref ``EWMA.scala:81-123``), with the correct gradient
+    sign (dJ/da = -2 Σ err_i · dS_i/da; verified against finite differences)."""
+    n = x.shape[0]
+    s = x[0]        # S_i, starting at S_0 = x_0
+    dsda = 0.0      # dS_i/da, dS_0/da = 0
+    sse = 0.0
+    djda = 0.0
+    for i in range(n - 1):
+        err = x[i + 1] - s
+        sse += err * err
+        djda += -2.0 * err * dsda
+        dsda = x[i + 1] - s + (1.0 - alpha) * dsda
+        s = alpha * x[i + 1] + (1.0 - alpha) * s
+    return sse, djda
+
+
+def _baseline_rate(panel: np.ndarray, sample: int = 32) -> float:
+    """Per-series scalar CPU fit rate (series/sec), reference-style."""
+    try:
+        from scipy.optimize import minimize as sp_minimize
+
+        def fit_one(x):
+            sp_minimize(lambda a: _ewma_sse_and_grad(a[0], x)[0],
+                        np.array([0.94]), method="CG",
+                        jac=lambda a: np.array([_ewma_sse_and_grad(a[0], x)[1]]),
+                        tol=1e-6)
+    except ImportError:
+        def fit_one(x):
+            a = 0.94
+            for _ in range(60):
+                _, g = _ewma_sse_and_grad(a, x)
+                a -= 1e-6 * g
+    sub = panel[:sample]
+    t0 = time.perf_counter()
+    for row in sub:
+        fit_one(row)
+    dt = time.perf_counter() - t0
+    return sample / dt
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from spark_timeseries_tpu.models import ewma
+
+    n_series = int(os.environ.get("BENCH_N_SERIES", "65536"))
+    n_obs = int(os.environ.get("BENCH_N_OBS", "128"))
+    panel = _synthetic_ar1_panel(n_series, n_obs)
+
+    if jax.devices()[0].platform == "tpu":
+        dtype = jnp.float32
+    else:
+        jax.config.update("jax_enable_x64", True)
+        dtype = jnp.float64
+    values = jnp.asarray(panel, dtype=dtype)
+
+    fit = jax.jit(lambda v: ewma.fit(v).smoothing)
+    fit(values).block_until_ready()  # compile
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fit(values).block_until_ready()
+    batched_rate = n_series * reps / (time.perf_counter() - t0)
+
+    cpu_rate = _baseline_rate(panel)
+
+    print(json.dumps({
+        "metric": "EWMA series fitted/sec/chip (synthetic AR(1) panel, "
+                  f"{n_series}x{n_obs})",
+        "value": round(batched_rate, 1),
+        "unit": "series/sec",
+        "vs_baseline": round(batched_rate / cpu_rate, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""Model tier (L5): batched classical time-series models.
+
+Parity targets the reference's ``models/`` package
+(``/root/reference/src/main/scala/com/cloudera/sparkts/models/``): ARIMA,
+ARIMAX, AR, ARX, EWMA, GARCH/ARGARCH, Holt-Winters, RegressionARIMA — but
+every fit is a batched XLA program over the panel instead of a per-series
+Commons-Math loop.
+"""
+
+from . import ewma
+from .base import TimeSeriesModel
+from .ewma import EWMAModel
+
+__all__ = ["TimeSeriesModel", "ewma", "EWMAModel"]

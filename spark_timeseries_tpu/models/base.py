@@ -1,0 +1,42 @@
+"""Model-tier base contract.
+
+Mirrors the reference's ``TimeSeriesModel`` trait (ref
+``/root/reference/src/main/scala/com/cloudera/sparkts/models/TimeSeriesModel.scala:23-45``)
+— every model can add/remove its time-dependent effects — with two TPU-native
+changes:
+
+- models are **pytrees** (NamedTuples of jax arrays), so a fitted model flows
+  through ``jit``/``vmap``/``pjit`` and serializes trivially;
+- every model is **batched**: parameter fields may carry a leading
+  ``(n_series,)`` dim, in which case the model IS the whole panel's fit and
+  its methods operate on ``(n_series, n_obs)`` arrays in one XLA call.
+  The reference's "one model object per series inside a mapValues closure"
+  becomes "one pytree of stacked parameters".
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+
+class TimeSeriesModel:
+    """Informal interface; concrete models are NamedTuple pytrees."""
+
+    def remove_time_dependent_effects(self, ts: jnp.ndarray) -> jnp.ndarray:
+        """i.i.d.-ify: strip this model's time-dependent structure.
+
+        Inverse of :meth:`add_time_dependent_effects`
+        (ref ``TimeSeriesModel.scala:24-33``)."""
+        raise NotImplementedError
+
+    def add_time_dependent_effects(self, ts: jnp.ndarray) -> jnp.ndarray:
+        """Overlay this model's time-dependent structure on i.i.d. draws
+        (ref ``TimeSeriesModel.scala:35-44``)."""
+        raise NotImplementedError
+
+
+def scalar_or_batch(x: Any) -> jnp.ndarray:
+    """Canonicalize a parameter to a jax array (scalar or ``(batch,)``)."""
+    return jnp.asarray(x)

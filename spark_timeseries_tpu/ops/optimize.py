@@ -1,0 +1,146 @@
+"""Batched smooth optimizers — the TPU replacement for Commons-Math.
+
+The reference drives every model fit through a scalar Commons-Math optimizer,
+one series at a time:
+
+- ``NonLinearConjugateGradientOptimizer`` with hand-derived gradients
+  (ref ``/root/reference/src/main/scala/com/cloudera/sparkts/models/EWMA.scala:45-69``,
+  ``ARIMA.scala:174-200``, ``GARCH.scala:33-53``)
+- ``BOBYQAOptimizer`` for bounded / derivative-free problems
+  (ref ``ARIMA.scala:130-160``, ``HoltWinters.scala:66-83``)
+
+On TPU the whole panel optimizes in lockstep: objectives are written once in
+JAX, gradients come from autodiff (through ``lax.scan`` recurrences), and a
+``vmap`` over the series axis advances every series' parameters inside one
+compiled XLA program.  Heterogeneous convergence across the batch is handled
+by per-series convergence masks — converged lanes simply stop moving while
+the rest iterate (SURVEY.md §7 "hard parts" #2, #3).
+
+Two solvers cover the reference's needs:
+
+- :func:`minimize_bfgs` — smooth unconstrained problems (CGD replacement).
+- :func:`minimize_box` — box-constrained projected gradient with Armijo
+  backtracking (BOBYQA replacement for the bounded fits; the reference's
+  bounded problems — Holt-Winters [0,1]^3, ARIMA css-bobyqa — are smooth, so
+  a projected-gradient method converges to the same optima).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class MinimizeResult(NamedTuple):
+    """Batched optimization artifacts (leading dims ``...`` = batch)."""
+    x: jnp.ndarray          # (..., p) optimal parameters
+    fun: jnp.ndarray        # (...,)   objective at optimum
+    converged: jnp.ndarray  # (...,)   bool per-lane convergence mask
+    n_iter: jnp.ndarray     # (...,)   iterations taken
+
+
+def minimize_bfgs(fn: Callable, x0: jnp.ndarray, *args,
+                  tol: float = 1e-8, max_iter: int = 200) -> MinimizeResult:
+    """Batched BFGS for smooth unconstrained objectives.
+
+    ``fn(params, *args) -> scalar`` where ``params`` is ``(p,)``; ``x0`` may
+    carry leading batch dims, in which case ``args`` entries must carry the
+    same leading dims and the solve is vmapped over them.
+    """
+    from jax.scipy.optimize import minimize as _jsp_minimize
+
+    def solve_one(x0_i, *args_i):
+        res = _jsp_minimize(lambda p: fn(p, *args_i), x0_i, method="BFGS",
+                            tol=tol, options={"maxiter": max_iter})
+        return MinimizeResult(res.x, res.fun, res.success, res.nit)
+
+    batch_dims = x0.ndim - 1
+    for _ in range(batch_dims):
+        solve_one = jax.vmap(solve_one)
+    return solve_one(x0, *args)
+
+
+def _project(x, lower, upper):
+    return jnp.clip(x, lower, upper)
+
+
+class _BoxState(NamedTuple):
+    x: jnp.ndarray
+    f: jnp.ndarray
+    g: jnp.ndarray
+    it: jnp.ndarray
+    done: jnp.ndarray
+
+
+def _minimize_box_one(fn, x0, lower, upper, tol=1e-10, max_iter=500,
+                      max_backtracks=40):
+    """Single-lane projected gradient with Armijo backtracking.
+
+    Designed to be vmapped: under ``vmap`` the ``while_loop`` keeps stepping
+    until every lane's mask is set, and finished lanes hold position — the
+    convergence-mask batching strategy from SURVEY.md §7.
+    """
+    value_and_grad = jax.value_and_grad(fn)
+    f0, g0 = value_and_grad(x0)
+
+    def cond(s: _BoxState):
+        return jnp.logical_and(~s.done, s.it < max_iter)
+
+    def body(s: _BoxState):
+        # Backtracking line search on the projected-gradient arc:
+        # x(t) = P(x - t g); accept when Armijo decrease holds.
+        def bt_cond(carry):
+            t, k, accepted, _, _ = carry
+            return jnp.logical_and(~accepted, k < max_backtracks)
+
+        def bt_body(carry):
+            t, k, _, _, _ = carry
+            x_new = _project(s.x - t * s.g, lower, upper)
+            f_new = fn(x_new)
+            decrease = jnp.dot(s.g, s.x - x_new)
+            ok = f_new <= s.f - 1e-4 * decrease
+            ok = jnp.logical_and(ok, jnp.isfinite(f_new))
+            return (t * 0.5, k + 1, ok, x_new, f_new)
+
+        init = (jnp.asarray(1.0, s.x.dtype), 0, False, s.x, s.f)
+        _, _, accepted, x_new, f_new = lax.while_loop(bt_cond, bt_body, init)
+
+        # converged if the projected-gradient step is tiny, the objective
+        # stalls, or no Armijo step was found (local minimum to tolerance)
+        step_norm = jnp.max(jnp.abs(x_new - s.x))
+        f_stall = jnp.abs(f_new - s.f) <= tol * (jnp.abs(s.f) + tol)
+        done = jnp.logical_or(step_norm <= tol,
+                              jnp.logical_or(f_stall, ~accepted))
+        x_next = jnp.where(accepted, x_new, s.x)
+        f_next = jnp.where(accepted, f_new, s.f)
+        g_next = jax.grad(fn)(x_next)
+        return _BoxState(x_next, f_next, g_next, s.it + 1, done)
+
+    x0 = _project(x0, lower, upper)
+    final = lax.while_loop(
+        cond, body, _BoxState(x0, f0, g0, jnp.asarray(0), jnp.asarray(False)))
+    return MinimizeResult(final.x, final.f, final.done, final.it)
+
+
+def minimize_box(fn: Callable, x0: jnp.ndarray, lower, upper, *args,
+                 tol: float = 1e-10, max_iter: int = 500) -> MinimizeResult:
+    """Batched box-constrained minimization (the BOBYQA replacement).
+
+    ``fn(params, *args) -> scalar``; ``x0 (..., p)``; ``lower``/``upper``
+    broadcastable to ``(p,)``.  Leading dims of ``x0`` (and of each ``args``
+    entry) are vmapped.
+    """
+    lower = jnp.broadcast_to(jnp.asarray(lower, x0.dtype), x0.shape[-1:])
+    upper = jnp.broadcast_to(jnp.asarray(upper, x0.dtype), x0.shape[-1:])
+
+    def solve_one(x0_i, *args_i):
+        return _minimize_box_one(lambda p: fn(p, *args_i), x0_i, lower, upper,
+                                 tol=tol, max_iter=max_iter)
+
+    batch_dims = x0.ndim - 1
+    for _ in range(batch_dims):
+        solve_one = jax.vmap(solve_one)
+    return solve_one(x0, *args)

@@ -1,0 +1,95 @@
+"""EWMA model tests.
+
+Contract: reference ``EWMASuite``
+(/root/reference/src/test/scala/com/cloudera/sparkts/models/EWMASuite.scala:22-66)
+plus batched-panel properties the reference cannot express.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu.models import ewma
+from spark_timeseries_tpu.models.ewma import EWMAModel
+
+
+class TestAddRemoveEffects:
+    # ref EWMASuite.scala:22-40
+    def test_adding_time_dependent_effects(self):
+        orig = jnp.arange(1.0, 11.0)
+
+        m1 = EWMAModel(jnp.asarray(0.2))
+        s1 = m1.add_time_dependent_effects(orig)
+        assert s1[0] == orig[0]
+        assert s1[1] == pytest.approx(0.2 * orig[1] + 0.8 * s1[0])
+        assert round(float(s1[-1]), 2) == 6.54
+
+        m2 = EWMAModel(jnp.asarray(0.6))
+        s2 = m2.add_time_dependent_effects(orig)
+        assert s2[0] == orig[0]
+        assert s2[1] == pytest.approx(0.6 * orig[1] + 0.4 * s2[0])
+        assert round(float(s2[-1]), 2) == 9.33
+
+    # ref EWMASuite.scala:42-52
+    def test_removing_time_dependent_effects(self):
+        smoothed = jnp.asarray(
+            [1.0, 1.2, 1.56, 2.05, 2.64, 3.31, 4.05, 4.84, 5.67, 6.54])
+        m1 = EWMAModel(jnp.asarray(0.2))
+        orig1 = m1.remove_time_dependent_effects(smoothed)
+        assert round(float(orig1[0]), 2) == 1.0
+        assert int(orig1[-1]) == 10
+
+    def test_add_remove_roundtrip(self):
+        rng = np.random.default_rng(42)
+        x = jnp.asarray(rng.normal(size=50))
+        m = EWMAModel(jnp.asarray(0.37))
+        np.testing.assert_allclose(
+            m.remove_time_dependent_effects(m.add_time_dependent_effects(x)),
+            x, atol=1e-9)
+
+    def test_batched_matches_per_series(self):
+        rng = np.random.default_rng(0)
+        xs = jnp.asarray(rng.normal(size=(5, 30)))
+        alphas = jnp.asarray([0.1, 0.3, 0.5, 0.7, 0.9])
+        batched = EWMAModel(alphas).add_time_dependent_effects(xs)
+        for i in range(5):
+            one = EWMAModel(alphas[i]).add_time_dependent_effects(xs[i])
+            np.testing.assert_allclose(batched[i], one, atol=1e-12)
+
+
+OIL = jnp.asarray([446.7, 454.5, 455.7, 423.6, 456.3, 440.6, 425.3, 485.1,
+                   506.0, 526.8, 514.3, 494.2])
+
+
+class TestFit:
+    # ref EWMASuite.scala:54-62 — fpp ch 7.1 oil example, alpha ~ 0.89
+    def test_fitting_ewma_model(self):
+        model = ewma.fit(OIL)
+        assert int(float(model.smoothing) * 100.0) == 89
+
+    def test_batched_fit_matches_single(self):
+        rng = np.random.default_rng(7)
+        noise = rng.normal(scale=5.0, size=(4, OIL.shape[0]))
+        panel_vals = jnp.asarray(np.asarray(OIL)[None, :] + noise)
+        batched = ewma.fit(panel_vals)
+        assert batched.smoothing.shape == (4,)
+        for i in range(4):
+            single = ewma.fit(panel_vals[i])
+            assert float(batched.smoothing[i]) == pytest.approx(
+                float(single.smoothing), abs=1e-4)
+
+    def test_fit_panel_on_mesh(self, mesh):
+        """Sharded panel fit — the mapValues(fitModel) equivalent runs SPMD."""
+        from spark_timeseries_tpu.panel import Panel
+        from spark_timeseries_tpu.time import UniformDateTimeIndex
+        from spark_timeseries_tpu.time.frequency import DayFrequency
+
+        rng = np.random.default_rng(3)
+        n_series, n = 16, 64
+        vals = rng.normal(size=(n_series, n)).cumsum(axis=1) + 100.0
+        idx = UniformDateTimeIndex("2020-01-01T00:00Z", n, DayFrequency(1))
+        p = Panel(idx, jnp.asarray(vals), [f"s{i}" for i in range(n_series)])
+        p = p.shard(mesh)
+        model = ewma.fit_panel(p)
+        assert model.smoothing.shape == (n_series,)
+        assert bool(jnp.all(jnp.isfinite(model.smoothing)))
