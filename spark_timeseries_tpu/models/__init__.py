@@ -7,8 +7,12 @@ every fit is a batched XLA program over the panel instead of a per-series
 Commons-Math loop.
 """
 
-from . import ewma
+from . import autoregression, autoregression_x, ewma
+from .autoregression import ARModel
+from .autoregression_x import ARXModel
 from .base import TimeSeriesModel
 from .ewma import EWMAModel
 
-__all__ = ["TimeSeriesModel", "ewma", "EWMAModel"]
+__all__ = ["TimeSeriesModel", "ewma", "EWMAModel",
+           "autoregression", "ARModel",
+           "autoregression_x", "ARXModel"]

@@ -1,0 +1,85 @@
+"""ARX: autoregression with exogenous regressors, batched.
+
+Capability parity with the reference's ``AutoregressionX``
+(ref ``/root/reference/src/main/scala/com/cloudera/sparkts/models/AutoregressionX.scala:27-131``):
+OLS on ``[lagged y ‖ lagged X ‖ current X]`` with the reference's column
+ordering and trimming conventions.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from ..ops.lag import lag_matrix, lag_matrix_multi
+from ..ops.linalg import ols
+
+
+def _empty_cols(x: jnp.ndarray, rows: int) -> jnp.ndarray:
+    return jnp.zeros((*x.shape[:-1], rows, 0), x.dtype)
+
+
+def assemble_predictors(y: jnp.ndarray, x: jnp.ndarray, y_max_lag: int,
+                        x_max_lag: int,
+                        include_original_x: bool = True) -> jnp.ndarray:
+    """Design matrix ``(..., n - maxLag, cols)`` in the reference's column
+    order: AR lags of y, per-column lags of x, then current x
+    (ref ``AutoregressionX.scala:71-92``)."""
+    n = y.shape[-1]
+    max_lag = max(y_max_lag, x_max_lag)
+    rows = n - max_lag
+
+    if y_max_lag > 0:
+        ar_y = lag_matrix(y, y_max_lag)[..., max_lag - y_max_lag:, :]
+    else:
+        ar_y = _empty_cols(y, rows)
+
+    if x_max_lag > 0:
+        lagged_x = lag_matrix_multi(x, x_max_lag)[..., max_lag - x_max_lag:, :]
+    else:
+        lagged_x = _empty_cols(y, rows)
+
+    parts = [ar_y, lagged_x]
+    if include_original_x:
+        parts.append(x[..., max_lag:, :])
+    return jnp.concatenate(parts, axis=-1)
+
+
+class ARXModel(NamedTuple):
+    """Coefficient order matches the reference (ref
+    ``AutoregressionX.scala:100-111``): y lags ascending, then per-x-column
+    lags ascending, then non-lagged x columns."""
+    c: jnp.ndarray
+    coefficients: jnp.ndarray
+    y_max_lag: int
+    x_max_lag: int
+    includes_original_x: bool
+
+    def predict(self, y: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+        """(ref ``AutoregressionX.scala:117-130``) — one batched matvec."""
+        predictors = assemble_predictors(y, x, self.y_max_lag, self.x_max_lag,
+                                         self.includes_original_x)
+        out = jnp.einsum("...nk,...k->...n", predictors,
+                         jnp.asarray(self.coefficients))
+        c = jnp.asarray(self.c)
+        return out + (c[..., None] if c.ndim else c)
+
+
+def fit(y: jnp.ndarray, x: jnp.ndarray, y_max_lag: int, x_max_lag: int,
+        include_original_x: bool = True, no_intercept: bool = False) -> ARXModel:
+    """OLS fit (ref ``AutoregressionX.scala:48-68``).  ``y (..., n)``,
+    ``x (..., n, k)``; leading dims batch through one QR solve."""
+    y = jnp.asarray(y)
+    x = jnp.asarray(x)
+    max_lag = max(y_max_lag, x_max_lag)
+    trim_y = y[..., max_lag:]
+    predictors = assemble_predictors(y, x, y_max_lag, x_max_lag,
+                                     include_original_x)
+    res = ols(predictors, trim_y, add_intercept=not no_intercept)
+    if no_intercept:
+        c = jnp.zeros(y.shape[:-1], y.dtype)
+        coeffs = res.beta
+    else:
+        c, coeffs = res.beta[..., 0], res.beta[..., 1:]
+    return ARXModel(c, coeffs, y_max_lag, x_max_lag, include_original_x)

@@ -1,0 +1,83 @@
+"""AR model tests.
+
+Contract: reference ``AutoregressionSuite``
+(/root/reference/src/test/scala/com/cloudera/sparkts/models/AutoregressionSuite.scala)
+plus batched-panel properties.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_timeseries_tpu.models import autoregression as ar
+from spark_timeseries_tpu.models.autoregression import ARModel
+
+
+class TestFit:
+    # ref AutoregressionSuite "fit AR(1) model"
+    def test_fit_ar1(self):
+        model = ARModel(jnp.asarray(1.5), jnp.asarray([0.2]))
+        ts = model.sample(5000, jax.random.PRNGKey(11))
+        fitted = ar.fit(ts, 1)
+        assert fitted.coefficients.shape == (1,)
+        assert abs(float(fitted.c) - 1.5) < 0.07
+        assert abs(float(fitted.coefficients[0]) - 0.2) < 0.03
+
+    # ref AutoregressionSuite "fit AR(2) model"
+    def test_fit_ar2(self):
+        model = ARModel(jnp.asarray(1.5), jnp.asarray([0.2, 0.3]))
+        ts = model.sample(5000, jax.random.PRNGKey(11))
+        fitted = ar.fit(ts, 2)
+        assert fitted.coefficients.shape == (2,)
+        assert abs(float(fitted.c) - 1.5) < 0.15
+        assert abs(float(fitted.coefficients[0]) - 0.2) < 0.03
+        assert abs(float(fitted.coefficients[1]) - 0.3) < 0.03
+
+    def test_no_intercept(self):
+        model = ARModel(jnp.asarray(0.0), jnp.asarray([0.5]))
+        ts = model.sample(5000, jax.random.PRNGKey(0))
+        fitted = ar.fit(ts, 1, no_intercept=True)
+        assert float(fitted.c) == 0.0
+        assert abs(float(fitted.coefficients[0]) - 0.5) < 0.03
+
+    def test_batched_fit_matches_single(self):
+        model = ARModel(jnp.asarray([1.5, -0.5, 0.0]),
+                        jnp.asarray([[0.2, 0.3], [0.4, -0.2], [0.6, 0.1]]))
+        ts = model.sample(2000, jax.random.PRNGKey(1), shape=(3,))
+        batched = ar.fit(ts, 2)
+        assert batched.coefficients.shape == (3, 2)
+        for i in range(3):
+            single = ar.fit(ts[i], 2)
+            np.testing.assert_allclose(batched.c[i], single.c, rtol=1e-8)
+            np.testing.assert_allclose(batched.coefficients[i],
+                                       single.coefficients, rtol=1e-8)
+
+
+class TestEffects:
+    # ref AutoregressionSuite "add and remove time dependent effects"
+    def test_add_remove_roundtrip(self):
+        rng = np.random.default_rng(5)
+        ts = jnp.asarray(rng.random(1000))
+        model = ARModel(jnp.asarray(1.5), jnp.asarray([0.2, 0.3]))
+        added = model.add_time_dependent_effects(ts)
+        removed = model.remove_time_dependent_effects(added)
+        np.testing.assert_allclose(removed, ts, atol=1e-3)
+
+    def test_early_terms_dropped(self):
+        """out[0] has no AR terms; out[1] only lag-1 — matches reference's
+        i-j-1 >= 0 guard (Autoregression.scala:66-71)."""
+        ts = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+        m = ARModel(jnp.asarray(10.0), jnp.asarray([0.5, 0.25]))
+        rem = m.remove_time_dependent_effects(ts)
+        assert float(rem[0]) == 1.0 - 10.0
+        assert float(rem[1]) == 2.0 - 10.0 - 0.5 * 1.0
+        assert float(rem[2]) == 3.0 - 10.0 - 0.5 * 2.0 - 0.25 * 1.0
+
+    def test_batched_effects(self):
+        rng = np.random.default_rng(2)
+        ts = jnp.asarray(rng.random((4, 100)))
+        model = ARModel(jnp.asarray([0.1, 0.2, 0.3, 0.4]),
+                        jnp.asarray([[0.2], [0.3], [0.4], [0.5]]))
+        added = model.add_time_dependent_effects(ts)
+        removed = model.remove_time_dependent_effects(added)
+        np.testing.assert_allclose(removed, ts, atol=1e-8)

@@ -1,0 +1,132 @@
+"""ARX model tests.
+
+Contract: reference ``AutoregressionXSuite``
+(/root/reference/src/test/scala/com/cloudera/sparkts/models/AutoregressionXSuite.scala):
+exact-recovery OLS fits at 1e-4 tolerance under every (yMaxLag, xMaxLag,
+includeOriginalX) configuration tested there.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu.models import autoregression_x as arx
+
+
+N_ROWS, N_COLS = 1000, 2
+RNG = np.random.default_rng(10)
+X = RNG.standard_normal((N_ROWS, N_COLS))
+INTERCEPT = float(RNG.standard_normal() * 10)
+
+
+def _lag_trim(x: np.ndarray, max_lag: int) -> np.ndarray:
+    """numpy lag matrix, lags ascending per column block (oracle)."""
+    cols = []
+    for col in range(x.shape[1]):
+        for lag in range(1, max_lag + 1):
+            cols.append(x[max_lag - lag:x.shape[0] - lag, col])
+    # reorder to reference layout: per original column, lags ascending
+    return np.stack(cols, axis=1)
+
+
+class TestFit:
+    # ref "fit ARX(1, 0, true)"
+    def test_arx_1_0_with_original(self):
+        x_coeffs = np.array([0.8, 0.2])
+        raw_y = X @ x_coeffs + INTERCEPT
+        ar_coeff = 0.4
+        y = np.zeros(N_ROWS)
+        prior = 0.0
+        for i in range(N_ROWS):
+            prior = raw_y[i] + prior * ar_coeff
+            y[i] = prior
+        model = arx.fit(jnp.asarray(y), jnp.asarray(X), 1, 0,
+                        include_original_x=True)
+        expected = [ar_coeff, *x_coeffs]
+        assert float(model.c) == pytest.approx(INTERCEPT, abs=1e-4)
+        for i, e in enumerate(expected):
+            assert float(model.coefficients[i]) == pytest.approx(e, abs=1e-4)
+
+    # ref "fit ARX(0, 1, false)"
+    def test_arx_0_1_no_original(self):
+        x_coeffs = np.array([0.4, 0.15])
+        x_lagged = _lag_trim(X, 1)
+        y = np.concatenate([[0.0], x_lagged @ x_coeffs + INTERCEPT])
+        model = arx.fit(jnp.asarray(y), jnp.asarray(X), 0, 1,
+                        include_original_x=False)
+        assert float(model.c) == pytest.approx(INTERCEPT, abs=1e-4)
+        for i, e in enumerate(x_coeffs):
+            assert float(model.coefficients[i]) == pytest.approx(e, abs=1e-4)
+
+    # ref "fit ARX(0, 0, true)" — plain regression
+    def test_arx_0_0_plain_regression(self):
+        x_coeffs = np.array([0.8, 0.2])
+        y = X @ x_coeffs + INTERCEPT
+        model = arx.fit(jnp.asarray(y), jnp.asarray(X), 0, 0,
+                        include_original_x=True)
+        assert float(model.c) == pytest.approx(INTERCEPT, abs=1e-4)
+        for i, e in enumerate(x_coeffs):
+            assert float(model.coefficients[i]) == pytest.approx(e, abs=1e-4)
+
+    # ref "fit ARX(0, 2, true)"
+    def test_arx_0_2_with_original(self):
+        x_lag_coeffs = np.array([0.4, 0.15, 0.2, 0.7])
+        x_lagged = _lag_trim(X, 2)
+        y_lagged_part = x_lagged @ x_lag_coeffs
+        x_normal_coeffs = np.array([0.3, 0.5])
+        y_normal_part = X @ x_normal_coeffs
+        y = np.concatenate(
+            [[0.0, 0.0], y_lagged_part + y_normal_part[2:] + INTERCEPT])
+        model = arx.fit(jnp.asarray(y), jnp.asarray(X), 0, 2,
+                        include_original_x=True)
+        expected = [*x_lag_coeffs, *x_normal_coeffs]
+        assert float(model.c) == pytest.approx(INTERCEPT, abs=1e-4)
+        for i, e in enumerate(expected):
+            assert float(model.coefficients[i]) == pytest.approx(e, abs=1e-4)
+
+    # ref "fit ARX(1, 1, false)"
+    def test_arx_1_1_no_original(self):
+        x_coeffs = np.array([0.8, 0.2])
+        x_lagged = _lag_trim(X, 1)
+        raw_y = x_lagged @ x_coeffs + INTERCEPT
+        ar_coeff = 0.4
+        y_tail = np.zeros(N_ROWS - 1)
+        prior = 0.0
+        for i in range(N_ROWS - 1):
+            prior = raw_y[i] + prior * ar_coeff
+            y_tail[i] = prior
+        y = np.concatenate([[0.0], y_tail])
+        model = arx.fit(jnp.asarray(y), jnp.asarray(X), 1, 1,
+                        include_original_x=False)
+        expected = [ar_coeff, *x_coeffs]
+        assert float(model.c) == pytest.approx(INTERCEPT, abs=1e-4)
+        for i, e in enumerate(expected):
+            assert float(model.coefficients[i]) == pytest.approx(e, abs=1e-4)
+
+
+class TestPredict:
+    # ref "predict using ARX model"
+    def test_predict(self):
+        x_coeffs = jnp.asarray(
+            [-1.136026484226831e-08, 8.637677568908233e-07,
+             15238.143039368977, -7.993535860373772e-09,
+             -5.198597570089805e-07, 1.5691547009557947e-08,
+             7.409621376205488e-08])
+        model = arx.ARXModel(jnp.asarray(0.0), x_coeffs, 0, 0, True)
+        y = jnp.asarray([100.0])
+        x = jnp.asarray([[465, 1, 0.006562479, 24, 1, 0, 51]], dtype=jnp.float64)
+        results = model.predict(y, x)
+        expected = float(jnp.dot(x[0], x_coeffs))
+        assert float(results[0]) == pytest.approx(expected, rel=1e-10)
+
+    def test_batched_fit_matches_single(self):
+        rng = np.random.default_rng(3)
+        xb = rng.standard_normal((3, 200, 2))
+        yb = np.einsum("bnk,k->bn", xb, np.array([0.5, -0.3])) + 2.0
+        yb += 0.01 * rng.standard_normal(yb.shape)
+        batched = arx.fit(jnp.asarray(yb), jnp.asarray(xb), 1, 1)
+        for i in range(3):
+            single = arx.fit(jnp.asarray(yb[i]), jnp.asarray(xb[i]), 1, 1)
+            np.testing.assert_allclose(batched.c[i], single.c, rtol=1e-8)
+            np.testing.assert_allclose(batched.coefficients[i],
+                                       single.coefficients, rtol=1e-8)
