@@ -7,7 +7,9 @@ every fit is a batched XLA program over the panel instead of a per-series
 Commons-Math loop.
 """
 
-from . import autoregression, autoregression_x, ewma
+from . import arima, arimax, autoregression, autoregression_x, ewma
+from .arima import ARIMAModel
+from .arimax import ARIMAXModel
 from .autoregression import ARModel
 from .autoregression_x import ARXModel
 from .base import TimeSeriesModel
@@ -15,4 +17,5 @@ from .ewma import EWMAModel
 
 __all__ = ["TimeSeriesModel", "ewma", "EWMAModel",
            "autoregression", "ARModel",
-           "autoregression_x", "ARXModel"]
+           "autoregression_x", "ARXModel",
+           "arima", "ARIMAModel", "arimax", "ARIMAXModel"]

@@ -1,0 +1,280 @@
+"""ARIMAX(p, d, q) — ARIMA with exogenous regressors, batched.
+
+Capability parity with the reference's ``ARIMAX``
+(ref ``/root/reference/src/main/scala/com/cloudera/sparkts/models/ARIMAX.scala:34-613``):
+``Y_t = beta * X_t + ARIMA`` with per-column exogenous lags up to
+``xreg_max_lag`` (optionally including the non-lagged values), initialization
+from an ARX fit plus Hannan-Rissanen MA estimates, CSS-CGD refinement of the
+ARMA part, and forecasting with d-order integration unwinding.
+
+Coefficient layout (ref ``ARIMAX.scala:177-186``): slot 0 the intercept
+(zero when fit without one — the reference keeps the slot too, cf. its
+coefficient-count assertions in ``ARIMAXSuite.scala:118,127``), then AR terms,
+MA terms, and for each exogenous column its lagged terms in increasing lag
+order, then the non-lagged columns.
+
+Like the reference, the CSS objective treats the series as a pure ARMA — the
+exogenous coefficients stay frozen at their ARX estimates during refinement
+(the reference's CSS gradient is identically zero in the xreg slots,
+``ARIMAX.scala:304-371``, so its CGD never moves them either).
+
+Deviations from the reference (intended semantics where its code is
+inconsistent):
+
+- the exogenous impact is the full dot product of the assembled lagged-xreg
+  predictor row with the xreg coefficients — the reference's accumulation
+  loop overwrites instead of summing and cycles its coefficient index
+  (``ARIMAX.scala:512-527``);
+- exogenous columns are differenced independently — the reference differences
+  the column-major flattened matrix, bleeding values across column boundaries
+  (``ARIMAX.scala:100-104``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..ops.univariate import (differences_of_order_d,
+                              inverse_differences_of_order_d)
+from . import autoregression_x
+from .arima import (_add_effects_one, _batched, _log_likelihood_css_arma,
+                    _one_step_errors, _remove_effects_one,
+                    hannan_rissanen_init)
+from ..ops.optimize import minimize_bfgs, minimize_box
+
+
+class ARIMAXModel(NamedTuple):
+    """ARIMAX(p, d, q) with ``xreg_max_lag`` exogenous lags per column
+    (ref ``ARIMAX.scala:190-198``)."""
+    p: int
+    d: int
+    q: int
+    xreg_max_lag: int
+    coefficients: jnp.ndarray
+    include_original_xreg: bool = True
+    has_intercept: bool = True
+
+    @property
+    def _n_arma(self) -> int:
+        return 1 + self.p + self.q
+
+    @property
+    def arma_coefficients(self) -> jnp.ndarray:
+        """``[c, AR..., MA...]`` — the slice the CSS likelihood sees."""
+        return jnp.asarray(self.coefficients)[..., :self._n_arma]
+
+    @property
+    def xreg_coefficients(self) -> jnp.ndarray:
+        return jnp.asarray(self.coefficients)[..., self._n_arma:]
+
+    # -- likelihood (pure ARMA, ref ARIMAX.scala:267-289) -------------------
+
+    def log_likelihood_css_arma(self, diffed: jnp.ndarray) -> jnp.ndarray:
+        return _batched(
+            lambda prm, y: _log_likelihood_css_arma(prm, y, self.p, self.q, 1),
+            self.arma_coefficients, jnp.asarray(diffed))
+
+    def gradient_log_likelihood_css_arma(self, diffed: jnp.ndarray) -> jnp.ndarray:
+        """Gradient w.r.t. the full coefficient vector; identically zero in
+        the frozen xreg slots (matches ref ``ARIMAX.scala:304-371``)."""
+        g = _batched(
+            jax.grad(lambda prm, y: _log_likelihood_css_arma(
+                prm, y, self.p, self.q, 1)),
+            self.arma_coefficients, jnp.asarray(diffed))
+        pad = jnp.zeros_like(self.xreg_coefficients)
+        return jnp.concatenate([g, pad], axis=-1)
+
+    # -- effects (pure ARMA, ref ARIMAX.scala:566-613) ----------------------
+
+    def remove_time_dependent_effects(self, ts: jnp.ndarray) -> jnp.ndarray:
+        return _batched(
+            lambda prm, y: _remove_effects_one(
+                prm, y, self.p, self.d, self.q, 1),
+            self.arma_coefficients, jnp.asarray(ts))
+
+    def add_time_dependent_effects(self, ts: jnp.ndarray) -> jnp.ndarray:
+        return _batched(
+            lambda prm, y: _add_effects_one(
+                prm, y, self.p, self.d, self.q, 1),
+            self.arma_coefficients, jnp.asarray(ts))
+
+    # -- forecasting --------------------------------------------------------
+
+    def difference_xreg(self, xreg: jnp.ndarray) -> jnp.ndarray:
+        """Order-d difference each exogenous column independently, drop the
+        first ``d`` rows, and left-pad ``max(p, q)`` zero rows
+        (ref ``ARIMAX.scala:543-557``; see module docstring for the
+        column-independence deviation).  ``xreg (..., r, k)``."""
+        cols = jnp.moveaxis(jnp.asarray(xreg), -1, -2)          # (..., k, r)
+        diffed = differences_of_order_d(cols, self.d)[..., self.d:]
+        max_lag = max(self.p, self.q)
+        pad = [(0, 0)] * (diffed.ndim - 1) + [(max_lag, 0)]
+        return jnp.moveaxis(jnp.pad(diffed, pad), -1, -2)
+
+    def forecast(self, ts: jnp.ndarray, xreg: jnp.ndarray) -> jnp.ndarray:
+        """Forecast one value per ``xreg`` row (ref ``ARIMAX.scala:200-257``,
+        which returns ``results.drop(nFuture)``).
+
+        ``ts (n,)`` is the observed history; ``xreg (n_future, k)`` holds the
+        exogenous values for the forecast window.  The ARMA recurrence runs on
+        the differenced history exactly as ARIMA's forecast does; each future
+        step adds the exogenous impact of its (differenced, lagged) xreg row;
+        the result is integrated back through the last ``d`` observations.
+        """
+        ts = jnp.asarray(ts)
+        xreg = jnp.asarray(xreg)
+        if ts.ndim > 1 or jnp.asarray(self.coefficients).ndim > 1:
+            return _batched(
+                lambda prm, y: self._forecast_one(prm, y, xreg),
+                jnp.asarray(self.coefficients), ts)
+        return self._forecast_one(jnp.asarray(self.coefficients), ts, xreg)
+
+    def _forecast_one(self, params: jnp.ndarray, ts: jnp.ndarray,
+                      xreg: jnp.ndarray) -> jnp.ndarray:
+        p, d, q = self.p, self.d, self.q
+        c = params[0]
+        phi = params[1:1 + p]
+        theta = params[1 + p:1 + p + q]
+        bx = params[1 + p + q:]
+        max_lag = max(p, q)
+        n_future = xreg.shape[-2]
+
+        diffed = differences_of_order_d(ts, d)[d:]
+        ext = jnp.concatenate([jnp.full((max_lag,), c, ts.dtype), diffed])
+
+        # history: one-step-ahead ARMA fits -> final MA error buffer
+        yhat, err = _one_step_errors(params[:1 + p + q], ext, p, q, 1)
+        hist = jnp.concatenate([jnp.zeros((max_lag,), ts.dtype), yhat])
+
+        errs0 = (ext - hist)[::-1][:q] if q > 0 else jnp.zeros((0,), ts.dtype)
+        recent0 = hist[::-1][:p] if p > 0 else jnp.zeros((0,), ts.dtype)
+
+        # exogenous impact per future step: lags of the differenced window
+        # (values before the window start are zero) ‖ current values
+        dx = self.difference_xreg(xreg)                  # (max_lag+nf-d, k)
+        k = xreg.shape[-1]
+        lags = []
+        for lag in range(1, self.xreg_max_lag + 1):
+            shifted = jnp.roll(dx, lag, axis=-2).at[:lag, :].set(0.0) \
+                if lag <= dx.shape[-2] else jnp.zeros_like(dx)
+            lags.append(shifted)
+        # reference column order: per column, its lags ascending; then the
+        # non-lagged columns (ARIMAX.scala:183-186)
+        parts = []
+        for col in range(k):
+            for lag_arr in lags:
+                parts.append(lag_arr[..., col])
+        if self.include_original_xreg:
+            for col in range(k):
+                parts.append(dx[..., col])
+        predictors = (jnp.stack(parts, axis=-1) if parts
+                      else jnp.zeros((dx.shape[-2], 0), ts.dtype))
+        impact = (predictors @ bx)[-n_future + d:] if n_future > d \
+            else jnp.zeros((0,), ts.dtype)
+        impact = jnp.concatenate(
+            [jnp.zeros((n_future - impact.shape[-1],), ts.dtype), impact]) \
+            if impact.shape[-1] < n_future else impact
+
+        def fwd_step(carry, imp):
+            recent, errs = carry
+            out = c + phi @ recent + theta @ errs + imp
+            if p > 0:
+                recent = jnp.concatenate([out[None], recent[:-1]])
+            if q > 0:
+                errs = jnp.concatenate([jnp.zeros((1,), ts.dtype), errs[:-1]])
+            return (recent, errs), out
+
+        (_, _), fwd = lax.scan(fwd_step, (recent0, errs0), impact)
+
+        if d == 0:
+            return fwd
+        # seeds = diagonal of the incremental-differences matrix: the i-th
+        # order difference at index n-d+i (ref ARIMA.scala:755-758)
+        n = ts.shape[-1]
+        rows = [ts]
+        for i in range(1, d):
+            prev = rows[i - 1]
+            rows.append(jnp.concatenate(
+                [jnp.zeros((i,), ts.dtype),
+                 differences_of_order_d(prev[i:], 1)]))
+        prev_terms = jnp.stack([rows[i][n - d + i] for i in range(d)])
+        integrated = inverse_differences_of_order_d(
+            jnp.concatenate([prev_terms, fwd]), d)
+        return integrated[d:]
+
+
+def fit(p: int, d: int, q: int, ts: jnp.ndarray, xreg: jnp.ndarray,
+        xreg_max_lag: int, include_original_xreg: bool = True,
+        include_intercept: bool = True,
+        user_init_params: Optional[jnp.ndarray] = None,
+        method: str = "css-cgd") -> ARIMAXModel:
+    """Fit an ARIMAX(p, d, q) (ref ``ARIMAX.scala:61-90``): initialize the
+    ARX part by OLS on [y lags ‖ xreg lags ‖ xreg] (with the xreg columns
+    differenced to order d, ref ``ARIMAX.scala:92-112``), the MA part by
+    Hannan-Rissanen, then refine the ARMA slice by batched CSS maximum
+    likelihood with the xreg coefficients frozen.
+
+    ``ts (..., n)``; ``xreg (n, k)`` (or batched ``(..., n, k)``).
+    """
+    ts = jnp.asarray(ts)
+    xreg = jnp.asarray(xreg)
+    diffed = differences_of_order_d(ts, d)[..., d:]
+    icpt = 1 if include_intercept else 0
+
+    if user_init_params is not None:
+        init_full = jnp.asarray(user_init_params, ts.dtype)
+        c0 = init_full[..., :1]
+        ar0 = init_full[..., 1:1 + p]
+        ma0 = init_full[..., 1 + p:1 + p + q]
+        bx = init_full[..., 1 + p + q:]
+    else:
+        # ARX on the raw series with differenced xreg (ref ARIMAX.scala:92-112)
+        cols = jnp.moveaxis(xreg, -1, -2)
+        dx = jnp.moveaxis(differences_of_order_d(cols, d), -1, -2)
+        arx = autoregression_x.fit(ts, dx, p, xreg_max_lag,
+                                   include_original_xreg,
+                                   no_intercept=not include_intercept)
+        c0 = jnp.asarray(arx.c)[..., None] if include_intercept \
+            else jnp.zeros((*ts.shape[:-1], 1), ts.dtype)
+        ar0 = arx.coefficients[..., :p]
+        bx = arx.coefficients[..., p:]
+        if q > 0:
+            ma0 = hannan_rissanen_init(p, q, diffed,
+                                       include_intercept)[..., -q:]
+        else:
+            ma0 = jnp.zeros((*ts.shape[:-1], 0), ts.dtype)
+
+    # refine [c?, AR, MA] by CSS; xreg slots stay frozen
+    if include_intercept:
+        init = jnp.concatenate([c0, ar0, ma0], axis=-1)
+    else:
+        init = jnp.concatenate([ar0, ma0], axis=-1)
+
+    if init.shape[-1] > 0:
+        def neg_ll(prm, y):
+            return -_log_likelihood_css_arma(prm, y, p, q, icpt)
+
+        if method == "css-cgd":
+            res = minimize_bfgs(neg_ll, init, diffed, tol=1e-7, max_iter=500)
+        elif method == "css-bobyqa":
+            res = minimize_box(neg_ll, init, -jnp.inf, jnp.inf, diffed,
+                               tol=1e-10, max_iter=500)
+        else:
+            raise ValueError(f"unknown method {method!r}")
+        lane_ok = jnp.all(jnp.isfinite(res.x), axis=-1, keepdims=True)
+        refined = jnp.where(lane_ok, res.x, init)
+    else:
+        refined = init
+
+    if include_intercept:
+        full = jnp.concatenate([refined, bx], axis=-1)
+    else:
+        zero_c = jnp.zeros((*ts.shape[:-1], 1), ts.dtype)
+        full = jnp.concatenate([zero_c, refined, bx], axis=-1)
+    return ARIMAXModel(p, d, q, xreg_max_lag, full, include_original_xreg,
+                       include_intercept)

@@ -1,0 +1,221 @@
+"""ARIMA tier tests — contracts mirror the reference's ``ARIMASuite``
+(ref /root/reference/src/test/scala/com/cloudera/sparkts/models/ARIMASuite.scala),
+with seeded sample→refit property tests replacing the R CSV fixtures (same
+philosophy: recover known generating parameters within tolerance)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu.models import arima
+from spark_timeseries_tpu.ops.univariate import (
+    differences_of_order_d, inverse_differences_of_order_d)
+
+
+def test_sample_then_fit_recovers_parameters():
+    # ref ARIMASuite.scala:43-56 — ARIMA(2,1,2), intercept 8.2
+    model = arima.ARIMAModel(2, 1, 2, jnp.array([8.2, 0.2, 0.5, 0.3, 0.1]))
+    sampled = model.sample(1000, jax.random.PRNGKey(10))
+    refit = arima.fit(2, 1, 2, sampled)
+    c, ar1, ar2, ma1, ma2 = np.asarray(refit.coefficients)
+    assert abs(ar1 - 0.2) < 0.1
+    assert abs(ar2 - 0.5) < 0.1
+    assert abs(ma1 - 0.3) < 0.1
+    assert abs(ma2 - 0.1) < 0.1
+    # the intercept itself is ill-conditioned against AR estimation error;
+    # the well-conditioned invariant is the implied mean c / (1 - Σφ)
+    implied_mean = c / (1.0 - ar1 - ar2)
+    assert abs(implied_mean - 8.2 / (1.0 - 0.2 - 0.5)) < 1.0
+
+
+def test_cgd_and_bobyqa_analogs_agree():
+    # ref ARIMASuite.scala:58-74
+    model = arima.ARIMAModel(2, 1, 2, jnp.array([8.2, 0.2, 0.5, 0.3, 0.1]))
+    sampled = model.sample(1000, jax.random.PRNGKey(10))
+    a = np.asarray(arima.fit(2, 1, 2, sampled, method="css-cgd").coefficients)
+    b = np.asarray(
+        arima.fit(2, 1, 2, sampled, method="css-bobyqa").coefficients)
+    assert abs(a[0] - b[0]) < 1.0
+    np.testing.assert_allclose(a[1:], b[1:], atol=0.1)
+
+
+def test_arima_p1q_equals_differenced_arma():
+    # ref ARIMASuite.scala:76-97
+    model = arima.ARIMAModel(1, 1, 2, jnp.array([0.3, 0.7, 0.1]),
+                             has_intercept=False)
+    sampled = model.sample(1000, jax.random.PRNGKey(0))
+    arima_fit = arima.fit(1, 1, 2, sampled, include_intercept=False)
+    diffed = differences_of_order_d(sampled, 1)[1:]
+    arma_fit = arima.fit(1, 0, 2, diffed, include_intercept=False)
+
+    got = np.asarray(arima_fit.coefficients)
+    # the CSS-ML estimate for this seed (verified against scipy BFGS from
+    # both the HR init and the true parameters) sits ~0.2 from the truth —
+    # ARMA(1,2) near-cancellation makes recovery high-variance
+    np.testing.assert_allclose(got, [0.3, 0.7, 0.1], atol=0.25)
+    # identical inputs -> identical solve
+    np.testing.assert_allclose(got, np.asarray(arma_fit.coefficients),
+                               atol=1e-9)
+
+
+def test_add_then_remove_effects_round_trip():
+    # ref ARIMASuite.scala:99-112
+    model = arima.ARIMAModel(1, 1, 2, jnp.array([8.3, 0.1, 0.2, 0.3]))
+    noise = jax.random.normal(jax.random.PRNGKey(20), (100,))
+    process = model.add_time_dependent_effects(noise)
+    recovered = model.remove_time_dependent_effects(process)
+    np.testing.assert_allclose(np.asarray(recovered), np.asarray(noise),
+                               atol=1e-4)
+
+
+def test_arima_000_with_intercept_fits_mean():
+    # ref ARIMASuite.scala:114-120
+    sampled = jax.random.normal(jax.random.PRNGKey(10), (100,))
+    model = arima.fit(0, 0, 0, sampled)
+    mean = float(jnp.mean(sampled))
+    assert abs(float(model.coefficients[0]) - mean) < 1e-4
+
+
+def test_arima_000_forecast_is_mean():
+    # ref ARIMASuite.scala:122-131
+    sampled = jax.random.normal(jax.random.PRNGKey(10), (100,))
+    model = arima.fit(0, 0, 0, sampled)
+    mean = float(jnp.mean(sampled))
+    forecast = np.asarray(model.forecast(sampled, 10))
+    assert forecast.shape == (110,)
+    np.testing.assert_allclose(forecast[100:], mean, atol=1e-4)
+
+
+def test_integrated_order_3_fit():
+    # ref ARIMASuite.scala:133-156 — ARIMA(0,3,1) with theta=0.2; the R CSV
+    # fixture is replaced by a seeded sample from the same process
+    gen = arima.ARIMAModel(0, 3, 1, jnp.array([0.0, 0.2]))
+    data = gen.sample(500, jax.random.PRNGKey(7))
+    model = arima.fit(0, 3, 1, data)
+    c, ma = np.asarray(model.coefficients)
+    # R's own CSS fit on the reference fixture deviated 0.052 from the truth
+    # (ARIMASuite.scala:139-149); allow the same order of estimation noise
+    assert abs(ma - 0.2) < 0.08
+
+
+def test_stationarity_and_invertibility_checks():
+    # ref ARIMASuite.scala:158-180
+    m1 = arima.ARIMAModel(1, 0, 0, jnp.array([0.2, 1.5]))
+    assert not m1.is_stationary()
+    assert m1.is_invertible()
+
+    m2 = arima.ARIMAModel(0, 0, 1, jnp.array([0.13, 1.8]))
+    assert m2.is_stationary()
+    assert not m2.is_invertible()
+
+    m3 = arima.ARIMAModel(2, 0, 0, jnp.array([0.003359, 1.545, -0.5646]))
+    assert m3.is_stationary()
+    assert m3.is_invertible()
+
+    m4 = arima.ARIMAModel(1, 0, 1,
+                          jnp.array([-0.09341, 0.857361, -0.300821]))
+    assert m4.is_stationary()
+    assert m4.is_invertible()
+
+
+def test_find_roots_easy():
+    # ref ARIMASuite.scala:215 — root of 1 - 0.4x is 2.5
+    roots = arima.find_roots([1.0, -0.4])
+    assert abs(abs(roots[0]) - 2.5) < 1e-9
+
+
+def test_find_roots_harder():
+    # ref ARIMASuite.scala:217-223 — R polyroot comparison
+    roots = arima.find_roots([1, 0.5, -0.3, 1.9, -3.0, 0.5])
+    got = sorted(np.round(np.abs(roots), 5))
+    expected = sorted([0.77959, 0.55383, 0.77959, 1.12229, 5.29438])
+    np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+def test_auto_fit():
+    # ref ARIMASuite.scala:182-213
+    model1 = arima.ARIMAModel(2, 0, 0, jnp.array([2.5, 0.4, 0.3]))
+    sampled = model1.sample(250, jax.random.PRNGKey(10))
+
+    high_i = inverse_differences_of_order_d(sampled, 5)
+    with pytest.raises(ValueError):
+        arima.auto_fit(high_i)
+    # works when the differencing-order limit is raised
+    arima.auto_fit(high_i, max_d=10, max_p=2, max_q=2)
+
+    fitted = arima.auto_fit(sampled, max_p=5, max_q=5)
+    just_intercept = arima.fit(0, fitted.d, 0, sampled)
+    assert float(just_intercept.approx_aic(sampled)) \
+        > float(fitted.approx_aic(sampled))
+
+
+def test_gradient_matches_finite_differences():
+    # the autodiff gradient replaces the reference's hand-derived recursion
+    # (ref ARIMA.scala:465-534); verify against central differences
+    model = arima.ARIMAModel(2, 0, 2, jnp.array([8.2, 0.2, 0.5, 0.3, 0.1]))
+    y = np.asarray(model.sample(300, jax.random.PRNGKey(3)))
+    params = np.array([8.0, 0.25, 0.45, 0.25, 0.15])
+    grad = np.asarray(arima.ARIMAModel(
+        2, 0, 2, jnp.array(params)).gradient_log_likelihood_css_arma(y))
+    eps = 1e-6
+    for j in range(params.size):
+        up, dn = params.copy(), params.copy()
+        up[j] += eps
+        dn[j] -= eps
+        fd = (float(arima.ARIMAModel(2, 0, 2, jnp.array(up))
+                    .log_likelihood_css_arma(y))
+              - float(arima.ARIMAModel(2, 0, 2, jnp.array(dn))
+                      .log_likelihood_css_arma(y))) / (2 * eps)
+        assert abs(grad[j] - fd) < 1e-3 * max(1.0, abs(fd))
+
+
+def test_forecast_with_differencing_tracks_series():
+    # d-order integration unwinding (ref ARIMA.scala:731-763): fitted
+    # historicals should track an integrated series closely
+    gen = arima.ARIMAModel(1, 1, 0, jnp.array([0.5, 0.4]))
+    ts = gen.sample(300, jax.random.PRNGKey(5))
+    model = arima.fit(1, 1, 0, ts)
+    out = np.asarray(model.forecast(ts, 5))
+    assert out.shape == (305,)
+    assert np.all(np.isfinite(out))
+    ts_np = np.asarray(ts)
+    # 1-step-ahead errors over the interior should look like the innovations
+    errs = ts_np[10:290] - out[10:290]
+    assert np.std(errs) < 3.0
+
+
+def test_batched_panel_fit():
+    # one batched solve over a panel == per-series fits (TPU design goal)
+    key = jax.random.PRNGKey(42)
+    model = arima.ARIMAModel(1, 0, 1, jnp.array([4.0, 0.45, 0.3]))
+    panel = model.sample(400, key, shape=(6,))
+    fitted = arima.fit(1, 0, 1, panel)
+    assert fitted.coefficients.shape == (6, 3)
+    for i in range(6):
+        single = arima.fit(1, 0, 1, panel[i])
+        np.testing.assert_allclose(np.asarray(fitted.coefficients[i]),
+                                   np.asarray(single.coefficients),
+                                   rtol=1e-4, atol=1e-4)
+    # batched AIC / likelihood shapes
+    assert fitted.approx_aic(panel).shape == (6,)
+
+
+def test_auto_fit_panel():
+    key = jax.random.PRNGKey(10)
+    m_ar = arima.ARIMAModel(2, 0, 0, jnp.array([2.5, 0.4, 0.3]))
+    m_i1 = arima.ARIMAModel(1, 1, 0, jnp.array([0.1, 0.5]))
+    panel = jnp.stack([
+        m_ar.sample(250, jax.random.fold_in(key, 0)),
+        m_ar.sample(250, jax.random.fold_in(key, 1)),
+        m_i1.sample(250, jax.random.fold_in(key, 2)),
+    ])
+    res = arima.auto_fit_panel(panel, max_p=3, max_d=2, max_q=2)
+    assert res.orders.shape == (3, 3)
+    assert np.all(np.isfinite(res.aic))
+    # the integrated series should need differencing; the AR(2) ones none
+    assert res.orders[2, 1] >= 1
+    assert res.orders[0, 1] == 0
+    # each winner must beat the intercept-only candidate it was compared to
+    m0 = res.model_for(0)
+    assert m0.p + m0.q > 0

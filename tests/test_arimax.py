@@ -1,0 +1,100 @@
+"""ARIMAX tests — contracts mirror the reference's ``ARIMAXSuite``
+(ref /root/reference/src/test/scala/com/cloudera/sparkts/models/ARIMAXSuite.scala):
+coefficient-vector lengths for each configuration, and forecasts that stay in
+a sane band around the hold-out mean.  The Hyndman CSV fixtures are replaced
+by a seeded synthetic panel with a known exogenous effect."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu.models import arimax
+
+
+def _make_data(key, n=120, n_future=16, k=2, d=0):
+    """ts driven by xreg plus AR(1) noise; returns train ts, train xreg,
+    future xreg, future actuals."""
+    keys = jax.random.split(key, 4)
+    total = n + n_future
+    xreg = jnp.stack(
+        [10.0 + jax.random.normal(keys[0], (total,)),
+         5.0 * jax.random.bernoulli(keys[1], 0.3, (total,)).astype(jnp.float64)]
+        [:k], axis=-1)
+    noise = jax.random.normal(keys[2], (total,))
+    ar = [0.0]
+    for t in range(1, total):
+        ar.append(0.5 * ar[-1] + float(noise[t]))
+    base = 50.0 + xreg @ jnp.array([2.0, -1.0][:k]) + jnp.array(ar)
+    if d > 0:
+        base = jnp.cumsum(base)
+    return base[:n], xreg[:n], xreg[n:], base[n:]
+
+
+@pytest.mark.parametrize("p,d,q,icpt,expected_len", [
+    (0, 0, 1, True, 6),    # ref ARIMAXSuite "MAX(0,0,1)": 1 + 0+1 + 2*(1+1)
+    (2, 1, 1, False, 8),   # ref "ARIMAX(2,1,1) ... false": slot-0 kept
+    (1, 1, 1, True, 7),
+])
+def test_coefficient_lengths(p, d, q, icpt, expected_len):
+    ts, xreg, _, _ = _make_data(jax.random.PRNGKey(1), d=min(d, 1))
+    model = arimax.fit(p, d, q, ts, xreg, xreg_max_lag=1,
+                       include_intercept=icpt)
+    assert model.coefficients.shape == (expected_len,)
+    assert np.all(np.isfinite(np.asarray(model.coefficients)))
+
+
+def test_forecast_in_band():
+    # ref ARIMAXSuite forecast contract: one prediction per xreg row, all
+    # within a band around the hold-out mean
+    ts, xreg, xreg_f, actual = _make_data(jax.random.PRNGKey(3))
+    model = arimax.fit(0, 0, 1, ts, xreg, xreg_max_lag=1)
+    pred = np.asarray(model.forecast(ts, xreg_f))
+    assert pred.shape == (xreg_f.shape[0],)
+    avg = float(jnp.mean(actual))
+    spread = float(jnp.max(jnp.abs(actual - avg)))
+    assert np.all(np.abs(pred - avg) < 2 * spread + 5.0)
+
+
+def test_forecast_with_differencing():
+    ts, xreg, xreg_f, actual = _make_data(jax.random.PRNGKey(5), d=1)
+    model = arimax.fit(1, 1, 1, ts, xreg, xreg_max_lag=1)
+    pred = np.asarray(model.forecast(ts, xreg_f))
+    assert pred.shape == (xreg_f.shape[0],)
+    assert np.all(np.isfinite(pred))
+    # integrated forecasts must continue from the end of the series, not
+    # collapse to the differenced scale
+    assert abs(pred[0] - float(ts[-1])) < abs(float(ts[-1])) * 0.5 + 100.0
+
+
+def test_xreg_effect_recovered():
+    # the ARX initialization should pick up the known exogenous effect
+    ts, xreg, _, _ = _make_data(jax.random.PRNGKey(7))
+    model = arimax.fit(1, 0, 0, ts, xreg, xreg_max_lag=1)
+    bx = np.asarray(model.xreg_coefficients)
+    # layout: col0 lag1, col1 lag1, col0 current, col1 current
+    assert bx.shape == (4,)
+    # current-value coefficients should reflect beta = [2, -1] direction
+    assert bx[2] > 0.5
+    assert bx[3] < -0.2
+
+
+def test_add_remove_effects_round_trip():
+    model = arimax.ARIMAXModel(
+        1, 0, 1, 1, jnp.array([3.0, 0.4, 0.25, 0.5, 0.5]))
+    noise = jax.random.normal(jax.random.PRNGKey(11), (80,))
+    out = model.add_time_dependent_effects(noise)
+    back = model.remove_time_dependent_effects(out)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(noise), atol=1e-6)
+
+
+def test_gradient_zero_in_xreg_slots():
+    # ref ARIMAX.scala:304-371 — CSS gradient never touches xreg slots
+    model = arimax.ARIMAXModel(
+        1, 0, 1, 1, jnp.array([3.0, 0.4, 0.25, 0.5, 0.5]))
+    y = np.asarray(model.add_time_dependent_effects(
+        jax.random.normal(jax.random.PRNGKey(2), (100,))))
+    g = np.asarray(model.gradient_log_likelihood_css_arma(y))
+    assert g.shape == (5,)
+    np.testing.assert_array_equal(g[3:], 0.0)
+    assert np.any(g[:3] != 0.0)
