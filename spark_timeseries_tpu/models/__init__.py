@@ -7,15 +7,22 @@ every fit is a batched XLA program over the panel instead of a per-series
 Commons-Math loop.
 """
 
-from . import arima, arimax, autoregression, autoregression_x, ewma
+from . import (arima, arimax, autoregression, autoregression_x, ewma, garch,
+               holt_winters, regression_arima)
 from .arima import ARIMAModel
 from .arimax import ARIMAXModel
 from .autoregression import ARModel
 from .autoregression_x import ARXModel
 from .base import TimeSeriesModel
 from .ewma import EWMAModel
+from .garch import ARGARCHModel, EGARCHModel, GARCHModel
+from .holt_winters import HoltWintersModel
+from .regression_arima import RegressionARIMAModel
 
 __all__ = ["TimeSeriesModel", "ewma", "EWMAModel",
            "autoregression", "ARModel",
            "autoregression_x", "ARXModel",
-           "arima", "ARIMAModel", "arimax", "ARIMAXModel"]
+           "arima", "ARIMAModel", "arimax", "ARIMAXModel",
+           "garch", "GARCHModel", "ARGARCHModel", "EGARCHModel",
+           "holt_winters", "HoltWintersModel",
+           "regression_arima", "RegressionARIMAModel"]

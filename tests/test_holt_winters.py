@@ -1,0 +1,84 @@
+"""Holt-Winters tests — contracts and R `stats::HoltWinters` oracle values
+mirror the reference's ``HoltWintersModelSuite``
+(ref /root/reference/src/test/scala/com/cloudera/sparkts/models/HoltWintersModelSuite.scala)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu.models import holt_winters as hw
+from r_datasets import AIR_PASSENGERS, CO2
+
+R_ADDITIVE_FORECAST = np.array([
+    453.4977, 429.3906, 467.0361, 503.2574, 512.3395, 571.8880,
+    652.6095, 637.4623, 539.7548, 490.7250, 424.4593, 469.5315])
+
+R_MULT_FORECAST = np.array([
+    365.1079, 365.9664, 366.7343, 368.1364, 368.6674, 367.9508,
+    366.5318, 364.3799, 362.4731, 362.7520, 364.2203, 365.6741])
+
+
+def test_additive_optimal_parameters():
+    # ref HoltWintersModelSuite.scala:44-52: R gives (0.24796, 0.03453, 1.0)
+    model = hw.fit(jnp.asarray(AIR_PASSENGERS), 12, "additive")
+    assert abs(float(model.alpha) - 0.24796) < 0.01
+    assert abs(float(model.beta) - 0.03453) < 0.01
+    assert abs(float(model.gamma) - 1.0) < 0.01
+
+
+def test_additive_forecast():
+    # ref HoltWintersModelSuite.scala:54-77 (tolerance ±10 vs R forecast)
+    model = hw.fit(jnp.asarray(AIR_PASSENGERS), 12, "additive")
+    fc = np.asarray(model.forecast(jnp.asarray(AIR_PASSENGERS), 12))
+    np.testing.assert_allclose(fc, R_ADDITIVE_FORECAST, atol=10)
+
+
+def test_multiplicative_optimal_parameters():
+    # ref HoltWintersModelSuite.scala:139-146: R gives (0.51265, 0.00949, 0.47289)
+    model = hw.fit(jnp.asarray(CO2), 12, "multiplicative")
+    assert abs(float(model.alpha) - 0.51265) < 0.01
+    assert abs(float(model.beta) - 0.00949) < 0.01
+    assert abs(float(model.gamma) - 0.47289) < 0.1
+
+
+def test_multiplicative_forecast():
+    # ref HoltWintersModelSuite.scala:148-170 (tolerance ±10 vs R forecast)
+    model = hw.fit(jnp.asarray(CO2), 12, "multiplicative")
+    fc = np.asarray(model.forecast(jnp.asarray(CO2), 12))
+    np.testing.assert_allclose(fc, R_MULT_FORECAST, atol=10)
+
+
+def test_invalid_model_type():
+    with pytest.raises(ValueError):
+        hw.HoltWintersModel("banana", 12, 0.3, 0.1, 0.1).additive
+
+
+def test_remove_effects_unsupported():
+    m = hw.HoltWintersModel("additive", 12, 0.3, 0.1, 0.1)
+    with pytest.raises(NotImplementedError):
+        m.remove_time_dependent_effects(jnp.zeros(24))
+
+
+def test_sse_positive_and_fitted_shape():
+    m = hw.HoltWintersModel("additive", 12,
+                            jnp.asarray(0.3), jnp.asarray(0.1),
+                            jnp.asarray(0.1))
+    fitted = m.add_time_dependent_effects(jnp.asarray(AIR_PASSENGERS))
+    assert fitted.shape == AIR_PASSENGERS.shape
+    # first `period` entries are zero (no prediction available there)
+    np.testing.assert_array_equal(np.asarray(fitted[:12]), 0.0)
+    assert float(m.sse(jnp.asarray(AIR_PASSENGERS))) > 0
+
+
+def test_batched_panel_fit_matches_single():
+    panel = jnp.stack([jnp.asarray(AIR_PASSENGERS),
+                       jnp.asarray(AIR_PASSENGERS) * 1.7 + 3.0])
+    fitted = hw.fit(panel, 12, "additive")
+    assert fitted.alpha.shape == (2,)
+    single = hw.fit(jnp.asarray(AIR_PASSENGERS), 12, "additive")
+    np.testing.assert_allclose(float(fitted.alpha[0]), float(single.alpha),
+                               atol=1e-6)
+    np.testing.assert_allclose(float(fitted.beta[0]), float(single.beta),
+                               atol=1e-6)
+    fc = fitted.forecast(panel, 6)
+    assert fc.shape == (2, 6)

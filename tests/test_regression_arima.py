@@ -1,0 +1,95 @@
+"""RegressionARIMA (Cochrane-Orcutt) tests — same public textbook datasets
+and oracle values as the reference's ``RegressionARIMASuite``
+(ref /root/reference/src/test/scala/com/cloudera/sparkts/models/RegressionARIMASuite.scala;
+data: PSU STAT 501 metal/vendor example and the UCLA Chatterjee-Price stock
+expenditure example)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu.models import regression_arima as ra
+
+METAL = np.array([
+    44.2, 44.3, 44.4, 43.4, 42.8, 44.3, 44.4, 44.8, 44.4, 43.1, 42.6, 42.4,
+    42.2, 41.8, 40.1, 42, 42.4, 43.1, 42.4, 43.1, 43.2, 42.8, 43, 42.8, 42.5,
+    42.6, 42.3, 42.9, 43.6, 44.7, 44.5, 45, 44.8, 44.9, 45.2, 45.2, 45, 45.5,
+    46.2, 46.8, 47.5, 48.3, 48.3, 49.1, 48.9, 49.4, 50, 50, 49.6, 49.9, 49.6,
+    50.7, 50.7, 50.9, 50.5, 51.2, 50.7, 50.3, 49.2, 48.1])
+
+VENDOR = np.array([
+    322.0, 317, 319, 323, 327, 328, 325, 326, 330, 334, 337, 341, 322, 318,
+    320, 326, 332, 334, 335, 336, 335, 338, 342, 348, 330, 326, 329, 337,
+    345, 350, 351, 354, 355, 357, 362, 368, 348, 345, 349, 355, 362, 367,
+    366, 370, 371, 375, 380, 385, 361, 354, 357, 367, 376, 381, 381, 383,
+    384, 387, 392, 396])
+
+EXPENDITURE = np.array([
+    214.6, 217.7, 219.6, 227.2, 230.9, 233.3, 234.1, 232.3, 233.7, 236.5,
+    238.7, 243.2, 249.4, 254.3, 260.9, 263.3, 265.6, 268.2, 270.4, 275.6])
+
+STOCK = np.array([
+    159.3, 161.2, 162.8, 164.6, 165.9, 167.9, 168.3, 169.7, 170.5, 171.6,
+    173.9, 176.1, 178.0, 179.1, 180.2, 181.2, 181.6, 182.5, 183.3, 184.3])
+
+
+def test_cochrane_orcutt_metal_with_max_iter():
+    # ref RegressionARIMASuite.scala:23-42: PSU oracle beta=(28.918, 0.0479)
+    model = ra.fit(jnp.asarray(METAL), jnp.asarray(VENDOR)[:, None],
+                   "cochrane-orcutt", 1)
+    beta = np.asarray(model.regression_coeff)
+    assert abs(beta[0] - 28.918) < 0.01
+    assert abs(beta[1] - 0.0479) < 0.001
+
+
+def test_cochrane_orcutt_stock_data():
+    # ref RegressionARIMASuite.scala:44-63: UCLA oracle rho=0.8241,
+    # beta=(-235.4889, 2.75306)
+    model = ra.fit_cochrane_orcutt(
+        jnp.asarray(EXPENDITURE), jnp.asarray(STOCK)[:, None], 11)
+    beta = np.asarray(model.regression_coeff)
+    rho = float(np.asarray(model.arima_coeff))
+    assert abs(rho - 0.8241) < 0.001
+    assert abs(beta[0] - (-235.4889)) < 0.1
+    assert abs(beta[1] - 2.75306) < 0.001
+
+
+def test_unknown_method():
+    with pytest.raises(NotImplementedError):
+        ra.fit(jnp.asarray(METAL), jnp.asarray(VENDOR)[:, None], "banana")
+
+
+def test_bad_args():
+    with pytest.raises(ValueError):
+        ra.fit(jnp.asarray(METAL), jnp.asarray(VENDOR)[:, None],
+               "cochrane-orcutt", "not-an-int")
+    with pytest.raises(ValueError):
+        ra.fit(jnp.asarray(METAL), jnp.asarray(VENDOR)[:, None],
+               "cochrane-orcutt", 1, 2)
+    with pytest.raises(ValueError):
+        ra.fit_cochrane_orcutt(jnp.asarray(METAL),
+                               jnp.asarray(VENDOR)[:10, None])
+
+
+def test_effects_unsupported():
+    model = ra.RegressionARIMAModel(jnp.zeros(2), (1, 0, 0), jnp.zeros(1))
+    with pytest.raises(NotImplementedError):
+        model.add_time_dependent_effects(jnp.zeros(10))
+    with pytest.raises(NotImplementedError):
+        model.remove_time_dependent_effects(jnp.zeros(10))
+
+
+def test_batched_matches_single():
+    panel = jnp.stack([jnp.asarray(EXPENDITURE),
+                       jnp.asarray(EXPENDITURE) * 1.1 + 2.0])
+    model = ra.fit_cochrane_orcutt(panel, jnp.asarray(STOCK)[:, None], 11)
+    assert model.regression_coeff.shape == (2, 2)
+    assert model.arima_coeff.shape == (2,)
+    single = ra.fit_cochrane_orcutt(
+        jnp.asarray(EXPENDITURE), jnp.asarray(STOCK)[:, None], 11)
+    np.testing.assert_allclose(np.asarray(model.regression_coeff[0]),
+                               np.asarray(single.regression_coeff),
+                               rtol=1e-10)
+    np.testing.assert_allclose(float(model.arima_coeff[0]),
+                               float(np.asarray(single.arima_coeff)),
+                               rtol=1e-10)
