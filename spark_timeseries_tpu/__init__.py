@@ -11,8 +11,8 @@ loops replaced by vmapped, XLA-compiled kernels.
 
 __version__ = "0.1.0"
 
-from . import ops, time  # noqa: F401
+from . import io, models, ops, parallel, stats, time, utils  # noqa: F401
 from .panel import Panel, lagged_pair_key, lagged_string_key  # noqa: F401
 
-__all__ = ["ops", "time", "Panel", "lagged_pair_key", "lagged_string_key",
-           "__version__"]
+__all__ = ["io", "models", "ops", "parallel", "stats", "time", "utils",
+           "Panel", "lagged_pair_key", "lagged_string_key", "__version__"]

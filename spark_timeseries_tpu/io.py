@@ -1,0 +1,130 @@
+"""Persistence and parsers: CSV / Parquet panels with index sidecars.
+
+Capability parity with the reference's persistence tier
+(ref ``/root/reference/src/main/scala/com/cloudera/sparkts/TimeSeriesRDD.scala:498-551,747-780``)
+and ``YahooParser``
+(ref ``/root/reference/src/main/scala/com/cloudera/sparkts/parsers/YahooParser.scala:24-49``).
+
+File contracts match the reference so datasets interchange:
+
+- **CSV**: a directory holding ``data.csv`` with one ``key,v0,v1,...`` line
+  per series (the reference's ``saveAsCsv`` text-file rows) and a
+  ``timeIndex`` sidecar holding ``DateTimeIndex`` string form
+  (ref ``TimeSeriesRDD.scala:498-509``; sidecar name ``:504``).
+- **Parquet**: a long-format observations table (timestamp, key, value —
+  the reference's ``toObservationsDataFrame`` layout,
+  ``TimeSeriesRDD.scala:419-443``) at ``<path>``, with the index string in a
+  ``<path>.idx`` sidecar (ref ``TimeSeriesRDD.scala:526-551``).
+
+There is no Kryo tier: sharded ``jax.Array``s are already bytes
+(SURVEY.md §5 "distributed communication backend").
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .panel import Panel
+from .time import index as dtindex
+
+CSV_DATA_FILE = "data.csv"
+CSV_INDEX_FILE = "timeIndex"   # same sidecar name as the reference
+
+
+# ---------------------------------------------------------------------------
+# CSV (ref TimeSeriesRDD.scala:498-509 save, :750-764 load)
+# ---------------------------------------------------------------------------
+
+def save_csv(panel: Panel, path: str) -> None:
+    """Write ``path/data.csv`` (one ``key,v0,v1,...`` row per series) and the
+    ``path/timeIndex`` sidecar."""
+    os.makedirs(path, exist_ok=True)
+    values = np.asarray(panel.values)
+    with open(os.path.join(path, CSV_DATA_FILE), "w") as f:
+        for key, row in zip(panel.keys, values):
+            f.write(str(key) + ","
+                    + ",".join(repr(float(v)) for v in row) + "\n")
+    with open(os.path.join(path, CSV_INDEX_FILE), "w") as f:
+        f.write(panel.index.to_string())
+
+
+def load_csv(path: str) -> Panel:
+    """Inverse of :func:`save_csv` (ref ``timeSeriesRDDFromCsv``)."""
+    with open(os.path.join(path, CSV_INDEX_FILE)) as f:
+        index = dtindex.from_string(f.read().strip())
+    keys, rows = [], []
+    with open(os.path.join(path, CSV_DATA_FILE)) as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if not line:
+                continue
+            tokens = line.split(",")
+            keys.append(tokens[0])
+            rows.append([float(t) for t in tokens[1:]])
+    return Panel(index, jnp.asarray(np.asarray(rows, dtype=np.float64)), keys)
+
+
+# ---------------------------------------------------------------------------
+# Parquet (ref TimeSeriesRDD.scala:526-551 save, :769-780 load)
+# ---------------------------------------------------------------------------
+
+def save_parquet(panel: Panel, path: str,
+                 ts_col: str = "timestamp", key_col: str = "key",
+                 value_col: str = "value") -> None:
+    """Write the observations DataFrame to parquet plus the ``<path>.idx``
+    index sidecar."""
+    df = panel.to_observations_dataframe(ts_col, key_col, value_col)
+    df.to_parquet(path, index=False)
+    with open(path + ".idx", "w") as f:
+        f.write(panel.index.to_string())
+
+
+def load_parquet(path: str, ts_col: str = "timestamp", key_col: str = "key",
+                 value_col: str = "value") -> Panel:
+    """Inverse of :func:`save_parquet`
+    (ref ``timeSeriesRDDFromParquet``)."""
+    import pandas as pd
+    with open(path + ".idx") as f:
+        index = dtindex.from_string(f.read().strip())
+    df = pd.read_parquet(path)
+    return Panel.from_observations(df, index, ts_col, key_col, value_col)
+
+
+# ---------------------------------------------------------------------------
+# Yahoo finance CSV (ref parsers/YahooParser.scala:24-49)
+# ---------------------------------------------------------------------------
+
+def yahoo_string_to_panel(text: str, key_prefix: str = "",
+                          zone: Optional[str] = None) -> Panel:
+    """Parse Yahoo-finance CSV text (``Date,Open,High,...`` header, rows
+    newest-first) into a panel keyed ``<prefix><column>``
+    (ref ``YahooParser.scala:25-38``: labels from the header tail, rows
+    reversed into chronological order, dates at start of day)."""
+    import pandas as pd
+    lines = [ln for ln in text.strip().split("\n") if ln]
+    labels = [key_prefix + c for c in lines[0].split(",")[1:]]
+    dates, rows = [], []
+    for line in lines[1:]:
+        tokens = line.split(",")
+        dates.append(tokens[0])
+        rows.append([float(t) for t in tokens[1:]])
+    order = np.argsort(np.asarray(dates))        # chronological
+    nanos = pd.DatetimeIndex(np.asarray(dates)[order]).as_unit("ns") \
+        .asi8.astype(np.int64)
+    data = np.asarray(rows, dtype=np.float64)[order].T   # (n_cols, n_obs)
+    index = dtindex.irregular(nanos, zone)
+    return Panel(index, jnp.asarray(data), labels)
+
+
+def yahoo_file_to_panel(path: str, key_prefix: Optional[str] = None,
+                        zone: Optional[str] = None) -> Panel:
+    """Parse one Yahoo CSV file; the default key prefix is the file name
+    (ref ``YahooParser.scala:40-48``)."""
+    if key_prefix is None:
+        key_prefix = os.path.basename(path)
+    with open(path) as f:
+        return yahoo_string_to_panel(f.read(), key_prefix, zone)

@@ -36,7 +36,8 @@ from jax import lax
 
 from ..ops.lag import lag_matrix
 from ..ops.linalg import ols
-from ..ops.optimize import minimize_bfgs, minimize_box
+from ..ops.optimize import (minimize_bfgs, minimize_box,
+                            minimize_least_squares)
 from ..ops.univariate import (differences_of_order_d,
                               inverse_differences_of_order_d)
 from ..stats import kpsstest
@@ -181,6 +182,22 @@ def _add_effects_one(params: jnp.ndarray, ts: jnp.ndarray,
     return inverse_differences_of_order_d(out, d)
 
 
+def _difference_rows(ts: jnp.ndarray, d: int) -> jnp.ndarray:
+    """Rows 0..d-1 of incremental differences; row ``i`` holds the proper
+    i-th order difference from index ``i`` on (zeros before).  Unlike the
+    size-preserving ``differences_of_order_d`` (whose copied first element
+    would leak a raw value into row i at index i — the artifact the
+    reference's ``diffMatrix`` carries into its first re-levelled step,
+    ``ARIMA.scala:735-744``), every retained entry here is a true
+    difference."""
+    rows = [ts]
+    for i in range(1, d):
+        prev = rows[i - 1]
+        rows.append(jnp.concatenate(
+            [jnp.zeros((i,), ts.dtype), prev[i:] - prev[i - 1:-1]]))
+    return jnp.stack(rows)
+
+
 def _forecast_one(params: jnp.ndarray, ts: jnp.ndarray, n_future: int,
                   p: int, d: int, q: int, icpt: int) -> jnp.ndarray:
     """1-step-ahead fitted historicals + ``n_future`` forecast periods
@@ -229,27 +246,20 @@ def _forecast_one(params: jnp.ndarray, ts: jnp.ndarray, n_future: int,
     results = results.at[n:].set(fwd)
 
     if d != 0:
-        # incremental differences of order 0..d (ref ARIMA.scala:735-744):
-        # row i holds, from position i on, the order-1 differences of row i-1
-        rows = [ts]
-        for i in range(1, d + 1):
-            prev = rows[i - 1]
-            row = jnp.concatenate(
-                [jnp.zeros((i,), ts.dtype),
-                 differences_of_order_d(prev[i:], 1)])
-            rows.append(row)
-        diff_matrix = jnp.stack(rows)                       # (d+1, n)
+        # incremental differences of order 0..d-1 (ref ARIMA.scala:735-744,
+        # with proper differences at the boundary — see _difference_rows)
+        diff_matrix = _difference_rows(ts, d)                # (d, n)
 
         # historical 1-step-ahead forecasts for the integrated series
         # (ref ARIMA.scala:747-753)
         i_idx = jnp.arange(d, hist_len - max_lag)
-        level = jnp.sum(diff_matrix[:d, :], axis=0)          # col sums rows<d
+        level = jnp.sum(diff_matrix, axis=0)                 # col sums rows<d
         hist_fit = level[i_idx - 1] + hist[max_lag + i_idx]
         results = results.at[d:hist_len - max_lag].set(hist_fit)
 
         # unwind the forward curve through the last d incremental differences
         # (ref ARIMA.scala:755-763)
-        prev_terms = jnp.diagonal(diff_matrix[:d, n - d:])   # (d,)
+        prev_terms = jnp.diagonal(diff_matrix[:, n - d:])    # (d,)
         fwd_integrated = inverse_differences_of_order_d(
             jnp.concatenate([prev_terms, fwd]), d)
         results = results.at[n - d:].set(fwd_integrated)
@@ -463,16 +473,25 @@ def hannan_rissanen_init(p: int, q: int, y: jnp.ndarray,
 
 
 def fit(p: int, d: int, q: int, ts: jnp.ndarray,
-        include_intercept: bool = True, method: str = "css-cgd",
+        include_intercept: bool = True, method: str = "css-lm",
         user_init_params: Optional[jnp.ndarray] = None,
         warn: bool = True) -> ARIMAModel:
     """Fit an ARIMA(p, d, q) by conditional-sum-of-squares maximum likelihood
     (ref ``ARIMA.scala:79-116``).
 
     ``ts`` may be ``(n,)`` or ``(n_series, n)`` — the whole panel fits in one
-    batched solve.  ``method``: ``"css-cgd"`` (batched BFGS on the autodiff
-    gradient — the conjugate-gradient analog) or ``"css-bobyqa"`` (projected-
-    gradient with backtracking — the derivative-free fallback's role).
+    batched solve.  ``method``:
+
+    - ``"css-lm"`` (default): batched Levenberg-Marquardt on the one-step
+      residuals.  Maximizing the CSS likelihood is exactly minimizing the
+      residual sum of squares (the likelihood is monotone in it,
+      ``ARIMA.scala:430-445``), and LM stays robust in float32 on TPU where
+      a BFGS line search underflows.
+    - ``"css-cgd"``: batched BFGS on the autodiff gradient (the reference's
+      conjugate-gradient analog).
+    - ``"css-bobyqa"``: projected gradient with backtracking (the
+      derivative-free fallback's role).
+
     Matches the reference's AR-only fast path (pure OLS when ``q == 0``).
     """
     ts = jnp.asarray(ts)
@@ -503,7 +522,11 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
     def neg_ll(prm, y):
         return -_log_likelihood_css_arma(prm, y, p, q, icpt)
 
-    if method == "css-cgd":
+    if method == "css-lm":
+        def resid(prm, y):
+            return _one_step_errors(prm, y, p, q, icpt)[1]
+        res = minimize_least_squares(resid, init, diffed, max_iter=100)
+    elif method == "css-cgd":
         res = minimize_bfgs(neg_ll, init, diffed, tol=1e-7, max_iter=500)
     elif method == "css-bobyqa":
         res = minimize_box(neg_ll, init, -jnp.inf, jnp.inf, diffed,
@@ -574,7 +597,7 @@ def auto_fit(ts: jnp.ndarray, max_p: int = 5, max_d: int = 2,
     add_intercept = d <= 1
 
     def try_fit(p, q, intercept):
-        for method in ("css-cgd", "css-bobyqa"):
+        for method in ("css-lm", "css-bobyqa"):
             try:
                 m = fit(p, 0, q, diffed, include_intercept=intercept,
                         method=method, warn=False)

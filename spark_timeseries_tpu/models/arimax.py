@@ -27,7 +27,13 @@ inconsistent):
   (``ARIMAX.scala:512-527``);
 - exogenous columns are differenced independently — the reference differences
   the column-major flattened matrix, bleeding values across column boundaries
-  (``ARIMAX.scala:100-104``).
+  (``ARIMAX.scala:100-104``);
+- the ARMA refinement runs on the **xreg-adjusted** differenced series
+  (``diff_d(y) - bx·X_terms``) rather than the raw one.  The reference's CSS
+  objective ignores the exogenous part entirely, so its intercept drifts
+  toward the series mean (absorbing the exogenous mean) and only its barely-
+  moving CGD keeps forecasts from double-counting the xreg effect; adjusting
+  first makes fit and forecast mutually consistent.
 """
 
 from __future__ import annotations
@@ -39,13 +45,35 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..ops.univariate import (differences_of_order_d,
-                              inverse_differences_of_order_d)
+from ..ops.univariate import differences_of_order_d
 from . import autoregression_x
-from .arima import (_add_effects_one, _batched, _log_likelihood_css_arma,
-                    _one_step_errors, _remove_effects_one,
-                    hannan_rissanen_init)
-from ..ops.optimize import minimize_bfgs, minimize_box
+from .arima import (_add_effects_one, _batched, _difference_rows,
+                    _log_likelihood_css_arma, _one_step_errors,
+                    _remove_effects_one, hannan_rissanen_init)
+from ..ops.optimize import (minimize_bfgs, minimize_box,
+                            minimize_least_squares)
+
+
+def _assemble_xreg_terms(dx: jnp.ndarray, xreg_max_lag: int,
+                         include_original: bool) -> jnp.ndarray:
+    """Assemble ``[per-column lags ascending ‖ current columns]`` rows over a
+    differenced window, zero-filling lags that reach before the window start
+    (reference column order, ``ARIMAX.scala:183-186``).
+    ``dx (..., r, k)`` → ``(..., r, n_xreg_coefs)``."""
+    k = dx.shape[-1]
+    lags = []
+    for lag in range(1, xreg_max_lag + 1):
+        lags.append(jnp.roll(dx, lag, axis=-2).at[..., :lag, :].set(0.0))
+    parts = []
+    for col in range(k):
+        for lag_arr in lags:
+            parts.append(lag_arr[..., col])
+    if include_original:
+        for col in range(k):
+            parts.append(dx[..., col])
+    if not parts:
+        return jnp.zeros((*dx.shape[:-1], 0), dx.dtype)
+    return jnp.stack(parts, axis=-1)
 
 
 class ARIMAXModel(NamedTuple):
@@ -103,28 +131,40 @@ class ARIMAXModel(NamedTuple):
                 prm, y, self.p, self.d, self.q, 1),
             self.arma_coefficients, jnp.asarray(ts))
 
-    # -- forecasting --------------------------------------------------------
+    # -- exogenous terms ----------------------------------------------------
 
     def difference_xreg(self, xreg: jnp.ndarray) -> jnp.ndarray:
-        """Order-d difference each exogenous column independently, drop the
-        first ``d`` rows, and left-pad ``max(p, q)`` zero rows
-        (ref ``ARIMAX.scala:543-557``; see module docstring for the
-        column-independence deviation).  ``xreg (..., r, k)``."""
+        """Order-d difference each exogenous column independently and drop
+        the first ``d`` rows (ref ``ARIMAX.scala:543-557``; see module
+        docstring for the column-independence deviation).
+        ``xreg (..., r, k)`` → ``(..., r - d, k)``."""
         cols = jnp.moveaxis(jnp.asarray(xreg), -1, -2)          # (..., k, r)
         diffed = differences_of_order_d(cols, self.d)[..., self.d:]
-        max_lag = max(self.p, self.q)
-        pad = [(0, 0)] * (diffed.ndim - 1) + [(max_lag, 0)]
-        return jnp.moveaxis(jnp.pad(diffed, pad), -1, -2)
+        return jnp.moveaxis(diffed, -1, -2)
+
+    def _xreg_terms(self, dx: jnp.ndarray) -> jnp.ndarray:
+        return _assemble_xreg_terms(dx, self.xreg_max_lag,
+                                    self.include_original_xreg)
+
+    def xreg_contribution(self, xreg: jnp.ndarray) -> jnp.ndarray:
+        """Exogenous contribution ``bx·X_terms`` on the differenced scale,
+        one value per row of ``diff_d(xreg)``."""
+        dx = self.difference_xreg(jnp.asarray(xreg))
+        return self._xreg_terms(dx) @ self.xreg_coefficients
+
+    # -- forecasting --------------------------------------------------------
 
     def forecast(self, ts: jnp.ndarray, xreg: jnp.ndarray) -> jnp.ndarray:
-        """Forecast one value per ``xreg`` row (ref ``ARIMAX.scala:200-257``,
-        which returns ``results.drop(nFuture)``).
+        """One-step-ahead predictions over a window: ``ts (n,)`` and
+        ``xreg (n, k)`` cover the SAME time span, and the result holds one
+        prediction per observation (the reference's suite calls this with
+        the hold-out series and its exogenous matrix and asserts
+        ``results.length == ts.length``, ref ``ARIMAXSuite.scala:100-106``).
 
-        ``ts (n,)`` is the observed history; ``xreg (n_future, k)`` holds the
-        exogenous values for the forecast window.  The ARMA recurrence runs on
-        the differenced history exactly as ARIMA's forecast does; each future
-        step adds the exogenous impact of its (differenced, lagged) xreg row;
-        the result is integrated back through the last ``d`` observations.
+        On the differenced scale: ``ŷ_t = ARMA 1-step fit of the adjusted
+        series + bx·X_terms_t``; for ``d > 0`` the prediction is re-levelled
+        through the lower-order differences at ``t-1`` (the ARIMA
+        integration unwinding, ref ``ARIMA.scala:747-753``).
         """
         ts = jnp.asarray(ts)
         xreg = jnp.asarray(xreg)
@@ -138,81 +178,33 @@ class ARIMAXModel(NamedTuple):
                       xreg: jnp.ndarray) -> jnp.ndarray:
         p, d, q = self.p, self.d, self.q
         c = params[0]
-        phi = params[1:1 + p]
-        theta = params[1 + p:1 + p + q]
-        bx = params[1 + p + q:]
         max_lag = max(p, q)
-        n_future = xreg.shape[-2]
+        n = ts.shape[-1]
 
-        diffed = differences_of_order_d(ts, d)[d:]
-        ext = jnp.concatenate([jnp.full((max_lag,), c, ts.dtype), diffed])
+        dy = differences_of_order_d(ts, d)[d:]
+        dx = self.difference_xreg(xreg)
+        g = self._xreg_terms(dx) @ params[1 + p + q:]
+        adjusted = dy - g
 
-        # history: one-step-ahead ARMA fits -> final MA error buffer
-        yhat, err = _one_step_errors(params[:1 + p + q], ext, p, q, 1)
+        ext = jnp.concatenate([jnp.full((max_lag,), c, ts.dtype), adjusted])
+        yhat, _ = _one_step_errors(params[:1 + p + q], ext, p, q, 1)
         hist = jnp.concatenate([jnp.zeros((max_lag,), ts.dtype), yhat])
-
-        errs0 = (ext - hist)[::-1][:q] if q > 0 else jnp.zeros((0,), ts.dtype)
-        recent0 = hist[::-1][:p] if p > 0 else jnp.zeros((0,), ts.dtype)
-
-        # exogenous impact per future step: lags of the differenced window
-        # (values before the window start are zero) ‖ current values
-        dx = self.difference_xreg(xreg)                  # (max_lag+nf-d, k)
-        k = xreg.shape[-1]
-        lags = []
-        for lag in range(1, self.xreg_max_lag + 1):
-            shifted = jnp.roll(dx, lag, axis=-2).at[:lag, :].set(0.0) \
-                if lag <= dx.shape[-2] else jnp.zeros_like(dx)
-            lags.append(shifted)
-        # reference column order: per column, its lags ascending; then the
-        # non-lagged columns (ARIMAX.scala:183-186)
-        parts = []
-        for col in range(k):
-            for lag_arr in lags:
-                parts.append(lag_arr[..., col])
-        if self.include_original_xreg:
-            for col in range(k):
-                parts.append(dx[..., col])
-        predictors = (jnp.stack(parts, axis=-1) if parts
-                      else jnp.zeros((dx.shape[-2], 0), ts.dtype))
-        impact = (predictors @ bx)[-n_future + d:] if n_future > d \
-            else jnp.zeros((0,), ts.dtype)
-        impact = jnp.concatenate(
-            [jnp.zeros((n_future - impact.shape[-1],), ts.dtype), impact]) \
-            if impact.shape[-1] < n_future else impact
-
-        def fwd_step(carry, imp):
-            recent, errs = carry
-            out = c + phi @ recent + theta @ errs + imp
-            if p > 0:
-                recent = jnp.concatenate([out[None], recent[:-1]])
-            if q > 0:
-                errs = jnp.concatenate([jnp.zeros((1,), ts.dtype), errs[:-1]])
-            return (recent, errs), out
-
-        (_, _), fwd = lax.scan(fwd_step, (recent0, errs0), impact)
+        pred_diff = hist[max_lag:] + g          # 1-step preds of dy
 
         if d == 0:
-            return fwd
-        # seeds = diagonal of the incremental-differences matrix: the i-th
-        # order difference at index n-d+i (ref ARIMA.scala:755-758)
-        n = ts.shape[-1]
-        rows = [ts]
-        for i in range(1, d):
-            prev = rows[i - 1]
-            rows.append(jnp.concatenate(
-                [jnp.zeros((i,), ts.dtype),
-                 differences_of_order_d(prev[i:], 1)]))
-        prev_terms = jnp.stack([rows[i][n - d + i] for i in range(d)])
-        integrated = inverse_differences_of_order_d(
-            jnp.concatenate([prev_terms, fwd]), d)
-        return integrated[d:]
+            return pred_diff
+        # re-level: ŷ_t = Σ_{i<d} diff_i(y)_{t-1} + pred of diff_d(y)_t
+        level = jnp.sum(_difference_rows(ts, d), axis=0)    # Σ_{i<d} diff_i
+        t_idx = jnp.arange(d, n)
+        preds = level[t_idx - 1] + pred_diff[t_idx - d]
+        return jnp.concatenate([ts[:d], preds])
 
 
 def fit(p: int, d: int, q: int, ts: jnp.ndarray, xreg: jnp.ndarray,
         xreg_max_lag: int, include_original_xreg: bool = True,
         include_intercept: bool = True,
         user_init_params: Optional[jnp.ndarray] = None,
-        method: str = "css-cgd") -> ARIMAXModel:
+        method: str = "css-lm") -> ARIMAXModel:
     """Fit an ARIMAX(p, d, q) (ref ``ARIMAX.scala:61-90``): initialize the
     ARX part by OLS on [y lags ‖ xreg lags ‖ xreg] (with the xreg columns
     differenced to order d, ref ``ARIMAX.scala:92-112``), the MA part by
@@ -224,6 +216,12 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray, xreg: jnp.ndarray,
     ts = jnp.asarray(ts)
     xreg = jnp.asarray(xreg)
     diffed = differences_of_order_d(ts, d)[..., d:]
+    # size-preserving per-column differencing once; the dropped-d view feeds
+    # the terms assembly, the full-length view the ARX init
+    dx_full = jnp.moveaxis(
+        differences_of_order_d(jnp.moveaxis(xreg, -1, -2), d), -1, -2)
+    dxreg = dx_full[..., d:, :]
+    terms = _assemble_xreg_terms(dxreg, xreg_max_lag, include_original_xreg)
     icpt = 1 if include_intercept else 0
 
     if user_init_params is not None:
@@ -234,9 +232,7 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray, xreg: jnp.ndarray,
         bx = init_full[..., 1 + p + q:]
     else:
         # ARX on the raw series with differenced xreg (ref ARIMAX.scala:92-112)
-        cols = jnp.moveaxis(xreg, -1, -2)
-        dx = jnp.moveaxis(differences_of_order_d(cols, d), -1, -2)
-        arx = autoregression_x.fit(ts, dx, p, xreg_max_lag,
+        arx = autoregression_x.fit(ts, dx_full, p, xreg_max_lag,
                                    include_original_xreg,
                                    no_intercept=not include_intercept)
         c0 = jnp.asarray(arx.c)[..., None] if include_intercept \
@@ -249,7 +245,9 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray, xreg: jnp.ndarray,
         else:
             ma0 = jnp.zeros((*ts.shape[:-1], 0), ts.dtype)
 
-    # refine [c?, AR, MA] by CSS; xreg slots stay frozen
+    # refine [c?, AR, MA] by CSS on the xreg-adjusted series (see module
+    # docstring); xreg slots stay frozen at their ARX estimates
+    adjusted = diffed - jnp.einsum("...nm,...m->...n", terms, bx)
     if include_intercept:
         init = jnp.concatenate([c0, ar0, ma0], axis=-1)
     else:
@@ -259,10 +257,15 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray, xreg: jnp.ndarray,
         def neg_ll(prm, y):
             return -_log_likelihood_css_arma(prm, y, p, q, icpt)
 
-        if method == "css-cgd":
-            res = minimize_bfgs(neg_ll, init, diffed, tol=1e-7, max_iter=500)
+        if method == "css-lm":
+            def resid(prm, y):
+                return _one_step_errors(prm, y, p, q, icpt)[1]
+            res = minimize_least_squares(resid, init, adjusted, max_iter=100)
+        elif method == "css-cgd":
+            res = minimize_bfgs(neg_ll, init, adjusted, tol=1e-7,
+                                max_iter=500)
         elif method == "css-bobyqa":
-            res = minimize_box(neg_ll, init, -jnp.inf, jnp.inf, diffed,
+            res = minimize_box(neg_ll, init, -jnp.inf, jnp.inf, adjusted,
                                tol=1e-10, max_iter=500)
         else:
             raise ValueError(f"unknown method {method!r}")

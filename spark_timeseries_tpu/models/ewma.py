@@ -20,7 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.optimize import minimize_bfgs, minimize_box
+from ..ops.optimize import (minimize_bfgs, minimize_box,
+                            minimize_least_squares)
 
 
 class EWMAModel(NamedTuple):
@@ -61,12 +62,15 @@ class EWMAModel(NamedTuple):
 
 
 def fit(ts: jnp.ndarray, init: float = 0.94, tol: float = 1e-9,
-        max_iter: int = 200, method: str = "bfgs") -> EWMAModel:
+        max_iter: int = 200, method: str = "lm") -> EWMAModel:
     """Fit EWMA by minimizing one-step SSE over the smoothing parameter
-    (ref ``EWMA.scala:45-69``; same 0.94 initial guess; ``method="bfgs"``
+    (ref ``EWMA.scala:45-69``; same 0.94 initial guess).
+
+    ``method="lm"`` (default) runs batched Levenberg-Marquardt on the
+    one-step residuals — float32-robust on TPU; ``method="bfgs"``
     reproduces the reference's unbounded optimization whose result "should
     always be sanity checked", while ``method="box"`` constrains ``a`` to
-    [1e-4, 1] — the formally correct domain).
+    [1e-4, 1] — the formally correct domain.
 
     ``ts`` may be ``(n,)`` or ``(n_series, n)``; the returned model's
     ``smoothing`` is correspondingly scalar or ``(n_series,)``.
@@ -76,8 +80,15 @@ def fit(ts: jnp.ndarray, init: float = 0.94, tol: float = 1e-9,
     def objective(params, series):
         return EWMAModel(params[0]).sse(series)
 
+    def residuals(params, series):
+        smoothed = EWMAModel(params[0]).add_time_dependent_effects(series)
+        return series[1:] - smoothed[:-1]
+
     x0 = jnp.full((*ts.shape[:-1], 1), init, dtype=ts.dtype)
-    if method == "box":
+    if method == "lm":
+        res = minimize_least_squares(residuals, x0, ts, tol=tol,
+                                     max_iter=max_iter)
+    elif method == "box":
         res = minimize_box(objective, x0, 1e-4, 1.0, ts,
                            tol=tol, max_iter=max_iter)
     elif method == "bfgs":

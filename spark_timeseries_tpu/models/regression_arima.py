@@ -63,7 +63,9 @@ def fit(ts: jnp.ndarray, regressors: jnp.ndarray, method: str,
         raise ValueError(
             "Maximum iteration parameter to Cochrane-Orcutt must be integer")
     if len(optimization_args) > 1:
-        raise ValueError("Number of Cochrane-Orcutt arguments can't exceed 3")
+        raise ValueError(
+            "Cochrane-Orcutt accepts at most one optimization argument "
+            "(max_iter)")
     return fit_cochrane_orcutt(ts, regressors, optimization_args[0])
 
 

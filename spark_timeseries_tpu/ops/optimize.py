@@ -63,6 +63,87 @@ def minimize_bfgs(fn: Callable, x0: jnp.ndarray, *args,
     return solve_one(x0, *args)
 
 
+class _LMState(NamedTuple):
+    x: jnp.ndarray
+    f: jnp.ndarray
+    lam: jnp.ndarray
+    it: jnp.ndarray
+    done: jnp.ndarray
+
+
+def _minimize_lm_one(residual_fn, x0, tol, max_iter, lam0=1e-3,
+                     lam_up=10.0, lam_down=0.1):
+    """Single-lane Levenberg-Marquardt on a residual vector; designed to be
+    vmapped (fixed-shape while_loop, per-lane damping and convergence)."""
+    p = x0.shape[-1]
+    eye = jnp.eye(p, dtype=x0.dtype)
+
+    def cost(x):
+        r = residual_fn(x)
+        return jnp.sum(r * r)
+
+    def body(s: _LMState):
+        r = residual_fn(s.x)
+        J = jax.jacfwd(residual_fn)(s.x)                 # (m, p)
+        jtj = J.T @ J
+        jtr = J.T @ r
+        # Marquardt scaling: damp by lam * diag(JTJ) for scale invariance
+        damp = s.lam * jnp.diagonal(jtj) + 1e-12
+        delta = jnp.linalg.solve(jtj + damp * eye, jtr)
+        x_new = s.x - delta
+        f_new = cost(x_new)
+        improved = jnp.logical_and(f_new < s.f, jnp.isfinite(f_new))
+        x = jnp.where(improved, x_new, s.x)
+        f = jnp.where(improved, f_new, s.f)
+        lam = jnp.where(improved, s.lam * lam_down, s.lam * lam_up)
+        rel_drop = (s.f - f_new) <= tol * (jnp.abs(s.f) + tol)
+        step_small = jnp.max(jnp.abs(delta)) <= tol * (
+            jnp.max(jnp.abs(s.x)) + tol)
+        done = jnp.logical_and(improved,
+                               jnp.logical_or(rel_drop, step_small))
+        # a rejected step with huge damping means we're pinned at a minimum
+        done = jnp.logical_or(done, jnp.logical_and(~improved, s.lam > 1e8))
+        return _LMState(x, f, lam, s.it + 1, done)
+
+    def cond(s: _LMState):
+        return jnp.logical_and(~s.done, s.it < max_iter)
+
+    f0 = cost(x0)
+    lam0 = jnp.asarray(lam0, x0.dtype)
+    state = lax.while_loop(
+        cond, body,
+        _LMState(x0, f0, lam0, jnp.asarray(0), jnp.asarray(False)))
+    return MinimizeResult(state.x, state.f, state.done, state.it)
+
+
+def minimize_least_squares(residual_fn: Callable, x0: jnp.ndarray, *args,
+                           tol: float | None = None,
+                           max_iter: int = 100) -> MinimizeResult:
+    """Batched Levenberg-Marquardt for residual objectives (minimizes
+    ``sum(residual_fn(x)**2)``).
+
+    The TPU-native workhorse for every CSS/SSE fit: the normal-equation
+    solves are tiny batched MXU matmuls, convergence is per-lane masked, and
+    — unlike a BFGS line search — the updates stay well-behaved in float32
+    (the production TPU dtype; SURVEY.md §7 hard part #7).
+
+    ``residual_fn(params, *args) -> (m,)`` with ``params (p,)``; ``x0`` may
+    carry leading batch dims, vmapped with matching ``args`` dims.  ``tol``
+    defaults to a dtype-aware value (1e-10 for f64, 1e-6 for f32).
+    """
+    if tol is None:
+        tol = 1e-10 if x0.dtype == jnp.float64 else 1e-6
+
+    def solve_one(x0_i, *args_i):
+        return _minimize_lm_one(lambda x: residual_fn(x, *args_i), x0_i,
+                                tol, max_iter)
+
+    batch_dims = x0.ndim - 1
+    for _ in range(batch_dims):
+        solve_one = jax.vmap(solve_one)
+    return solve_one(x0, *args)
+
+
 def _project(x, lower, upper):
     return jnp.clip(x, lower, upper)
 

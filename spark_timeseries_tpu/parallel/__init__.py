@@ -1,0 +1,129 @@
+"""Distribution tier: meshes, shardings, resharding, multi-host.
+
+The reference's distribution layer is Spark's shuffle + task scheduler over a
+partitioned ``RDD[(key, Vector)]``
+(ref ``/root/reference/src/main/scala/com/cloudera/sparkts/TimeSeriesRDD.scala:52-648``).
+The TPU-native equivalents (SURVEY.md §5):
+
+| Spark mechanism                         | here                               |
+|-----------------------------------------|------------------------------------|
+| RDD partitioning over series            | ``NamedSharding(mesh, P("series"))``|
+| ``toInstants`` shuffle transpose        | resharding constraint → XLA ``all_to_all`` over ICI |
+| ``aggregate`` mask OR-reduction         | ``jnp.any`` over the sharded axis (XLA ``psum``) |
+| driver ``collect``                      | :func:`collect` (process-0 gather) |
+| Kryo serialization                      | n/a — arrays are already bytes     |
+| cluster manager / executors             | ``jax.distributed`` + one process per host |
+
+Everything here is ordinary pjit-era JAX: annotate shardings, let XLA insert
+the collectives, and the same program runs on 1 chip, a v5e-8 slice, or a
+multi-host DCN-connected pod.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SERIES_AXIS = "series"
+TIME_AXIS = "time"
+
+
+def make_mesh(n_series_shards: Optional[int] = None,
+              n_time_shards: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """A ``(series, time)`` mesh over the available devices.
+
+    The series axis is the primary data-parallel axis (the analogue of the
+    reference's RDD partitioning); a time axis > 1 additionally shards the
+    observation dimension for long series (sequence parallelism — beyond the
+    reference's capability envelope, which keeps each series on one machine,
+    ref ``src/site/markdown/index.md:35-40``).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if n_series_shards is None:
+        n_series_shards = len(devs) // n_time_shards
+    n = n_series_shards * n_time_shards
+    if n > len(devs):
+        raise ValueError(
+            f"mesh {n_series_shards}x{n_time_shards} needs {n} devices, "
+            f"have {len(devs)}")
+    grid = np.array(devs[:n]).reshape(n_series_shards, n_time_shards)
+    return Mesh(grid, (SERIES_AXIS, TIME_AXIS))
+
+
+def series_sharding(mesh: Mesh) -> NamedSharding:
+    """Series-major panel layout: ``(n_series, n_obs)`` split over the series
+    axis (and the time axis if the mesh has one)."""
+    return NamedSharding(mesh, P(SERIES_AXIS, TIME_AXIS))
+
+
+def instant_sharding(mesh: Mesh) -> NamedSharding:
+    """Time-major layout: ``(n_obs, n_series)`` split over the time axis."""
+    return NamedSharding(mesh, P(TIME_AXIS, SERIES_AXIS))
+
+
+def shard_panel_values(values: jnp.ndarray, mesh: Mesh) -> jnp.ndarray:
+    """Place ``(n_series, n_obs)`` values with series-major sharding."""
+    return jax.device_put(values, series_sharding(mesh))
+
+
+@functools.lru_cache(maxsize=None)
+def _to_instants_jit(mesh: Mesh):
+    return jax.jit(
+        lambda v: jax.lax.with_sharding_constraint(
+            v.T, instant_sharding(mesh)),
+        in_shardings=series_sharding(mesh),
+        out_shardings=instant_sharding(mesh))
+
+
+def to_instants(values: jnp.ndarray, mesh: Mesh) -> jnp.ndarray:
+    """Series-major → time-major relayout — the ``toInstants`` equivalent
+    (ref ``TimeSeriesRDD.scala:276-391``).
+
+    The reference implements this as its only all-to-all shuffle (map-side
+    chunking, range partitioner, secondary sort).  Here it is a transpose
+    with a sharding constraint; XLA lowers the resharding to an
+    ``all_to_all`` that rides ICI.  The jitted relayout is cached per mesh.
+    """
+    return _to_instants_jit(mesh)(values)
+
+
+@functools.lru_cache(maxsize=None)
+def _instant_mask_any_jit(mesh: Mesh):
+    return jax.jit(lambda m: jnp.any(m, axis=0),
+                   in_shardings=series_sharding(mesh))
+
+
+def instant_mask_any(mask: jnp.ndarray, mesh: Mesh) -> jnp.ndarray:
+    """Per-instant OR-reduction over the sharded series axis — the
+    ``aggregate``/mask-reduce equivalent (ref ``TimeSeriesRDD.scala:158-210``);
+    XLA inserts the cross-shard reduction (``psum``).  Cached per mesh."""
+    return _instant_mask_any_jit(mesh)(mask)
+
+
+def collect(values: jnp.ndarray) -> np.ndarray:
+    """Materialize a (possibly sharded, possibly multi-host) array on the
+    host — the driver-``collect`` equivalent
+    (ref ``TimeSeriesRDD.scala:61-75``)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        values = multihost_utils.process_allgather(values, tiled=True)
+    return np.asarray(values)
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> Tuple[int, int]:
+    """Join a multi-host mesh via ``jax.distributed`` (the analogue of the
+    reference's Spark cluster manager; collectives then ride ICI within a
+    slice and DCN across slices).  No-ops on a single process with no
+    coordinator configured.  Returns (process_id, process_count)."""
+    if coordinator_address is not None:
+        jax.distributed.initialize(coordinator_address, num_processes,
+                                   process_id)
+    return jax.process_index(), jax.process_count()

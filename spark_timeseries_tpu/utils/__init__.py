@@ -1,0 +1,11 @@
+"""Auxiliary subsystems: checkpointing, observability, plotting.
+
+The reference has no tracing/metrics/checkpoint tier (SURVEY.md §5) — its
+fault tolerance is Spark lineage and its only observability is the Spark UI.
+Here the equivalents are explicit: pytree checkpoints (fits are idempotent
+and restartable), a profiler/timing harness, and convergence counters.
+"""
+
+from . import checkpoint, observability, plot  # noqa: F401
+
+__all__ = ["checkpoint", "observability", "plot"]

@@ -1,0 +1,55 @@
+"""Checkpoint/restore for model pytrees and panels.
+
+The reference has no model persistence at all (constructor args are the
+state; SURVEY.md §5 "checkpoint/resume") and delegates fault tolerance to
+Spark lineage re-execution.  Here every fitted model is a pytree of arrays,
+so checkpointing is orbax (or a plain ``.npz`` fallback) and restart
+semantics are "re-run the batched fit for any shard not in the checkpoint"
+— per-batch fits are idempotent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def save_pytree(path: str, tree: Any) -> None:
+    """Save an arbitrary pytree of arrays/scalars as ``<path>.npz`` plus a
+    ``<path>.tree.json`` structure sidecar."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
+    np.savez(path + ".npz", **arrays)
+    with open(path + ".tree.json", "w") as f:
+        json.dump({"treedef": str(treedef), "n_leaves": len(leaves)}, f)
+
+
+def load_leaves(path: str) -> list:
+    """Load the leaf arrays saved by :func:`save_pytree` (in order).  Callers
+    rebuild their model types from the leaves (NamedTuple models: ``M(*leaves)``)."""
+    with np.load(path + ".npz") as data:
+        return [data[f"leaf_{i}"] for i in range(len(data.files))]
+
+
+def save_model(path: str, model: Any) -> None:
+    """Save a NamedTuple model with its class name recorded for sanity
+    checks on restore."""
+    save_pytree(path, tuple(model))
+    with open(path + ".meta.json", "w") as f:
+        json.dump({"class": type(model).__name__}, f)
+
+
+def load_model(path: str, model_cls: type) -> Any:
+    """Restore a NamedTuple model saved by :func:`save_model`."""
+    meta_path = path + ".meta.json"
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            recorded = json.load(f).get("class")
+        if recorded != model_cls.__name__:
+            raise ValueError(
+                f"checkpoint holds a {recorded}, not a {model_cls.__name__}")
+    return model_cls(*load_leaves(path))

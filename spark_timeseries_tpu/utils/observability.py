@@ -1,0 +1,75 @@
+"""Profiling, timing, and fit-convergence observability.
+
+The reference's only in-library telemetry is ``println`` warnings for
+non-stationary fits and ``seriesStats`` summaries
+(ref ``/root/reference/src/main/scala/com/cloudera/sparkts/models/ARIMA.scala:248-256``,
+``TimeSeriesRDD.scala:265-267``); everything else is delegated to the Spark
+UI.  Here: ``jax.profiler`` traces, a ``block_until_ready`` timing harness,
+and structured convergence counters off the batched optimizers
+(SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import time
+from typing import Any, Callable, Dict
+
+import jax
+import numpy as np
+
+logger = logging.getLogger("spark_timeseries_tpu")
+
+
+@contextlib.contextmanager
+def trace(name: str):
+    """Named profiler scope; shows up in ``jax.profiler`` traces around the
+    fit kernels."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def profile(log_dir: str):
+    """Capture a full device trace to ``log_dir`` (view with TensorBoard or
+    xprof)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def timed(fn: Callable, *args, warmup: int = 1, iters: int = 3,
+          **kwargs) -> Dict[str, Any]:
+    """Wall-time a jitted callable with ``block_until_ready`` fencing;
+    returns {mean_s, min_s, result}."""
+    result = None
+    for _ in range(warmup):
+        result = jax.block_until_ready(fn(*args, **kwargs))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        result = jax.block_until_ready(fn(*args, **kwargs))
+        times.append(time.perf_counter() - t0)
+    return {"mean_s": float(np.mean(times)), "min_s": float(np.min(times)),
+            "result": result}
+
+
+def fit_report(minimize_result) -> Dict[str, Any]:
+    """Convergence counters for a batched :class:`MinimizeResult` — the
+    batched answer to the reference's per-series println warnings."""
+    converged = np.asarray(minimize_result.converged)
+    n_iter = np.asarray(minimize_result.n_iter)
+    fun = np.asarray(minimize_result.fun)
+    report = {
+        "n_series": int(converged.size),
+        "n_converged": int(np.sum(converged)),
+        "n_diverged": int(np.sum(~np.isfinite(fun))),
+        "iters_mean": float(np.mean(n_iter)),
+        "iters_max": int(np.max(n_iter)) if n_iter.size else 0,
+    }
+    logger.info("fit_report %s", json.dumps(report))
+    return report

@@ -1,0 +1,79 @@
+"""Plotting: line plots and ACF/PACF with confidence bands.
+
+Capability parity with the reference's ``EasyPlot``
+(ref ``/root/reference/src/main/scala/com/cloudera/sparkts/EasyPlot.scala:24-120``),
+with matplotlib replacing breeze-viz.  PACF uses the AR(maxLag) coefficients
+exactly as the reference does (``EasyPlot.scala:85-96``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..models import autoregression
+from ..ops.univariate import autocorr
+
+
+def _figure():
+    import matplotlib
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+    return plt.subplots()
+
+
+def calc_conf_val(conf: float, n: int) -> float:
+    """Two-sided normal confidence bound scaled by sqrt(n)
+    (ref ``EasyPlot.scala:98-102``)."""
+    from scipy.stats import norm
+    return float(norm.ppf(1.0 - (1.0 - conf) / 2.0) / np.sqrt(n))
+
+
+def ezplot(series, style: str = "-"):
+    """Line plot of one series or a sequence of series
+    (ref ``EasyPlot.scala:25-53``)."""
+    fig, ax = _figure()
+    arr = np.asarray(series)
+    if arr.ndim == 1:
+        arr = arr[None, :]
+    for row in arr:
+        ax.plot(np.arange(row.size), row, style)
+    return fig
+
+
+def _draw_corr(ax, corrs: np.ndarray, conf_val: float) -> None:
+    """Vertical correlation bars + horizontal confidence lines
+    (ref ``EasyPlot.scala:104-119``)."""
+    for i, c in enumerate(corrs):
+        ax.plot([i + 1, i + 1], [0.0, c], color="C0")
+    n = len(corrs)
+    xs = np.arange(n + 1)
+    for v in (conf_val, -conf_val):
+        ax.plot(xs, np.full(n + 1, v), "-", color="red")
+
+
+def acf_plot(data, max_lag: int, conf: float = 0.95):
+    """Autocorrelation plot (ref ``EasyPlot.scala:61-75``)."""
+    arr = np.asarray(data)
+    corrs = np.asarray(autocorr(arr, max_lag))
+    fig, ax = _figure()
+    ax.set_title("Autocorrelation function")
+    ax.set_xlabel("Lag")
+    ax.set_ylabel("Autocorrelation")
+    _draw_corr(ax, corrs, calc_conf_val(conf, arr.size))
+    return fig
+
+
+def pacf_plot(data, max_lag: int, conf: float = 0.95):
+    """Partial autocorrelation plot: the AR(maxLag) coefficients
+    (ref ``EasyPlot.scala:77-96``)."""
+    arr = np.asarray(data)
+    model = autoregression.fit(arr, max_lag)
+    pcorrs = np.asarray(model.coefficients)
+    fig, ax = _figure()
+    ax.set_title("Partial autocorrelation function")
+    ax.set_xlabel("Lag")
+    ax.set_ylabel("Partial Autocorrelation")
+    _draw_corr(ax, pcorrs, calc_conf_val(conf, arr.size))
+    return fig
