@@ -475,7 +475,7 @@ def hannan_rissanen_init(p: int, q: int, y: jnp.ndarray,
 def fit(p: int, d: int, q: int, ts: jnp.ndarray,
         include_intercept: bool = True, method: str = "css-lm",
         user_init_params: Optional[jnp.ndarray] = None,
-        warn: bool = True) -> ARIMAModel:
+        warn: bool = True, max_iter: Optional[int] = None) -> ARIMAModel:
     """Fit an ARIMA(p, d, q) by conditional-sum-of-squares maximum likelihood
     (ref ``ARIMA.scala:79-116``).
 
@@ -493,6 +493,11 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
       derivative-free fallback's role).
 
     Matches the reference's AR-only fast path (pure OLS when ``q == 0``).
+
+    ``max_iter`` caps the optimizer iterations (default: 50 for LM, 500
+    otherwise); under vmap every lane pays the slowest lane's iterations,
+    and on the bench panel 50 trades ~1 point of batch convergence for ~2x
+    throughput — raise it for full-convergence parity runs.
     """
     ts = jnp.asarray(ts)
     icpt = 1 if include_intercept else 0
@@ -525,12 +530,14 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
     if method == "css-lm":
         def resid(prm, y):
             return _one_step_errors(prm, y, p, q, icpt)[1]
-        res = minimize_least_squares(resid, init, diffed, max_iter=100)
+        res = minimize_least_squares(resid, init, diffed,
+                                     max_iter=max_iter or LM_MAX_ITER)
     elif method == "css-cgd":
-        res = minimize_bfgs(neg_ll, init, diffed, tol=1e-7, max_iter=500)
+        res = minimize_bfgs(neg_ll, init, diffed, tol=1e-7,
+                            max_iter=max_iter or 500)
     elif method == "css-bobyqa":
         res = minimize_box(neg_ll, init, -jnp.inf, jnp.inf, diffed,
-                           tol=1e-10, max_iter=500)
+                           tol=1e-10, max_iter=max_iter or 500)
     else:
         raise ValueError(f"unknown method {method!r}")
 
@@ -565,6 +572,12 @@ def fit_panel(panel, p: int, d: int, q: int, **kwargs) -> ARIMAModel:
 # ---------------------------------------------------------------------------
 
 KPSS_SIGNIFICANCE = 0.05
+
+# default LM iteration cap: under vmap every lane pays the slowest lane's
+# iterations; 50 trades ~1 point of batch convergence (95.6% vs 96.8% at 100
+# on the bench panel) for ~2x throughput, and non-converged lanes keep their
+# best-found parameters.  Override per call via fit(..., max_iter=...).
+LM_MAX_ITER = 50
 
 
 def _choose_d(ts: jnp.ndarray, max_d: int) -> int:

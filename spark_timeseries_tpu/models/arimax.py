@@ -204,7 +204,8 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray, xreg: jnp.ndarray,
         xreg_max_lag: int, include_original_xreg: bool = True,
         include_intercept: bool = True,
         user_init_params: Optional[jnp.ndarray] = None,
-        method: str = "css-lm") -> ARIMAXModel:
+        method: str = "css-lm",
+        max_iter: Optional[int] = None) -> ARIMAXModel:
     """Fit an ARIMAX(p, d, q) (ref ``ARIMAX.scala:61-90``): initialize the
     ARX part by OLS on [y lags ‖ xreg lags ‖ xreg] (with the xreg columns
     differenced to order d, ref ``ARIMAX.scala:92-112``), the MA part by
@@ -258,15 +259,18 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray, xreg: jnp.ndarray,
             return -_log_likelihood_css_arma(prm, y, p, q, icpt)
 
         if method == "css-lm":
+            from .arima import LM_MAX_ITER
+
             def resid(prm, y):
                 return _one_step_errors(prm, y, p, q, icpt)[1]
-            res = minimize_least_squares(resid, init, adjusted, max_iter=100)
+            res = minimize_least_squares(resid, init, adjusted,
+                                         max_iter=max_iter or LM_MAX_ITER)
         elif method == "css-cgd":
             res = minimize_bfgs(neg_ll, init, adjusted, tol=1e-7,
-                                max_iter=500)
+                                max_iter=max_iter or 500)
         elif method == "css-bobyqa":
             res = minimize_box(neg_ll, init, -jnp.inf, jnp.inf, adjusted,
-                               tol=1e-10, max_iter=500)
+                               tol=1e-10, max_iter=max_iter or 500)
         else:
             raise ValueError(f"unknown method {method!r}")
         lane_ok = jnp.all(jnp.isfinite(res.x), axis=-1, keepdims=True)
