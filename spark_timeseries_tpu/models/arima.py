@@ -531,13 +531,13 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
         def resid(prm, y):
             return _one_step_errors(prm, y, p, q, icpt)[1]
         res = minimize_least_squares(resid, init, diffed,
-                                     max_iter=max_iter or LM_MAX_ITER)
+                                     max_iter=max_iter if max_iter is not None else LM_MAX_ITER)
     elif method == "css-cgd":
         res = minimize_bfgs(neg_ll, init, diffed, tol=1e-7,
-                            max_iter=max_iter or 500)
+                            max_iter=max_iter if max_iter is not None else 500)
     elif method == "css-bobyqa":
         res = minimize_box(neg_ll, init, -jnp.inf, jnp.inf, diffed,
-                           tol=1e-10, max_iter=max_iter or 500)
+                           tol=1e-10, max_iter=max_iter if max_iter is not None else 500)
     else:
         raise ValueError(f"unknown method {method!r}")
 

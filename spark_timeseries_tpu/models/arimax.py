@@ -47,9 +47,10 @@ from jax import lax
 
 from ..ops.univariate import differences_of_order_d
 from . import autoregression_x
-from .arima import (_add_effects_one, _batched, _difference_rows,
-                    _log_likelihood_css_arma, _one_step_errors,
-                    _remove_effects_one, hannan_rissanen_init)
+from .arima import (LM_MAX_ITER, _add_effects_one, _batched,
+                    _difference_rows, _log_likelihood_css_arma,
+                    _one_step_errors, _remove_effects_one,
+                    hannan_rissanen_init)
 from ..ops.optimize import (minimize_bfgs, minimize_box,
                             minimize_least_squares)
 
@@ -259,18 +260,16 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray, xreg: jnp.ndarray,
             return -_log_likelihood_css_arma(prm, y, p, q, icpt)
 
         if method == "css-lm":
-            from .arima import LM_MAX_ITER
-
             def resid(prm, y):
                 return _one_step_errors(prm, y, p, q, icpt)[1]
             res = minimize_least_squares(resid, init, adjusted,
-                                         max_iter=max_iter or LM_MAX_ITER)
+                                         max_iter=max_iter if max_iter is not None else LM_MAX_ITER)
         elif method == "css-cgd":
             res = minimize_bfgs(neg_ll, init, adjusted, tol=1e-7,
-                                max_iter=max_iter or 500)
+                                max_iter=max_iter if max_iter is not None else 500)
         elif method == "css-bobyqa":
             res = minimize_box(neg_ll, init, -jnp.inf, jnp.inf, adjusted,
-                               tol=1e-10, max_iter=max_iter or 500)
+                               tol=1e-10, max_iter=max_iter if max_iter is not None else 500)
         else:
             raise ValueError(f"unknown method {method!r}")
         lane_ok = jnp.all(jnp.isfinite(res.x), axis=-1, keepdims=True)

@@ -1,8 +1,12 @@
-"""Vectorized series ops (L1/L2): lag matrices, univariate kernels, resample, OLS."""
+"""Vectorized series ops (L1/L2): lag matrices, univariate kernels, resample,
+OLS, batched optimizers, and sequence-parallel recurrences."""
 
+from . import optimize, scan_parallel
 from .lag import lag_matrix, lag_matrix_multi
 from .linalg import OLSResult, ols, ols_beta, r_squared, t_statistics
 from .resample import bucket_assignments, resample
+from .scan_parallel import (ar1_filter, ewma_smooth, garch_variance,
+                            linear_recurrence)
 from .univariate import (
     autocorr,
     differences_at_lag,
@@ -31,6 +35,8 @@ from .univariate import (
 )
 
 __all__ = [
+    "optimize", "scan_parallel",
+    "linear_recurrence", "ewma_smooth", "ar1_filter", "garch_variance",
     "lag_matrix", "lag_matrix_multi",
     "OLSResult", "ols", "ols_beta", "r_squared", "t_statistics",
     "bucket_assignments", "resample",

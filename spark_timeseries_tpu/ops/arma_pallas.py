@@ -1,0 +1,281 @@
+"""Pallas TPU kernel for the ARMA CSS inner loop — the framework's hot op.
+
+Every Levenberg-Marquardt iteration of an ARIMA/ARIMAX fit needs, per
+series: the one-step residuals ``e_t``, the Gauss-Newton normal equations
+``J^T J`` / ``J^T e``, and the cost.  The XLA path builds them by
+``jacfwd`` through a ``lax.scan`` (p+q+1 tangent streams through HBM); this
+kernel instead runs the error recurrence AND the reference's analytic
+derivative recurrence (ref
+``/root/reference/src/main/scala/com/cloudera/sparkts/models/ARIMA.scala:465-534``):
+
+    e_t       = y_t - c - Σ_j φ_j y_{t-j-1} - Σ_k θ_k e_{t-k}
+    ∂e_t/∂x   = -u_t - Σ_k θ_k ∂e_{t-k}/∂x,   u = (1, y_{t-j-1}, e_{t-k})
+
+entirely in VMEM, accumulating the packed upper triangle of ``J^T J``,
+``J^T e`` and the cost in one pass over time.  Series are blocked
+``(8, 128)`` lanes per grid step (the float32 VPU tile), parameters ride
+as per-lane vectors, and every op is elementwise — pure VPU work with no
+HBM traffic beyond one read of the series block.
+
+On non-TPU backends the same kernel runs under ``interpret=True`` (used by
+the CPU test tier); callers gate on platform via :func:`use_pallas`.
+
+Measured on a v5e chip (8192 series x 128 obs, ARIMA(2,1,2)): this kernel
+reaches ~5.5k fits/sec while the XLA ``jacfwd``-through-``scan`` path in
+:func:`spark_timeseries_tpu.ops.optimize.minimize_least_squares` reaches
+~12.8k — XLA's fusion of the tangent streams already saturates the VPU for
+this recurrence, and Mosaic's per-step dynamic VMEM reads cost more than
+XLA's pipelined scan.  The kernel is therefore kept as an alternative
+backend (and the template for a future cross-chip RDMA variant), not the
+default fit path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+MAX_ROWS = 64          # sublane rows per block: 64x128 lanes = 8 VPU tiles
+
+
+def use_pallas() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _block_rows(n_series: int) -> int:
+    """Sublane rows per grid block: enough to cover the panel (amortizing
+    per-op issue overhead across VPU tiles) but capped so VMEM holds the
+    series block."""
+    rows = -(-n_series // LANES)
+    return max(8, min(MAX_ROWS, ((rows + 7) // 8) * 8))
+
+
+def _pack_triu_index(n: int):
+    pairs = []
+    for a in range(n):
+        for b in range(a, n):
+            pairs.append((a, b))
+    return pairs
+
+
+def _css_kernel(p: int, q: int, icpt: int, n_obs: int, with_grad: bool,
+                params_ref, y_ref, out_ref):
+    """One series block: params (nparams, 8, 128), y (n_obs, 8, 128),
+    out (n_out, 8, 128) where n_out = 1 (cost) [+ triu + nparams]."""
+    nparams = icpt + p + q
+    max_lag = max(p, q)
+    pairs = _pack_triu_index(nparams) if with_grad else []
+
+    # derive the zero from real data so Mosaic gives every carry entry the
+    # same (non-replicated) layout as computed values
+    zero = y_ref[0, 0] * 0.0
+    c = params_ref[0, 0] if icpt else zero
+    phi = [params_ref[icpt + j, 0] for j in range(p)]
+    theta = [params_ref[icpt + p + k, 0] for k in range(q)]
+
+    # carry: error ring (q), derivative rings (q per param), accumulators
+    n_acc = 1 + (len(pairs) + nparams if with_grad else 0)
+    carry0 = ([zero] * q                                   # e ring, newest first
+              + [zero] * (q * nparams if with_grad else 0)  # de rings
+              + [zero] * n_acc)                             # cost, jtj, jtr
+
+    def body(t, carry):
+        e_ring = list(carry[:q])
+        off = q
+        if with_grad:
+            de_ring = [list(carry[off + k * nparams: off + (k + 1) * nparams])
+                       for k in range(q)]
+            off += q * nparams
+        acc = list(carry[off:])
+
+        y_t = y_ref[t, 0]
+        yhat = c
+        for j in range(p):
+            yhat = yhat + phi[j] * y_ref[t - (j + 1), 0]
+        for k in range(q):
+            yhat = yhat + theta[k] * e_ring[k]
+        e_t = y_t - yhat
+
+        if with_grad:
+            # de_t[x] = -(u_x + Σ_k θ_k de_{t-k}[x])
+            de_t = []
+            for x in range(nparams):
+                if x < icpt:
+                    u = zero + 1.0
+                elif x < icpt + p:
+                    u = y_ref[t - (x - icpt + 1), 0]
+                else:
+                    u = e_ring[x - icpt - p]
+                s = u
+                for k in range(q):
+                    s = s + theta[k] * de_ring[k][x]
+                de_t.append(-s)
+
+        # accumulate
+        acc[0] = acc[0] + e_t * e_t
+        if with_grad:
+            for idx, (a, b) in enumerate(pairs):
+                acc[1 + idx] = acc[1 + idx] + de_t[a] * de_t[b]
+            for x in range(nparams):
+                acc[1 + len(pairs) + x] = \
+                    acc[1 + len(pairs) + x] + de_t[x] * e_t
+
+        new_e_ring = ([e_t] + e_ring[:-1]) if q else []
+        out = list(new_e_ring)
+        if with_grad:
+            new_de = [de_t] + de_ring[:-1] if q else []
+            for ring in new_de:
+                out.extend(ring)
+        out.extend(acc)
+        return tuple(out)
+
+    final = jax.lax.fori_loop(max_lag, n_obs, body, tuple(carry0))
+    off = q + (q * nparams if with_grad else 0)
+    for i in range(n_acc):
+        out_ref[i, 0] = final[off + i]
+
+
+@functools.lru_cache(maxsize=None)
+def _build_call(p: int, q: int, icpt: int, n_obs: int, n_blocks: int,
+                rows: int, with_grad: bool, interpret: bool):
+    nparams = icpt + p + q
+    n_out = 1 + (len(_pack_triu_index(nparams)) + nparams if with_grad else 0)
+    kernel = functools.partial(_css_kernel, p, q, icpt, n_obs, with_grad)
+    return pl.pallas_call(
+        kernel,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((nparams, 1, rows, LANES),
+                         lambda i: (0, i, 0, 0)),
+            pl.BlockSpec((n_obs, 1, rows, LANES),
+                         lambda i: (0, i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((n_out, 1, rows, LANES),
+                               lambda i: (0, i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_out, n_blocks, rows, LANES), jnp.float32),
+        interpret=interpret,
+    )
+
+
+def _blocked(x: jnp.ndarray, n_series: int,
+             rows: int) -> Tuple[jnp.ndarray, int, int]:
+    """(n_series, k) -> (k, n_blocks, rows, 128) with zero padding."""
+    block = rows * LANES
+    pad = (-n_series) % block
+    n_blocks = (n_series + pad) // block
+    x = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
+    x = jnp.moveaxis(x, 0, -1)                      # (k, S)
+    return x.reshape(*x.shape[:-1], n_blocks, rows, LANES), n_blocks, pad
+
+
+def css_normal_equations(params: jnp.ndarray, y: jnp.ndarray,
+                         p: int, q: int, icpt: int,
+                         interpret: bool | None = None):
+    """Batched (J^T J, J^T e, cost) for the ARMA CSS residuals.
+
+    ``params (S, nparams)`` float32, ``y (S, n)`` float32 (the differenced
+    series).  Returns ``(jtj (S, nparams, nparams), jtr (S, nparams),
+    cost (S,))``.
+    """
+    if interpret is None:
+        interpret = not use_pallas()
+    nparams = icpt + p + q
+    S, n_obs = y.shape
+    rows = _block_rows(S)
+    params_b, n_blocks, _ = _blocked(params.astype(jnp.float32), S, rows)
+    y_b = jnp.moveaxis(
+        jnp.pad(y.astype(jnp.float32), [(0, (-S) % (rows * LANES)), (0, 0)]),
+        0, -1).reshape(n_obs, n_blocks, rows, LANES)
+
+    call = _build_call(p, q, icpt, n_obs, n_blocks, rows, True, interpret)
+    out = call(params_b, y_b)                       # (n_out, nb, 8, 128)
+    out = out.reshape(out.shape[0], -1)[:, :S].T    # (S, n_out)
+
+    cost = out[:, 0]
+    pairs = _pack_triu_index(nparams)
+    jtj = jnp.zeros((S, nparams, nparams), jnp.float32)
+    for idx, (a, b) in enumerate(pairs):
+        v = out[:, 1 + idx]
+        jtj = jtj.at[:, a, b].set(v)
+        if a != b:
+            jtj = jtj.at[:, b, a].set(v)
+    jtr = out[:, 1 + len(pairs):1 + len(pairs) + nparams]
+    return jtj, jtr, cost
+
+
+def css_cost(params: jnp.ndarray, y: jnp.ndarray,
+             p: int, q: int, icpt: int,
+             interpret: bool | None = None) -> jnp.ndarray:
+    """Batched CSS (sum of squared one-step errors) only — the cheap trial
+    evaluation inside the LM loop.  Shapes as in
+    :func:`css_normal_equations`; returns ``(S,)``."""
+    if interpret is None:
+        interpret = not use_pallas()
+    S, n_obs = y.shape
+    rows = _block_rows(S)
+    params_b, n_blocks, _ = _blocked(params.astype(jnp.float32), S, rows)
+    y_b = jnp.moveaxis(
+        jnp.pad(y.astype(jnp.float32), [(0, (-S) % (rows * LANES)), (0, 0)]),
+        0, -1).reshape(n_obs, n_blocks, rows, LANES)
+    call = _build_call(p, q, icpt, n_obs, n_blocks, rows, False, interpret)
+    out = call(params_b, y_b)
+    return out.reshape(out.shape[0], -1)[0, :S]
+
+
+def fit_css_lm(params0: jnp.ndarray, y: jnp.ndarray, p: int, q: int,
+               icpt: int, max_iter: int = 50, tol: float = 1e-6,
+               interpret: bool | None = None):
+    """Levenberg-Marquardt on the CSS residuals driven by the fused kernel.
+
+    Same algorithm as :func:`spark_timeseries_tpu.ops.optimize.
+    minimize_least_squares` (Marquardt-scaled damping, accept-if-improved,
+    per-lane convergence) but with the normal equations built by one Pallas
+    pass instead of ``jacfwd`` streams.  All lanes iterate together; state
+    is ``(x, cost, lam, done)`` batched over series.
+
+    Returns ``(x (S, k), cost (S,), converged (S,), n_iter ())``.
+    """
+    if interpret is None:
+        interpret = not use_pallas()
+    params0 = params0.astype(jnp.float32)
+    y = y.astype(jnp.float32)
+    S, k = params0.shape
+    eye = jnp.eye(k, dtype=jnp.float32)
+
+    def body(state):
+        x, f, lam, done, it = state
+        jtj, jtr, _ = css_normal_equations(x, y, p, q, icpt, interpret)
+        damp = lam[:, None] * jnp.diagonal(jtj, axis1=-2, axis2=-1) + 1e-12
+        delta = jnp.linalg.solve(jtj + damp[:, :, None] * eye,
+                                 jtr[..., None])[..., 0]
+        x_new = x - delta
+        f_new = css_cost(x_new, y, p, q, icpt, interpret)
+        improved = (f_new < f) & jnp.isfinite(f_new) & ~done
+        x = jnp.where(improved[:, None], x_new, x)
+        lam = jnp.where(done, lam,
+                        jnp.where(improved, lam * 0.1, lam * 10.0))
+        rel_drop = (f - f_new) <= tol * (jnp.abs(f) + tol)
+        step_small = jnp.max(jnp.abs(delta), axis=-1) <= tol * (
+            jnp.max(jnp.abs(x), axis=-1) + tol)
+        newly_done = improved & (rel_drop | step_small)
+        newly_done = newly_done | (~improved & (lam > 1e8))
+        f = jnp.where(improved, f_new, f)
+        return x, f, lam, done | newly_done, it + 1
+
+    def cond(state):
+        _, _, _, done, it = state
+        return (~jnp.all(done)) & (it < max_iter)
+
+    f0 = css_cost(params0, y, p, q, icpt, interpret)
+    lam0 = jnp.full((S,), 1e-3, jnp.float32)
+    done0 = jnp.zeros((S,), bool)
+    x, f, lam, done, it = jax.lax.while_loop(
+        cond, body, (params0, f0, lam0, done0, jnp.asarray(0)))
+    return x, f, done, it

@@ -66,6 +66,8 @@ def minimize_bfgs(fn: Callable, x0: jnp.ndarray, *args,
 class _LMState(NamedTuple):
     x: jnp.ndarray
     f: jnp.ndarray
+    jtj: jnp.ndarray
+    jtr: jnp.ndarray
     lam: jnp.ndarray
     it: jnp.ndarray
     done: jnp.ndarray
@@ -74,27 +76,36 @@ class _LMState(NamedTuple):
 def _minimize_lm_one(residual_fn, x0, tol, max_iter, lam0=1e-3,
                      lam_up=10.0, lam_down=0.1):
     """Single-lane Levenberg-Marquardt on a residual vector; designed to be
-    vmapped (fixed-shape while_loop, per-lane damping and convergence)."""
+    vmapped (fixed-shape while_loop, per-lane damping and convergence).
+
+    One fused residual+Jacobian pass per iteration: the normal equations are
+    evaluated at the *trial* point, so an accepted step's next solve reuses
+    them and a rejected step re-solves from the carried ones with higher
+    damping — halving the recurrence work versus a separate cost evaluation.
+    """
     p = x0.shape[-1]
     eye = jnp.eye(p, dtype=x0.dtype)
 
-    def cost(x):
+    def resid_and_aux(x):
         r = residual_fn(x)
-        return jnp.sum(r * r)
+        return r, r
+
+    def normal_eqs(x):
+        J, r = jax.jacfwd(resid_and_aux, has_aux=True)(x)   # (m, p), (m,)
+        return J.T @ J, J.T @ r, jnp.sum(r * r)
 
     def body(s: _LMState):
-        r = residual_fn(s.x)
-        J = jax.jacfwd(residual_fn)(s.x)                 # (m, p)
-        jtj = J.T @ J
-        jtr = J.T @ r
         # Marquardt scaling: damp by lam * diag(JTJ) for scale invariance
-        damp = s.lam * jnp.diagonal(jtj) + 1e-12
-        delta = jnp.linalg.solve(jtj + damp * eye, jtr)
+        damp = s.lam * jnp.diagonal(s.jtj) + 1e-12
+        delta = jnp.linalg.solve(s.jtj + damp * eye, s.jtr)
         x_new = s.x - delta
-        f_new = cost(x_new)
-        improved = jnp.logical_and(f_new < s.f, jnp.isfinite(f_new))
+        jtj_new, jtr_new, f_new = normal_eqs(x_new)
+        ok = jnp.all(jnp.isfinite(jtj_new)) & jnp.all(jnp.isfinite(jtr_new))
+        improved = (f_new < s.f) & jnp.isfinite(f_new) & ok
         x = jnp.where(improved, x_new, s.x)
         f = jnp.where(improved, f_new, s.f)
+        jtj = jnp.where(improved, jtj_new, s.jtj)
+        jtr = jnp.where(improved, jtr_new, s.jtr)
         lam = jnp.where(improved, s.lam * lam_down, s.lam * lam_up)
         rel_drop = (s.f - f_new) <= tol * (jnp.abs(s.f) + tol)
         step_small = jnp.max(jnp.abs(delta)) <= tol * (
@@ -103,16 +114,17 @@ def _minimize_lm_one(residual_fn, x0, tol, max_iter, lam0=1e-3,
                                jnp.logical_or(rel_drop, step_small))
         # a rejected step with huge damping means we're pinned at a minimum
         done = jnp.logical_or(done, jnp.logical_and(~improved, s.lam > 1e8))
-        return _LMState(x, f, lam, s.it + 1, done)
+        return _LMState(x, f, jtj, jtr, lam, s.it + 1, done)
 
     def cond(s: _LMState):
         return jnp.logical_and(~s.done, s.it < max_iter)
 
-    f0 = cost(x0)
+    jtj0, jtr0, f0 = normal_eqs(x0)
     lam0 = jnp.asarray(lam0, x0.dtype)
     state = lax.while_loop(
         cond, body,
-        _LMState(x0, f0, lam0, jnp.asarray(0), jnp.asarray(False)))
+        _LMState(x0, f0, jtj0, jtr0, lam0, jnp.asarray(0),
+                 jnp.asarray(False)))
     return MinimizeResult(state.x, state.f, state.done, state.it)
 
 

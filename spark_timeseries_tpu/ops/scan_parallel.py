@@ -1,0 +1,108 @@
+"""Sequence-parallel linear recurrences via associative scans.
+
+The reference's capability envelope keeps every series on one machine and
+walks it with O(n) scalar loops (``src/site/markdown/index.md:35-40``); its
+sequential recurrences (EWMA smoothing, AR filters, GARCH variance) are the
+reason.  Here those recurrences are first-order *affine* maps
+
+    y_t = a_t * y_{t-1} + b_t
+
+whose composition is associative, so ``jax.lax.associative_scan`` evaluates
+them in O(log n) depth — and, when the time axis is sharded over a mesh
+(``parallel.make_mesh(n, m)`` with ``m > 1``), XLA splits the scan across
+the time shards with collectives riding ICI.  This is the framework's
+sequence-parallelism story: series longer than one chip's HBM shard the
+time axis and still filter/smooth in logarithmic depth — the classical-TS
+analogue of ring-attention-style context parallelism.
+
+Used by the EWMA and GARCH paths for long series; the general helper is
+public for user-defined filters.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def linear_recurrence(a: jnp.ndarray, b: jnp.ndarray,
+                      axis: int = -1) -> jnp.ndarray:
+    """Solve ``y_t = a_t * y_{t-1} + b_t`` with ``y_{-1} = 0`` for all t,
+    in O(log n) depth.
+
+    ``a`` and ``b`` broadcast against each other; the recurrence runs along
+    ``axis``.  The affine maps ``(a_t, b_t)`` compose as
+    ``(a2, b2) ∘ (a1, b1) = (a1*a2, a2*b1 + b2)``, which is associative.
+    """
+    a, b = jnp.broadcast_arrays(a, b)
+
+    def combine(left, right):
+        a1, b1 = left
+        a2, b2 = right
+        return a1 * a2, a2 * b1 + b2
+
+    _, y = lax.associative_scan(combine, (a, b), axis=axis)
+    return y
+
+
+def ewma_smooth(x: jnp.ndarray, alpha: jnp.ndarray,
+                axis: int = -1) -> jnp.ndarray:
+    """EWMA smoothing ``S_t = alpha*x_t + (1-alpha)*S_{t-1}``, ``S_0 = x_0``
+    (the recurrence of ``models.ewma.EWMAModel.add_time_dependent_effects``),
+    evaluated by associative scan — identical output, O(log n) depth,
+    time-shardable."""
+    x = jnp.asarray(x)
+    alpha = jnp.asarray(alpha)
+    if alpha.ndim and axis in (-1, x.ndim - 1):
+        alpha = alpha[..., None]
+    a = jnp.broadcast_to(1.0 - alpha, x.shape)
+    b = alpha * x
+    # S_0 = x_0 exactly: make the first step the identity-carrying seed
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(0, 1)
+    a = a.at[tuple(idx)].set(0.0)
+    b = b.at[tuple(idx)].set(x[tuple(idx)])
+    return linear_recurrence(a, b, axis=axis)
+
+
+def ar1_filter(x: jnp.ndarray, c, phi, axis: int = -1) -> jnp.ndarray:
+    """AR(1) filtering ``y_t = c + phi*y_{t-1} + x_t`` with ``y_{-1} = 0``
+    — the ``ARModel.add_time_dependent_effects`` recurrence for p=1 — by
+    associative scan."""
+    x = jnp.asarray(x)
+    c = jnp.asarray(c)
+    phi = jnp.asarray(phi)
+    if phi.ndim and axis in (-1, x.ndim - 1):
+        phi = phi[..., None]
+        c = c[..., None]
+    a = jnp.broadcast_to(phi, x.shape)
+    b = x + c
+    return linear_recurrence(a, b, axis=axis)
+
+
+def garch_variance(errors: jnp.ndarray, omega, alpha, beta,
+                   axis: int = -1) -> jnp.ndarray:
+    """Conditional-variance path ``h_t = omega + alpha*e²_{t-1} + beta*h_{t-1}``
+    with ``h_0 = omega / (1 - alpha - beta)`` (the GARCH recurrence,
+    ``models.garch.GARCHModel``), by associative scan.  Returns ``h`` aligned
+    with ``errors`` (``h[0]`` is the stationary seed)."""
+    e = jnp.asarray(errors)
+    omega = jnp.asarray(omega)
+    alpha = jnp.asarray(alpha)
+    beta = jnp.asarray(beta)
+    if beta.ndim and axis in (-1, e.ndim - 1):
+        omega = omega[..., None]
+        alpha = alpha[..., None]
+        beta = beta[..., None]
+    e2_prev = jnp.concatenate(
+        [jnp.zeros_like(jnp.take(e, jnp.asarray([0]), axis=axis)),
+         jnp.take(e, jnp.arange(e.shape[axis] - 1), axis=axis) ** 2],
+        axis=axis)
+    a = jnp.broadcast_to(beta, e.shape)
+    b = omega + alpha * e2_prev
+    h0 = omega / (1.0 - alpha - beta)
+    idx = [slice(None)] * e.ndim
+    idx[axis] = slice(0, 1)
+    a = a.at[tuple(idx)].set(0.0)
+    b = b.at[tuple(idx)].set(jnp.broadcast_to(h0, b[tuple(idx)].shape))
+    return linear_recurrence(a, b, axis=axis)

@@ -1,0 +1,86 @@
+"""Pallas ARMA-CSS kernel tests (interpret mode on the CPU tier).
+
+The kernel must agree with the autodiff path it mirrors: residual cost,
+J^T J / J^T e normal equations, and the full LM fit trajectory."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu.models.arima import (_one_step_errors,
+                                               hannan_rissanen_init)
+from spark_timeseries_tpu.ops import arma_pallas as ap
+from spark_timeseries_tpu.ops.optimize import minimize_least_squares
+
+
+@pytest.fixture(scope="module")
+def problem():
+    rng = np.random.default_rng(0)
+    S, n = 16, 96
+    y = np.cumsum(rng.normal(size=(S, n)), axis=1).astype(np.float32)
+    diffed = np.diff(y, axis=1)
+    params = np.tile(np.array([0.3, 0.2, 0.3, 0.2, 0.1], np.float32), (S, 1))
+    params += rng.normal(scale=0.02, size=params.shape).astype(np.float32)
+    return jnp.asarray(params), jnp.asarray(diffed)
+
+
+def _reference(params, diffed, p=2, q=2, icpt=1):
+    def resid(prm, yy):
+        return _one_step_errors(prm, yy, p, q, icpt)[1]
+
+    r = jax.vmap(resid)(params, diffed)
+    J = jax.vmap(jax.jacfwd(resid))(params, diffed)
+    return (jnp.einsum("snp,snk->spk", J, J),
+            jnp.einsum("snp,sn->sp", J, r),
+            jnp.sum(r * r, axis=-1))
+
+
+def test_normal_equations_match_autodiff(problem):
+    params, diffed = problem
+    jtj, jtr, cost = ap.css_normal_equations(params, diffed, 2, 2, 1,
+                                             interpret=True)
+    jtj_ref, jtr_ref, cost_ref = _reference(params, diffed)
+    np.testing.assert_allclose(np.asarray(cost), np.asarray(cost_ref),
+                               rtol=3e-4)
+    np.testing.assert_allclose(np.asarray(jtr), np.asarray(jtr_ref),
+                               rtol=3e-3, atol=1e-2)
+    np.testing.assert_allclose(np.asarray(jtj), np.asarray(jtj_ref),
+                               rtol=3e-3, atol=1e-2)
+
+
+def test_cost_only_kernel(problem):
+    params, diffed = problem
+    cost = ap.css_cost(params, diffed, 2, 2, 1, interpret=True)
+    _, _, cost_ref = _reference(params, diffed)
+    np.testing.assert_allclose(np.asarray(cost), np.asarray(cost_ref),
+                               rtol=3e-4)
+
+
+def test_no_intercept_and_ar_only(problem):
+    _, diffed = problem
+    S = diffed.shape[0]
+    params = jnp.tile(jnp.asarray([0.4, 0.1], jnp.float32), (S, 1))
+    jtj, jtr, cost = ap.css_normal_equations(params, diffed, 2, 0, 0,
+                                             interpret=True)
+    jtj_ref, jtr_ref, cost_ref = _reference(params, diffed, 2, 0, 0)
+    np.testing.assert_allclose(np.asarray(cost), np.asarray(cost_ref),
+                               rtol=3e-4)
+    np.testing.assert_allclose(np.asarray(jtj), np.asarray(jtj_ref),
+                               rtol=3e-3, atol=1e-2)
+
+
+def test_lm_fit_improves_and_tracks_xla_path(problem):
+    params, diffed = problem
+    x, f, done, it = ap.fit_css_lm(params, diffed, 2, 2, 1, max_iter=30,
+                                   interpret=True)
+    _, _, cost0 = _reference(params, diffed)
+    assert np.all(np.asarray(f) <= np.asarray(cost0) + 1e-3)
+
+    def resid(prm, yy):
+        return _one_step_errors(prm, yy, 2, 2, 1)[1]
+
+    res = minimize_least_squares(resid, params, diffed, max_iter=30)
+    # both optimizers should reach comparable cost (not identical paths)
+    assert np.median(np.asarray(f) - np.asarray(res.fun)) < \
+        0.05 * np.median(np.asarray(res.fun))
