@@ -1,0 +1,111 @@
+"""Extended benchmark suite — the five BASELINE.md configs.
+
+``python benchmarks/bench_suite.py`` prints one JSON line per config:
+EWMA, ARIMA (the headline, same as bench.py), Holt-Winters seasonal,
+AR-GARCH volatility, and RegressionARIMA + stationarity tests.  Synthetic
+panels stand in for the M4/minute-bar datasets (zero-egress environment);
+shapes are chosen to match their scale profile.  All timings are to host
+materialization (the tunneled TPU platform does not synchronize on
+block_until_ready alone).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _timed(fn, *args, reps=3):
+    np.asarray(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = np.asarray(fn(*args))
+    return (time.perf_counter() - t0) / reps, out
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _synthetic_arima_panel
+    from spark_timeseries_tpu import stats
+    from spark_timeseries_tpu.models import (arima, ewma, garch,
+                                             holt_winters,
+                                             regression_arima)
+
+    dtype = jnp.float32 if jax.devices()[0].platform == "tpu" else jnp.float64
+    if dtype == jnp.float64:
+        jax.config.update("jax_enable_x64", True)
+    rng = np.random.default_rng(0)
+    results = []
+
+    # 1. EWMA on an AR(1) panel (BASELINE config #1)
+    n, n_obs = 65536, 128
+    ar1 = np.cumsum(rng.normal(size=(n, n_obs)), axis=1) + 100.0
+    vals = jnp.asarray(ar1, dtype)
+    dt, _ = _timed(jax.jit(lambda v: ewma.fit(v).smoothing), vals)
+    results.append(("EWMA fit", n, n_obs, n / dt))
+
+    # 2. ARIMA(2,1,2) (BASELINE config #2; headline, mirrors bench.py)
+    n, n_obs = 8192, 128
+    vals = jnp.asarray(_synthetic_arima_panel(n, n_obs), dtype)
+    dt, _ = _timed(
+        jax.jit(lambda v: arima.fit(2, 1, 2, v, warn=False).coefficients),
+        vals)
+    results.append(("ARIMA(2,1,2) CSS+HR fit", n, n_obs, n / dt))
+
+    # 3. Holt-Winters additive, monthly seasonality (BASELINE config #3)
+    n, n_obs, period = 4096, 120, 12
+    t = np.arange(n_obs)
+    season = 10 * np.sin(2 * np.pi * t / period)
+    base = (100 + 0.5 * t + season)[None, :] \
+        + rng.normal(scale=2.0, size=(n, n_obs))
+    vals = jnp.asarray(base, dtype)
+    fit_hw = jax.jit(lambda v: holt_winters.fit(v, period, "additive",
+                                                max_iter=200).alpha)
+    dt, _ = _timed(fit_hw, vals)
+    results.append(("HoltWinters additive fit", n, n_obs, n / dt))
+
+    # 4. AR-GARCH volatility (BASELINE config #4, minute-bar profile)
+    n, n_obs = 4096, 1024
+    gen = garch.ARGARCHModel(jnp.asarray(0.1), jnp.asarray(0.3),
+                             jnp.asarray(0.05), jnp.asarray(0.1),
+                             jnp.asarray(0.85))
+    vals = gen.sample(n_obs, jax.random.PRNGKey(1), shape=(n,)).astype(dtype)
+    dt, _ = _timed(jax.jit(lambda v: garch.fit_ar_garch(v).alpha), vals)
+    results.append(("ARGARCH(1,1) fit", n, n_obs, n / dt))
+
+    # 5. RegressionARIMA + batched ADF/KPSS (BASELINE config #5)
+    n, n_obs, k = 8192, 256, 3
+    X = rng.normal(size=(n_obs, k)).cumsum(axis=0)
+    beta = rng.normal(size=k)
+    e = np.zeros((n, n_obs))
+    w = rng.normal(size=(n, n_obs))
+    for tt in range(1, n_obs):
+        e[:, tt] = 0.6 * e[:, tt - 1] + w[:, tt]
+    y = jnp.asarray(X @ beta + e, dtype)
+    Xj = jnp.asarray(X, dtype)
+
+    def reg_and_tests(v):
+        m = regression_arima.fit_cochrane_orcutt(v, Xj, 10)
+        adf, _ = stats.adftest(v, 4)
+        kpss, _ = stats.kpsstest(v, "c")
+        return m.arima_coeff, adf, kpss
+
+    dt, _ = _timed(jax.jit(lambda v: reg_and_tests(v)[0]), y)
+    results.append(("RegressionARIMA + ADF/KPSS", n, n_obs, n / dt))
+
+    for name, n, n_obs, rate in results:
+        print(json.dumps({
+            "metric": f"{name} series/sec/chip ({n}x{n_obs})",
+            "value": round(rate, 1),
+            "unit": "series/sec",
+        }))
+
+
+if __name__ == "__main__":
+    main()

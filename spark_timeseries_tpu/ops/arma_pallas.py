@@ -190,9 +190,7 @@ def css_normal_equations(params: jnp.ndarray, y: jnp.ndarray,
     S, n_obs = y.shape
     rows = _block_rows(S)
     params_b, n_blocks, _ = _blocked(params.astype(jnp.float32), S, rows)
-    y_b = jnp.moveaxis(
-        jnp.pad(y.astype(jnp.float32), [(0, (-S) % (rows * LANES)), (0, 0)]),
-        0, -1).reshape(n_obs, n_blocks, rows, LANES)
+    y_b, _, _ = _blocked(y.astype(jnp.float32), S, rows)
 
     call = _build_call(p, q, icpt, n_obs, n_blocks, rows, True, interpret)
     out = call(params_b, y_b)                       # (n_out, nb, 8, 128)
@@ -221,9 +219,7 @@ def css_cost(params: jnp.ndarray, y: jnp.ndarray,
     S, n_obs = y.shape
     rows = _block_rows(S)
     params_b, n_blocks, _ = _blocked(params.astype(jnp.float32), S, rows)
-    y_b = jnp.moveaxis(
-        jnp.pad(y.astype(jnp.float32), [(0, (-S) % (rows * LANES)), (0, 0)]),
-        0, -1).reshape(n_obs, n_blocks, rows, LANES)
+    y_b, _, _ = _blocked(y.astype(jnp.float32), S, rows)
     call = _build_call(p, q, icpt, n_obs, n_blocks, rows, False, interpret)
     out = call(params_b, y_b)
     return out.reshape(out.shape[0], -1)[0, :S]

@@ -72,9 +72,11 @@ def ar1_filter(x: jnp.ndarray, c, phi, axis: int = -1) -> jnp.ndarray:
     x = jnp.asarray(x)
     c = jnp.asarray(c)
     phi = jnp.asarray(phi)
-    if phi.ndim and axis in (-1, x.ndim - 1):
-        phi = phi[..., None]
-        c = c[..., None]
+    if axis in (-1, x.ndim - 1):
+        if phi.ndim:
+            phi = phi[..., None]
+        if c.ndim:
+            c = c[..., None]
     a = jnp.broadcast_to(phi, x.shape)
     b = x + c
     return linear_recurrence(a, b, axis=axis)
@@ -90,10 +92,13 @@ def garch_variance(errors: jnp.ndarray, omega, alpha, beta,
     omega = jnp.asarray(omega)
     alpha = jnp.asarray(alpha)
     beta = jnp.asarray(beta)
-    if beta.ndim and axis in (-1, e.ndim - 1):
-        omega = omega[..., None]
-        alpha = alpha[..., None]
-        beta = beta[..., None]
+    if axis in (-1, e.ndim - 1):
+        if omega.ndim:
+            omega = omega[..., None]
+        if alpha.ndim:
+            alpha = alpha[..., None]
+        if beta.ndim:
+            beta = beta[..., None]
     e2_prev = jnp.concatenate(
         [jnp.zeros_like(jnp.take(e, jnp.asarray([0]), axis=axis)),
          jnp.take(e, jnp.arange(e.shape[axis] - 1), axis=axis) ** 2],

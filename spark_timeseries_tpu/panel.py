@@ -107,6 +107,17 @@ class Panel:
         sharding = NamedSharding(mesh, P(axis_name, None))
         return self._with(values=jax.device_put(self.values, sharding))
 
+    def to_row_matrix(self) -> jnp.ndarray:
+        """Time-major ``(n_obs, n_series)`` matrix — the ``toRowMatrix``
+        bridge (ref ``TimeSeriesRDD.scala:482-486``); requires no distributed
+        matrix type here, the array IS the matrix."""
+        return self.to_time_major()
+
+    def to_indexed_row_matrix(self) -> jnp.ndarray:
+        """Alias of :meth:`to_row_matrix` (ref ``TimeSeriesRDD.scala:456-471``
+        — the row index is the position in the time axis)."""
+        return self.to_time_major()
+
     def to_time_major(self) -> jnp.ndarray:
         """``(n_obs, n_series)`` view — the reference's ``toInstants`` shuffle
         transpose (ref ``TimeSeriesRDD.scala:276-391``) collapses to one

@@ -61,7 +61,7 @@ def test_garch_variance_matches_model_recurrence():
     np.testing.assert_allclose(np.asarray(h_par), h_ref, rtol=1e-8)
 
 
-def test_time_sharded_recurrence(mesh):
+def test_time_sharded_recurrence():
     # the sequence-parallel claim: the scan runs with the TIME axis sharded
     # over the mesh, XLA inserting the cross-shard combine
     m = parallel.make_mesh(2, 4)     # 4-way time sharding
