@@ -13,13 +13,13 @@ from .arima import ARIMAModel
 from .arimax import ARIMAXModel
 from .autoregression import ARModel
 from .autoregression_x import ARXModel
-from .base import TimeSeriesModel
+from .base import FitDiagnostics, TimeSeriesModel
 from .ewma import EWMAModel
 from .garch import ARGARCHModel, EGARCHModel, GARCHModel
 from .holt_winters import HoltWintersModel
 from .regression_arima import RegressionARIMAModel
 
-__all__ = ["TimeSeriesModel", "ewma", "EWMAModel",
+__all__ = ["TimeSeriesModel", "FitDiagnostics", "ewma", "EWMAModel",
            "autoregression", "ARModel",
            "autoregression_x", "ARXModel",
            "arima", "ARIMAModel", "arimax", "ARIMAXModel",

@@ -42,6 +42,7 @@ from ..ops.univariate import (differences_of_order_d,
                               inverse_differences_of_order_d)
 from ..stats import kpsstest
 from . import autoregression
+from .base import FitDiagnostics, diagnostics_from
 
 
 # ---------------------------------------------------------------------------
@@ -111,7 +112,14 @@ def _log_likelihood_css_arma(params: jnp.ndarray, diffed: jnp.ndarray,
                              p: int, q: int, icpt: int) -> jnp.ndarray:
     """CSS log likelihood of an ARMA(p, q) on an already-differenced series
     (ref ``ARIMA.scala:430-445``): residuals for t < max(p, q) are dropped,
-    ``sigma² = css / n``."""
+    ``sigma² = css / n``.
+
+    Deliberate deviation (like the other documented reference-bug fixes):
+    the leading factor is the real ``-n / 2.0`` — the reference's
+    ``-n / 2`` is Scala *integer* division (``ARIMA.scala:444``), so for
+    odd-length series its likelihood (and ``approxAIC``) is off by
+    ``0.5·log(2π·sigma²)``; model-selection thresholds tuned against
+    reference AIC values can differ by that amount."""
     n = diffed.shape[-1]
     _, err = _one_step_errors(params, diffed, p, q, icpt)
     css = jnp.sum(err * err)
@@ -332,6 +340,7 @@ class ARIMAModel(NamedTuple):
     q: int
     coefficients: jnp.ndarray
     has_intercept: bool = True
+    diagnostics: Optional["FitDiagnostics"] = None
 
     @property
     def _icpt(self) -> int:
@@ -504,19 +513,27 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
     diffed = differences_of_order_d(ts, d)[..., d:]
 
     if p > 0 and q == 0 and user_init_params is None:
-        # AR fast path (ref ARIMA.scala:90-96)
+        # AR fast path (ref ARIMA.scala:90-96); OLS is direct, so the
+        # diagnostics mark every finite lane converged in 0 iterations
         ar = autoregression.fit(diffed, p, no_intercept=not include_intercept)
         parts = ([jnp.asarray(ar.c)[..., None]] if include_intercept else []) \
             + [jnp.atleast_1d(ar.coefficients)]
-        model = ARIMAModel(p, d, q, jnp.concatenate(parts, axis=-1),
-                           include_intercept)
+        coefs = jnp.concatenate(parts, axis=-1)
+        lane_ok = jnp.all(jnp.isfinite(coefs), axis=-1)
+        model = ARIMAModel(p, d, q, coefs, include_intercept)
+        fun = -model.log_likelihood_css_arma(diffed)
+        model = model._replace(diagnostics=FitDiagnostics(
+            lane_ok, jnp.zeros(lane_ok.shape, jnp.int32), fun))
         _warn_stationarity_invertibility(model, warn)
         return model
 
     dim = p + q + icpt
     if dim == 0:
-        return ARIMAModel(p, d, q, jnp.zeros((*ts.shape[:-1], 0), ts.dtype),
-                          include_intercept)
+        model = ARIMAModel(p, d, q, jnp.zeros((*ts.shape[:-1], 0), ts.dtype),
+                           include_intercept)
+        fun = -model.log_likelihood_css_arma(diffed)
+        return model._replace(diagnostics=FitDiagnostics(
+            jnp.isfinite(fun), jnp.zeros(fun.shape, jnp.int32), fun))
 
     if user_init_params is None:
         init = hannan_rissanen_init(p, q, diffed, include_intercept)
@@ -546,7 +563,8 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
     # partially-NaN result never yields a mixed coefficient vector
     lane_ok = jnp.all(jnp.isfinite(res.x), axis=-1, keepdims=True)
     params = jnp.where(lane_ok, res.x, init)
-    model = ARIMAModel(p, d, q, params, include_intercept)
+    model = ARIMAModel(p, d, q, params, include_intercept,
+                       diagnostics=diagnostics_from(res, lane_ok))
     _warn_stationarity_invertibility(model, warn)
     return model
 

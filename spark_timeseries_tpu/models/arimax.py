@@ -47,6 +47,7 @@ from jax import lax
 
 from ..ops.univariate import differences_of_order_d
 from . import autoregression_x
+from .base import FitDiagnostics, diagnostics_from
 from .arima import (LM_MAX_ITER, _add_effects_one, _batched,
                     _difference_rows, _log_likelihood_css_arma,
                     _one_step_errors, _remove_effects_one,
@@ -87,6 +88,7 @@ class ARIMAXModel(NamedTuple):
     coefficients: jnp.ndarray
     include_original_xreg: bool = True
     has_intercept: bool = True
+    diagnostics: Optional["FitDiagnostics"] = None
 
     @property
     def _n_arma(self) -> int:
@@ -169,11 +171,16 @@ class ARIMAXModel(NamedTuple):
         """
         ts = jnp.asarray(ts)
         xreg = jnp.asarray(xreg)
-        if ts.ndim > 1 or jnp.asarray(self.coefficients).ndim > 1:
-            return _batched(
-                lambda prm, y: self._forecast_one(prm, y, xreg),
-                jnp.asarray(self.coefficients), ts)
-        return self._forecast_one(jnp.asarray(self.coefficients), ts, xreg)
+        coefs = jnp.asarray(self.coefficients)
+        p_b, t_b, x_b = coefs.ndim > 1, ts.ndim > 1, xreg.ndim > 2
+        if not (p_b or t_b or x_b):
+            return self._forecast_one(coefs, ts, xreg)
+        # a per-series xreg (..., n, k) — which fit() supports — must be
+        # vmapped alongside params/ts, not closed over (it would otherwise
+        # mis-broadcast inside the per-lane forecast)
+        return jax.vmap(self._forecast_one,
+                        in_axes=(0 if p_b else None, 0 if t_b else None,
+                                 0 if x_b else None))(coefs, ts, xreg)
 
     def _forecast_one(self, params: jnp.ndarray, ts: jnp.ndarray,
                       xreg: jnp.ndarray) -> jnp.ndarray:
@@ -274,8 +281,15 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray, xreg: jnp.ndarray,
             raise ValueError(f"unknown method {method!r}")
         lane_ok = jnp.all(jnp.isfinite(res.x), axis=-1, keepdims=True)
         refined = jnp.where(lane_ok, res.x, init)
+        diag = diagnostics_from(res, lane_ok)
     else:
+        # nothing to refine (p = q = 0, no intercept): the fit is the direct
+        # ARX solve; report its residual CSS so fit_report still works
         refined = init
+        fun = jnp.sum(adjusted * adjusted, axis=-1)
+        diag = FitDiagnostics(
+            jnp.all(jnp.isfinite(bx), axis=-1) & jnp.isfinite(fun),
+            jnp.zeros(fun.shape, jnp.int32), fun)
 
     if include_intercept:
         full = jnp.concatenate([refined, bx], axis=-1)
@@ -283,4 +297,4 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray, xreg: jnp.ndarray,
         zero_c = jnp.zeros((*ts.shape[:-1], 1), ts.dtype)
         full = jnp.concatenate([zero_c, refined, bx], axis=-1)
     return ARIMAXModel(p, d, q, xreg_max_lag, full, include_original_xreg,
-                       include_intercept)
+                       include_intercept, diagnostics=diag)

@@ -30,6 +30,12 @@ def assemble_predictors(y: jnp.ndarray, x: jnp.ndarray, y_max_lag: int,
     max_lag = max(y_max_lag, x_max_lag)
     rows = n - max_lag
 
+    # a shared unbatched design x (n, k) broadcasts over y's batch dims
+    # (and vice versa) so the column concat below sees uniform ranks
+    batch = jnp.broadcast_shapes(y.shape[:-1], x.shape[:-2])
+    y = jnp.broadcast_to(y, (*batch, n))
+    x = jnp.broadcast_to(x, (*batch, *x.shape[-2:]))
+
     if y_max_lag > 0:
         ar_y = lag_matrix(y, y_max_lag)[..., max_lag - y_max_lag:, :]
     else:

@@ -16,9 +16,38 @@ changes:
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, NamedTuple, Optional
 
 import jax.numpy as jnp
+
+
+class FitDiagnostics(NamedTuple):
+    """Per-lane optimizer outcome attached to every fitted model — the
+    batched replacement for the reference's per-series ``println`` warnings
+    and swallowed optimizer state (ref ``ARIMA.scala:246-256``).
+
+    ``converged`` is False both for lanes whose optimizer hit its iteration
+    cap and for lanes that were quarantined back to their initial guess
+    (non-finite result); ``fun`` is the objective at the returned parameters.
+    """
+    converged: jnp.ndarray   # bool (...,)
+    n_iter: jnp.ndarray      # (...,)
+    fun: jnp.ndarray         # (...,)
+
+
+def diagnostics_from(res, lane_ok=None) -> FitDiagnostics:
+    """Build :class:`FitDiagnostics` from a ``MinimizeResult``; ``lane_ok``
+    (the quarantine mask, True = kept the optimizer's result) demotes
+    quarantined lanes to non-converged."""
+    converged = jnp.asarray(res.converged)
+    if lane_ok is not None:
+        converged = converged & jnp.reshape(jnp.asarray(lane_ok),
+                                            converged.shape)
+    fun = jnp.asarray(res.fun)
+    # a lane whose objective is non-finite (e.g. an all-NaN series) may
+    # still trip the optimizer's "pinned" exit; it has not converged
+    return FitDiagnostics(converged & jnp.isfinite(fun),
+                          jnp.asarray(res.n_iter), fun)
 
 
 class TimeSeriesModel:

@@ -14,7 +14,7 @@ panel (one compiled program fits every series at once).
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -22,12 +22,19 @@ from jax import lax
 
 from ..ops.optimize import (minimize_bfgs, minimize_box,
                             minimize_least_squares)
+from .base import FitDiagnostics, diagnostics_from
+
+# floor for the smoothing parameter when *inverting* the recurrence: the
+# box method's lower bound (EWMA.scala's unbounded CGD shares the hazard —
+# a lane at a≈0 would emit inf when dividing by it)
+SMOOTHING_FLOOR = 1e-4
 
 
 class EWMAModel(NamedTuple):
     """Smoothing parameter ``a``: scalar for one series, ``(n_series,)`` for
     a batched panel fit (ref ``EWMA.scala:75``)."""
     smoothing: jnp.ndarray
+    diagnostics: Optional[FitDiagnostics] = None
 
     def add_time_dependent_effects(self, ts: jnp.ndarray) -> jnp.ndarray:
         """Smooth i.i.d. observations: ``S_t = a X_t + (1-a) S_{t-1}``
@@ -45,10 +52,14 @@ class EWMAModel(NamedTuple):
 
     def remove_time_dependent_effects(self, ts: jnp.ndarray) -> jnp.ndarray:
         """Invert the smoothing recurrence — elementwise, no scan needed
-        (ref ``EWMA.scala:125-133``)."""
+        (ref ``EWMA.scala:125-133``).  The divisor is floored at
+        ``SMOOTHING_FLOOR`` so an unconstrained-fit lane at ``a≈0`` yields a
+        large-but-finite inversion instead of inf poisoning the batch."""
         a = jnp.asarray(self.smoothing)
         if a.ndim and ts.ndim > 1:
             a = a[..., None]
+        a = jnp.where(a >= 0, jnp.maximum(a, SMOOTHING_FLOOR),
+                      jnp.minimum(a, -SMOOTHING_FLOOR))
         prev = ts[..., :-1]
         rest = (ts[..., 1:] - (1.0 - a) * prev) / a
         return jnp.concatenate([ts[..., :1], rest], axis=-1)
@@ -95,7 +106,12 @@ def fit(ts: jnp.ndarray, init: float = 0.94, tol: float = 1e-9,
         res = minimize_bfgs(objective, x0, ts, tol=tol, max_iter=max_iter)
     else:
         raise ValueError(f"unknown method {method!r}")
-    return EWMAModel(res.x[..., 0])
+    # per-lane quarantine: a diverged lane falls back to the initial guess
+    # instead of emitting NaN smoothing (same policy as the ARIMA/GARCH fits)
+    lane_ok = jnp.all(jnp.isfinite(res.x), axis=-1, keepdims=True)
+    params = jnp.where(lane_ok, res.x, x0)
+    return EWMAModel(params[..., 0],
+                     diagnostics=diagnostics_from(res, lane_ok))
 
 
 def fit_panel(panel) -> EWMAModel:

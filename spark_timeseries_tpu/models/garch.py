@@ -18,7 +18,7 @@ beta) params; autodiff is both simpler and actually consistent).
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +26,7 @@ from jax import lax
 
 from ..ops.optimize import minimize_bfgs
 from . import autoregression
+from .base import FitDiagnostics, diagnostics_from
 
 
 def _move(ts):
@@ -38,6 +39,7 @@ class GARCHModel(NamedTuple):
     omega: jnp.ndarray
     alpha: jnp.ndarray
     beta: jnp.ndarray
+    diagnostics: Optional[FitDiagnostics] = None
 
     @property
     def _params(self):
@@ -76,11 +78,17 @@ class GARCHModel(NamedTuple):
             return GARCHModel(params[..., 0], params[..., 1],
                               params[..., 2]).log_likelihood(series)
 
+        # batch = broadcast of the parameter batch dims and ts's leading dims
+        # (scalar params with a batched ts must still vmap over the series)
+        ts = jnp.asarray(ts)
         packed = jnp.stack(jnp.broadcast_arrays(*self._params), axis=-1)
+        batch = jnp.broadcast_shapes(packed.shape[:-1], ts.shape[:-1])
+        packed = jnp.broadcast_to(packed, (*batch, packed.shape[-1]))
+        ts = jnp.broadcast_to(ts, (*batch, ts.shape[-1]))
         g = jax.grad(ll)
-        for _ in range(packed.ndim - 1):
+        for _ in range(len(batch)):
             g = jax.vmap(g)
-        return g(packed, jnp.asarray(ts))
+        return g(packed, ts)
 
     def remove_time_dependent_effects(self, ts: jnp.ndarray) -> jnp.ndarray:
         """Standardize: divide each observation by its conditional volatility
@@ -183,7 +191,8 @@ def fit(ts: jnp.ndarray, init=(0.2, 0.2, 0.2), tol: float = 1e-6,
     res = minimize_bfgs(neg_ll, x0, ts, tol=tol, max_iter=max_iter)
     ok = jnp.all(jnp.isfinite(res.x), axis=-1, keepdims=True)
     params = jnp.where(ok, res.x, x0)
-    return GARCHModel(*_constrain(params))
+    return GARCHModel(*_constrain(params),
+                      diagnostics=diagnostics_from(res, ok))
 
 
 def fit_panel(panel) -> GARCHModel:
@@ -199,6 +208,7 @@ class ARGARCHModel(NamedTuple):
     omega: jnp.ndarray
     alpha: jnp.ndarray
     beta: jnp.ndarray
+    diagnostics: Optional[FitDiagnostics] = None
 
     def _h0(self):
         return jnp.asarray(self.omega) / \
@@ -281,7 +291,8 @@ def fit_ar_garch(ts: jnp.ndarray) -> ARGARCHModel:
     residuals = ar.remove_time_dependent_effects(ts)
     g = fit(residuals)
     return ARGARCHModel(ar.c, jnp.asarray(ar.coefficients)[..., 0],
-                        g.omega, g.alpha, g.beta)
+                        g.omega, g.alpha, g.beta,
+                        diagnostics=g.diagnostics)
 
 
 def fit_ar_garch_panel(panel) -> ARGARCHModel:

@@ -16,7 +16,7 @@ the scan.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -24,6 +24,7 @@ from jax import lax
 
 from ..ops.lag import lag_matrix
 from ..ops.optimize import minimize_box
+from .base import FitDiagnostics, diagnostics_from
 
 
 def _kernel(period: int) -> np.ndarray:
@@ -43,6 +44,7 @@ class HoltWintersModel(NamedTuple):
     alpha: jnp.ndarray
     beta: jnp.ndarray
     gamma: jnp.ndarray
+    diagnostics: Optional[FitDiagnostics] = None
 
     @property
     def additive(self) -> bool:
@@ -189,7 +191,7 @@ def fit(ts: jnp.ndarray, period: int, model_type: str = "additive",
     ok = jnp.all(jnp.isfinite(res.x), axis=-1, keepdims=True)
     p = jnp.where(ok, res.x, x0)
     return HoltWintersModel(model_type, period, p[..., 0], p[..., 1],
-                            p[..., 2])
+                            p[..., 2], diagnostics=diagnostics_from(res, ok))
 
 
 def fit_panel(panel, period: int, model_type: str = "additive",

@@ -15,12 +15,13 @@ data-dependent early exit.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax.numpy as jnp
 
 from ..ops.linalg import ols
 from ..stats import dwtest
+from .base import FitDiagnostics
 
 DW_MARGIN = 0.05
 RHO_DIFF_THRESHOLD = 0.001
@@ -40,6 +41,7 @@ class RegressionARIMAModel(NamedTuple):
     regression_coeff: jnp.ndarray
     arima_orders: Tuple[int, int, int]
     arima_coeff: jnp.ndarray
+    diagnostics: Optional[FitDiagnostics] = None
 
     def add_time_dependent_effects(self, ts):
         raise NotImplementedError(
@@ -94,8 +96,10 @@ def fit_cochrane_orcutt(ts: jnp.ndarray, regressors: jnp.ndarray,
 
     finished = ~_is_autocorrelated(resid)
     rho = jnp.zeros(y.shape[:-1], y.dtype)
+    n_done = jnp.zeros(y.shape[:-1], jnp.int32)
 
     for it in range(max_iter):
+        n_done = n_done + (~finished).astype(jnp.int32)
         # rho from e_t = rho·e_{t-1} (no-intercept simple regression)
         e_prev, e_cur = resid[..., :-1], resid[..., 1:]
         rho_new = jnp.sum(e_prev * e_cur, axis=-1) / \
@@ -128,7 +132,9 @@ def fit_cochrane_orcutt(ts: jnp.ndarray, regressors: jnp.ndarray,
         rho = jnp.where(upd, rho_new, rho)
         finished = finished | now_finished
 
-    return RegressionARIMAModel(beta, (1, 0, 0), rho)
+    diag = FitDiagnostics(finished, n_done,
+                          jnp.sum(resid * resid, axis=-1))
+    return RegressionARIMAModel(beta, (1, 0, 0), rho, diagnostics=diag)
 
 
 def fit_panel(panel, regressors, max_iter: int = 10) -> RegressionARIMAModel:

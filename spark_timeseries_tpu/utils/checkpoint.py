@@ -2,14 +2,22 @@
 
 The reference has no model persistence at all (constructor args are the
 state; SURVEY.md §5 "checkpoint/resume") and delegates fault tolerance to
-Spark lineage re-execution.  Here every fitted model is a pytree of arrays,
-so checkpointing is orbax (or a plain ``.npz`` fallback) and restart
-semantics are "re-run the batched fit for any shard not in the checkpoint"
-— per-batch fits are idempotent.
+Spark lineage re-execution.  Here every fitted model is a pytree of arrays
+plus static metadata (orders, flags, model-type strings), so checkpointing
+writes the arrays to one ``.npz`` and a JSON *structure* sidecar that is
+sufficient to rebuild the tree — restore needs no caller-side knowledge of
+leaf order or model internals, and restart semantics are "re-run the batched
+fit for any shard not in the checkpoint" (per-batch fits are idempotent).
+
+Supported node types: numpy/JAX arrays, Python scalars (int/float/bool/str/
+None), lists, tuples, dicts with string keys, and NamedTuples (recorded by
+import path and re-imported on load — which covers every model class in
+``spark_timeseries_tpu.models``).
 """
 
 from __future__ import annotations
 
+import importlib
 import json
 import os
 from typing import Any
@@ -18,38 +26,113 @@ import jax
 import numpy as np
 
 
+def _is_namedtuple(node: Any) -> bool:
+    return isinstance(node, tuple) and hasattr(node, "_fields")
+
+
+def _encode(node: Any, arrays: list) -> Any:
+    """Recursively encode a pytree into a JSON-able structure spec; array
+    leaves are appended to ``arrays`` and referenced by position."""
+    if isinstance(node, (np.ndarray, jax.Array)):
+        arrays.append(np.asarray(node))
+        return {"k": "arr", "i": len(arrays) - 1}
+    if isinstance(node, np.generic):            # numpy scalar -> 0-d array
+        arrays.append(np.asarray(node))
+        return {"k": "arr", "i": len(arrays) - 1}
+    if node is None or isinstance(node, (bool, int, float, str)):
+        return {"k": "py", "v": node}
+    if _is_namedtuple(node):
+        cls = type(node)
+        return {"k": "nt", "cls": f"{cls.__module__}:{cls.__qualname__}",
+                "items": [_encode(v, arrays) for v in node]}
+    if isinstance(node, tuple):
+        return {"k": "tuple", "items": [_encode(v, arrays) for v in node]}
+    if isinstance(node, list):
+        return {"k": "list", "items": [_encode(v, arrays) for v in node]}
+    if isinstance(node, dict):
+        if not all(isinstance(key, str) for key in node):
+            raise TypeError("checkpoint dicts must have string keys")
+        return {"k": "dict",
+                "items": {key: _encode(v, arrays) for key, v in node.items()}}
+    raise TypeError(f"cannot checkpoint node of type {type(node).__name__}")
+
+
+def _decode(spec: Any, arrays: dict) -> Any:
+    kind = spec["k"]
+    if kind == "arr":
+        return arrays[f"leaf_{spec['i']}"]
+    if kind == "py":
+        return spec["v"]
+    if kind == "nt":
+        mod_name, _, qualname = spec["cls"].partition(":")
+        obj = importlib.import_module(mod_name)
+        for part in qualname.split("."):
+            obj = getattr(obj, part)
+        # the sidecar names an import path; only ever call an actual
+        # NamedTuple class, never an arbitrary resolved callable
+        if not (isinstance(obj, type) and issubclass(obj, tuple)
+                and hasattr(obj, "_fields")):
+            raise ValueError(
+                f"checkpoint names {spec['cls']!r}, which is not a "
+                "NamedTuple class — refusing to call it")
+        return obj(*(_decode(s, arrays) for s in spec["items"]))
+    if kind == "tuple":
+        return tuple(_decode(s, arrays) for s in spec["items"])
+    if kind == "list":
+        return [_decode(s, arrays) for s in spec["items"]]
+    if kind == "dict":
+        return {key: _decode(s, arrays) for key, s in spec["items"].items()}
+    raise ValueError(f"unknown checkpoint node kind {kind!r}")
+
+
 def save_pytree(path: str, tree: Any) -> None:
-    """Save an arbitrary pytree of arrays/scalars as ``<path>.npz`` plus a
-    ``<path>.tree.json`` structure sidecar."""
-    leaves, treedef = jax.tree_util.tree_flatten(tree)
-    arrays = {f"leaf_{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
-    np.savez(path + ".npz", **arrays)
+    """Save an arbitrary pytree as ``<path>.npz`` (array leaves) plus a
+    ``<path>.tree.json`` structure sidecar that fully describes the tree."""
+    arrays: list = []
+    spec = _encode(tree, arrays)
+    np.savez(path + ".npz", **{f"leaf_{i}": a for i, a in enumerate(arrays)})
     with open(path + ".tree.json", "w") as f:
-        json.dump({"treedef": str(treedef), "n_leaves": len(leaves)}, f)
+        json.dump({"format": 2, "spec": spec, "n_leaves": len(arrays)}, f)
+
+
+def load_pytree(path: str) -> Any:
+    """Rebuild the exact pytree saved by :func:`save_pytree` — structure,
+    static Python fields, and array leaves — with no caller-side knowledge."""
+    with open(path + ".tree.json") as f:
+        meta = json.load(f)
+    if "spec" not in meta:
+        raise ValueError(
+            f"{path}.tree.json is a format-1 checkpoint (opaque treedef); "
+            "re-save it, or read the leaves directly with load_leaves()")
+    with np.load(path + ".npz") as data:
+        arrays = {name: data[name] for name in data.files}
+    return _decode(meta["spec"], arrays)
 
 
 def load_leaves(path: str) -> list:
-    """Load the leaf arrays saved by :func:`save_pytree` (in order).  Callers
-    rebuild their model types from the leaves (NamedTuple models: ``M(*leaves)``)."""
+    """Load just the array leaves saved by :func:`save_pytree` (in order) —
+    the escape hatch for format-1 checkpoints whose structure sidecar is
+    opaque."""
     with np.load(path + ".npz") as data:
         return [data[f"leaf_{i}"] for i in range(len(data.files))]
 
 
 def save_model(path: str, model: Any) -> None:
-    """Save a NamedTuple model with its class name recorded for sanity
-    checks on restore."""
-    save_pytree(path, tuple(model))
+    """Save a model (NamedTuple pytree) with its class name recorded for
+    sanity checks on restore."""
+    save_pytree(path, model)
     with open(path + ".meta.json", "w") as f:
         json.dump({"class": type(model).__name__}, f)
 
 
-def load_model(path: str, model_cls: type) -> Any:
-    """Restore a NamedTuple model saved by :func:`save_model`."""
+def load_model(path: str, model_cls: type | None = None) -> Any:
+    """Restore a model saved by :func:`save_model`; ``model_cls`` (optional)
+    is checked against the recorded class name."""
     meta_path = path + ".meta.json"
-    if os.path.exists(meta_path):
+    if model_cls is not None and os.path.exists(meta_path):
         with open(meta_path) as f:
             recorded = json.load(f).get("class")
         if recorded != model_cls.__name__:
             raise ValueError(
                 f"checkpoint holds a {recorded}, not a {model_cls.__name__}")
-    return model_cls(*load_leaves(path))
+    return load_pytree(path)

@@ -58,12 +58,27 @@ def timed(fn: Callable, *args, warmup: int = 1, iters: int = 3,
             "result": result}
 
 
-def fit_report(minimize_result) -> Dict[str, Any]:
-    """Convergence counters for a batched :class:`MinimizeResult` — the
-    batched answer to the reference's per-series println warnings."""
-    converged = np.asarray(minimize_result.converged)
-    n_iter = np.asarray(minimize_result.n_iter)
-    fun = np.asarray(minimize_result.fun)
+def fit_report(result_or_model) -> Dict[str, Any]:
+    """Convergence counters — the batched answer to the reference's
+    per-series println warnings (ref ``ARIMA.scala:246-256``).
+
+    Accepts a batched ``MinimizeResult``, a ``FitDiagnostics``, or any fitted
+    model (every ``fit``/``fit_panel`` attaches ``model.diagnostics``), so
+    counting non-converged lanes is one call on the public fit output::
+
+        model = arima.fit_panel(panel, 2, 1, 2)
+        report = fit_report(model)          # {"n_converged": ..., ...}
+    """
+    diag = getattr(result_or_model, "diagnostics", None)
+    if diag is not None:
+        result_or_model = diag
+    if not hasattr(result_or_model, "converged"):
+        raise TypeError(
+            f"{type(result_or_model).__name__} carries no fit diagnostics "
+            "(was it produced by a fit()?)")
+    converged = np.asarray(result_or_model.converged)
+    n_iter = np.asarray(result_or_model.n_iter)
+    fun = np.asarray(result_or_model.fun)
     report = {
         "n_series": int(converged.size),
         "n_converged": int(np.sum(converged)),

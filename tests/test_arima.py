@@ -1,7 +1,11 @@
 """ARIMA tier tests — contracts mirror the reference's ``ARIMASuite``
-(ref /root/reference/src/test/scala/com/cloudera/sparkts/models/ARIMASuite.scala),
-with seeded sample→refit property tests replacing the R CSV fixtures (same
-philosophy: recover known generating parameters within tolerance)."""
+(ref /root/reference/src/test/scala/com/cloudera/sparkts/models/ARIMASuite.scala):
+the R-generated golden fixtures (``tests/resources/R_ARIMA_DataSet{1,2}.csv``,
+the shared numerical contract — R's ``arima.sim`` with documented seeds) anchor
+the fits against numbers not produced by this codebase, and seeded
+sample→refit property tests cover the rest."""
+
+import os
 
 import jax
 import jax.numpy as jnp
@@ -11,6 +15,42 @@ import pytest
 from spark_timeseries_tpu.models import arima
 from spark_timeseries_tpu.ops.univariate import (
     differences_of_order_d, inverse_differences_of_order_d)
+
+RESOURCES = os.path.join(os.path.dirname(__file__), "resources")
+
+
+def _load_r_fixture(name: str) -> jnp.ndarray:
+    return jnp.asarray(np.loadtxt(os.path.join(RESOURCES, name)))
+
+
+def test_compare_with_r_arma11():
+    """ref ARIMASuite.scala:28-41 — R: set.seed(456);
+    y <- arima.sim(n=250, list(ar=0.3, ma=0.7), mean=5)."""
+    data = _load_r_fixture("R_ARIMA_DataSet1.csv")
+    model = arima.fit(1, 0, 1, data)
+    c, ar, ma = np.asarray(model.coefficients)
+    assert abs(ar - 0.3) < 0.05
+    assert abs(ma - 0.7) < 0.05
+
+
+def test_fit_integrated_series_of_order_3_vs_r():
+    """ref ARIMASuite.scala:134-156 — R: set.seed(10);
+    vals <- arima.sim(list(ma=c(0.2), order=c(0,3,1)), 200); R's CSS fit
+    reports ma1 = 0.2523 (s.e. 0.0623)."""
+    data = _load_r_fixture("R_ARIMA_DataSet2.csv")
+    model = arima.fit(0, 3, 1, data)
+    c, ma = np.asarray(model.coefficients)
+    assert abs(ma - 0.2) < 0.05          # reference's assertion
+    assert abs(ma - 0.2523) < 0.03       # R's own CSS point estimate
+
+
+def test_i3_differencing_round_trip_on_r_fixture():
+    """Order-3 difference/inverse round trip on the R fixture (the data half
+    of ARIMASuite.scala:134-156)."""
+    data = _load_r_fixture("R_ARIMA_DataSet2.csv")
+    diffed = differences_of_order_d(data, 3)
+    back = inverse_differences_of_order_d(diffed, 3)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(data), atol=1e-8)
 
 
 def test_sample_then_fit_recovers_parameters():
