@@ -1,12 +1,19 @@
-"""Extended benchmark suite — the five BASELINE.md configs.
+"""Extended benchmark suite — the five BASELINE.md configs, each with a
+measured CPU-baseline ratio.
 
 ``python benchmarks/bench_suite.py`` prints one JSON line per config:
 EWMA, ARIMA (the headline, same as bench.py), Holt-Winters seasonal,
-AR-GARCH volatility, and RegressionARIMA + stationarity tests.  Synthetic
-panels stand in for the M4/minute-bar datasets (zero-egress environment);
-shapes are chosen to match their scale profile.  All timings are to host
-materialization (the tunneled TPU platform does not synchronize on
-block_until_ready alone).
+AR-GARCH volatility, and RegressionARIMA + stationarity tests — plus the
+batched auto-ARIMA order search.  Synthetic panels stand in for the
+M4/minute-bar datasets (zero-egress environment); shapes match their scale
+profile.  All timings are to host materialization (the tunneled TPU platform
+does not synchronize on block_until_ready alone).
+
+BASELINE.md requires every config to "run on both the reference CPU path and
+the new TPU path": the reference publishes no numbers and is a JVM library,
+so its per-series scalar path (Commons-Math CGD/BOBYQA loops, numpy-scalar
+recurrences) is emulated per model on a pinned subsample and extrapolated;
+each output line carries ``vs_baseline`` and the emulation's sample size.
 """
 
 import json
@@ -17,6 +24,8 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BASELINE_SAMPLE = 6
 
 
 def _timed(fn, *args, reps=3):
@@ -32,6 +41,177 @@ def _timed(fn, *args, reps=3):
     return (time.perf_counter() - t0) / reps, out
 
 
+def _baseline(per_series_fn, panel: np.ndarray,
+              sample: int = BASELINE_SAMPLE) -> tuple:
+    """Time ``per_series_fn(row)`` over a pinned subsample; returns
+    (series/sec, sample) for the emulated reference CPU path."""
+    sub = panel[:sample]
+    t0 = time.perf_counter()
+    for row in sub:
+        per_series_fn(np.asarray(row, np.float64))
+    return sample / (time.perf_counter() - t0), sample
+
+
+# ---------------------------------------------------------------------------
+# per-series reference-path emulations (scalar numpy + scipy optimizers,
+# the Commons-Math cost shapes; no code shared with the JAX fits)
+# ---------------------------------------------------------------------------
+
+def _ewma_sse_scalar(a: float, x: np.ndarray) -> float:
+    """ref EWMA.scala:81-96 — sequential smoothing recurrence."""
+    s = x[0]
+    sse = 0.0
+    for t in range(1, x.shape[0]):
+        sse += (x[t] - s) ** 2
+        s = a * x[t] + (1.0 - a) * s
+    return sse
+
+
+def _ewma_baseline(row: np.ndarray) -> None:
+    from scipy.optimize import minimize_scalar
+    minimize_scalar(lambda a: _ewma_sse_scalar(a, row), bounds=(1e-4, 1.0),
+                    method="bounded", options={"xatol": 1e-6})
+
+
+def _hw_sse_scalar(params, x: np.ndarray, period: int) -> float:
+    """ref HoltWinters.scala:106-121,180-226 — additive triple smoothing."""
+    a, b, g = params
+    if not (0 <= a <= 1 and 0 <= b <= 1 and 0 <= g <= 1):
+        return np.inf
+    # moving-average detrend init (Hyndman recipe, as the reference does)
+    k = period
+    trend0 = np.convolve(x[:2 * k], np.full(k, 1.0 / k), mode="valid") \
+        if k % 2 else np.convolve(
+            x[:2 * k],
+            np.r_[0.5 / k, np.full(k - 1, 1.0 / k), 0.5 / k], mode="valid")
+    idx = np.arange(1, trend0.shape[0] + 1)
+    slope, intercept = np.polyfit(idx, trend0, 1)
+    level = intercept
+    trend = slope
+    pad = (len(x[:2 * k]) - len(trend0)) // 2
+    detrended = np.zeros(2 * k)
+    detrended[pad:pad + len(trend0)] = x[pad:pad + len(trend0)] - trend0
+    season = np.zeros(k)
+    for i in range(k):
+        season[i] = (detrended[i] + detrended[i + k]) / 2.0
+    season -= season.mean()
+    sse = 0.0
+    seasons = list(season)
+    for t in range(k, x.shape[0]):
+        s_i = seasons[0]
+        base = level + trend
+        sse += (x[t] - (base + s_i)) ** 2
+        new_level = a * (x[t] - s_i) + (1 - a) * base
+        new_trend = b * (new_level - level) + (1 - b) * trend
+        new_season = g * (x[t] - new_level) + (1 - g) * s_i
+        level, trend = new_level, new_trend
+        seasons = seasons[1:] + [new_season]
+    return sse
+
+
+def _hw_baseline_factory(period: int):
+    from scipy.optimize import minimize as sp_minimize
+
+    def run(row: np.ndarray) -> None:
+        sp_minimize(_hw_sse_scalar, np.array([0.3, 0.1, 0.1]),
+                    args=(row, period), method="Powell",
+                    bounds=[(0, 1)] * 3, options={"maxiter": 500})
+    return run
+
+
+def _garch_neg_ll_scalar(params, x: np.ndarray) -> float:
+    """ref GARCH.scala:82-129 — sequential variance recurrence."""
+    omega, alpha, beta = params
+    if omega <= 0 or alpha < 0 or beta < 0 or alpha + beta >= 1:
+        return np.inf
+    h = omega / (1.0 - alpha - beta)
+    ll = 0.0
+    for t in range(1, x.shape[0]):
+        h = omega + alpha * x[t - 1] ** 2 + beta * h
+        ll += -0.5 * np.log(h) - 0.5 * x[t] ** 2 / h
+    return -ll
+
+
+def _argarch_baseline(row: np.ndarray) -> None:
+    from scipy.optimize import minimize as sp_minimize
+    # stage 1: AR(1) OLS (ref GARCH.scala:63-69)
+    yprev, ycur = row[:-1], row[1:]
+    X = np.stack([np.ones_like(yprev), yprev], axis=1)
+    coef, *_ = np.linalg.lstsq(X, ycur, rcond=None)
+    resid = np.r_[row[0] - coef[0], ycur - X @ coef]
+    # stage 2: GARCH(1,1) MLE, derivative-free
+    sp_minimize(_garch_neg_ll_scalar, np.array([0.2, 0.2, 0.2]),
+                args=(resid,), method="Nelder-Mead",
+                options={"maxiter": 600})
+
+
+def _regarima_baseline_factory(X: np.ndarray, max_iter: int = 10,
+                               adf_lag: int = 4):
+    def dw(e: np.ndarray) -> float:
+        return np.sum(np.diff(e) ** 2) / np.sum(e ** 2)
+
+    def run(row: np.ndarray) -> None:
+        """ref RegressionARIMA.scala:83-160 per-series Cochrane-Orcutt, plus
+        the per-series ADF/KPSS OLS work the TPU config also computes
+        (ref TimeSeriesStatisticalTests.scala:209-242,369-394)."""
+        A = np.column_stack([np.ones(X.shape[0]), X])
+        beta, *_ = np.linalg.lstsq(A, row, rcond=None)
+        resid = row - A @ beta
+        if abs(dw(resid) - 2.0) >= 0.05:
+            rho_prev = 0.0
+            for it in range(max_iter):
+                e_prev, e_cur = resid[:-1], resid[1:]
+                rho = float(e_prev @ e_cur / (e_prev @ e_prev))
+                y_d = row[1:] - rho * row[:-1]
+                X_d = X[1:] - rho * X[:-1]
+                A_d = np.column_stack([np.ones(X_d.shape[0]), X_d])
+                b_d, *_ = np.linalg.lstsq(A_d, y_d, rcond=None)
+                b_d[0] /= (1.0 - rho)
+                resid = row - np.column_stack(
+                    [np.ones(X.shape[0]), X]) @ b_d
+                tres = y_d - A_d @ np.r_[b_d[0] * (1 - rho), b_d[1:]]
+                if abs(dw(tres) - 2.0) < 0.05 or \
+                        (it >= 1 and abs(rho - rho_prev) <= 0.001):
+                    break
+                rho_prev = rho
+
+        # ADF: OLS t-stat of the lagged level in the Dickey-Fuller design
+        dy = np.diff(row)
+        lvl = row[adf_lag:-1]
+        lags = np.column_stack([dy[adf_lag - k:len(dy) - k]
+                                for k in range(1, adf_lag + 1)])
+        D = np.column_stack([lvl, np.ones_like(lvl), lags])
+        target = dy[adf_lag:]
+        coef, *_ = np.linalg.lstsq(D, target, rcond=None)
+        r = target - D @ coef
+        s2 = (r @ r) / max(len(target) - D.shape[1], 1)
+        cov = s2 * np.linalg.inv(D.T @ D)
+        _ = coef[0] / np.sqrt(cov[0, 0])
+
+        # KPSS: demeaned cumsum statistic with Newey-West variance
+        e = row - row.mean()
+        s = np.cumsum(e)
+        n = len(row)
+        lags_nw = int(4 * (n / 100.0) ** 0.25)
+        var = (e @ e) / n
+        for k in range(1, lags_nw + 1):
+            w = 1.0 - k / (lags_nw + 1.0)
+            var += 2.0 * w * (e[k:] @ e[:-k]) / n
+        _ = (s @ s) / (n * n * var)
+    return run
+
+
+def _arima_baseline(row: np.ndarray) -> None:
+    # shares bench.py's scalar CSS objective so the headline vs_baseline and
+    # this config's ratio can never drift apart
+    from bench import _css_neg_ll
+    from scipy.optimize import minimize as sp_minimize
+    diffed = np.diff(row)
+    x0 = np.array([np.mean(diffed), 0.1, 0.1, 0.1, 0.1])
+    sp_minimize(_css_neg_ll, x0, args=(diffed,), method="Powell",
+                options={"maxiter": 2000})
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -42,7 +222,7 @@ def main():
                                              holt_winters,
                                              regression_arima)
 
-    dtype = jnp.float32 if jax.devices()[0].platform == "tpu" else jnp.float64
+    dtype = jnp.float32 if jax.devices()[0].platform != "cpu" else jnp.float64
     if dtype == jnp.float64:
         jax.config.update("jax_enable_x64", True)
     rng = np.random.default_rng(0)
@@ -53,15 +233,18 @@ def main():
     ar1 = np.cumsum(rng.normal(size=(n, n_obs)), axis=1) + 100.0
     vals = jnp.asarray(ar1, dtype)
     dt, _ = _timed(jax.jit(lambda v: ewma.fit(v).smoothing), vals)
-    results.append(("EWMA fit", n, n_obs, n / dt))
+    results.append(("EWMA fit", n, n_obs, n / dt,
+                    _baseline(_ewma_baseline, ar1)))
 
     # 2. ARIMA(2,1,2) (BASELINE config #2; headline, mirrors bench.py)
     n, n_obs = 8192, 128
-    vals = jnp.asarray(_synthetic_arima_panel(n, n_obs), dtype)
+    arima_panel = _synthetic_arima_panel(n, n_obs)
+    vals = jnp.asarray(arima_panel, dtype)
     dt, _ = _timed(
         jax.jit(lambda v: arima.fit(2, 1, 2, v, warn=False).coefficients),
         vals)
-    results.append(("ARIMA(2,1,2) CSS+HR fit", n, n_obs, n / dt))
+    results.append(("ARIMA(2,1,2) CSS+HR fit", n, n_obs, n / dt,
+                    _baseline(_arima_baseline, arima_panel)))
 
     # 3. Holt-Winters additive, monthly seasonality (BASELINE config #3)
     n, n_obs, period = 4096, 120, 12
@@ -73,16 +256,20 @@ def main():
     fit_hw = jax.jit(lambda v: holt_winters.fit(v, period, "additive",
                                                 max_iter=200).alpha)
     dt, _ = _timed(fit_hw, vals)
-    results.append(("HoltWinters additive fit", n, n_obs, n / dt))
+    results.append(("HoltWinters additive fit", n, n_obs, n / dt,
+                    _baseline(_hw_baseline_factory(period), base)))
 
     # 4. AR-GARCH volatility (BASELINE config #4, minute-bar profile)
     n, n_obs = 4096, 1024
     gen = garch.ARGARCHModel(jnp.asarray(0.1), jnp.asarray(0.3),
                              jnp.asarray(0.05), jnp.asarray(0.1),
                              jnp.asarray(0.85))
-    vals = gen.sample(n_obs, jax.random.PRNGKey(1), shape=(n,)).astype(dtype)
+    sample_panel = np.asarray(
+        gen.sample(n_obs, jax.random.PRNGKey(1), shape=(n,)))
+    vals = jnp.asarray(sample_panel, dtype)
     dt, _ = _timed(jax.jit(lambda v: garch.fit_ar_garch(v).alpha), vals)
-    results.append(("ARGARCH(1,1) fit", n, n_obs, n / dt))
+    results.append(("ARGARCH(1,1) fit", n, n_obs, n / dt,
+                    _baseline(_argarch_baseline, sample_panel, sample=4)))
 
     # 5. RegressionARIMA + batched ADF/KPSS (BASELINE config #5)
     n, n_obs, k = 8192, 256, 3
@@ -92,7 +279,8 @@ def main():
     w = rng.normal(size=(n, n_obs))
     for tt in range(1, n_obs):
         e[:, tt] = 0.6 * e[:, tt - 1] + w[:, tt]
-    y = jnp.asarray(X @ beta + e, dtype)
+    y_np = X @ beta + e
+    y = jnp.asarray(y_np, dtype)
     Xj = jnp.asarray(X, dtype)
 
     def reg_and_tests(v):
@@ -102,14 +290,42 @@ def main():
         return m.arima_coeff, adf, kpss
 
     dt, _ = _timed(jax.jit(reg_and_tests), y)
-    results.append(("RegressionARIMA + ADF/KPSS", n, n_obs, n / dt))
+    results.append(("RegressionARIMA + ADF/KPSS", n, n_obs, n / dt,
+                    _baseline(_regarima_baseline_factory(X), y_np,
+                              sample=256)))
 
-    for name, n, n_obs, rate in results:
-        print(json.dumps({
+    # 6. batched auto-ARIMA order selection (SURVEY §3.5 — the strongest
+    # argument for batched fitting; grid (p,q) <= 2x2 to bound runtime)
+    n, n_obs = 2048, 128
+    auto_panel = _synthetic_arima_panel(n, n_obs, seed=3)
+    vals = jnp.asarray(auto_panel, dtype)
+
+    def run_auto(v):
+        return arima.auto_fit_panel(v, max_p=2, max_d=2, max_q=2)
+
+    run_auto(vals)          # warm every (d, p, q) trace
+    t0 = time.perf_counter()
+    out = run_auto(vals)
+    np.asarray(out.coefficients)
+    dt = time.perf_counter() - t0
+    results.append(("auto-ARIMA grid search (p,q<=2)", n, n_obs, n / dt,
+                    None))
+
+    for name, n, n_obs, rate, baseline in results:
+        line = {
             "metric": f"{name} series/sec/chip ({n}x{n_obs})",
             "value": round(rate, 1),
             "unit": "series/sec",
-        }))
+        }
+        if baseline is not None:
+            cpu_rate, sample = baseline
+            line["vs_baseline"] = round(rate / cpu_rate, 2)
+            line["baseline_emulation"] = {
+                "kind": "per-series scalar numpy/scipy, reference cost shape",
+                "sample": sample,
+                "rate": round(cpu_rate, 3),
+            }
+        print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -39,6 +39,49 @@ CSV_INDEX_FILE = "timeIndex"   # same sidecar name as the reference
 # CSV (ref TimeSeriesRDD.scala:498-509 save, :750-764 load)
 # ---------------------------------------------------------------------------
 
+def _escape_key(key: str) -> str:
+    """RFC-4180-style quoting for keys containing delimiters.  Plain keys
+    are written bare, preserving the reference's file contract
+    (``TimeSeriesRDD.scala:498-509`` writes keys raw and silently corrupts
+    comma keys on reload — "match the contract" doesn't extend to
+    preserving a data-loss bug).  Newlines are rejected outright: the file
+    format is line-per-series, so a quoted key spanning physical lines
+    could never be read back."""
+    if "\n" in key or "\r" in key:
+        raise ValueError(
+            f"series key {key!r} contains a newline, which the "
+            "line-per-series CSV contract cannot represent")
+    if "," in key or '"' in key:
+        return '"' + key.replace('"', '""') + '"'
+    return key
+
+
+def _split_key(line: str) -> tuple:
+    """Split ``key,rest`` honoring the quoting from :func:`_escape_key`.
+
+    Lines whose leading quote does not parse as well-formed quoting (e.g. a
+    reference-written file whose raw key just happens to start with ``\"``)
+    fall back to the bare ``key,rest`` split the reference's loader uses."""
+    if not line.startswith('"'):
+        key, _, rest = line.partition(",")
+        return key, rest
+    i = 1
+    out = []
+    while i < len(line):
+        if line[i] == '"':
+            if i + 1 < len(line) and line[i + 1] == '"':
+                out.append('"')
+                i += 2
+                continue
+            if i + 1 == len(line) or line[i + 1] == ",":
+                return "".join(out), line[i + 2:]
+            break                      # quote not closing the field: bare key
+        out.append(line[i])
+        i += 1
+    key, _, rest = line.partition(",")
+    return key, rest
+
+
 def save_csv(panel: Panel, path: str) -> None:
     """Write ``path/data.csv`` (one ``key,v0,v1,...`` row per series) and the
     ``path/timeIndex`` sidecar."""
@@ -46,7 +89,7 @@ def save_csv(panel: Panel, path: str) -> None:
     values = np.asarray(panel.values)
     with open(os.path.join(path, CSV_DATA_FILE), "w") as f:
         for key, row in zip(panel.keys, values):
-            f.write(str(key) + ","
+            f.write(_escape_key(str(key)) + ","
                     + ",".join(repr(float(v)) for v in row) + "\n")
     with open(os.path.join(path, CSV_INDEX_FILE), "w") as f:
         f.write(panel.index.to_string())
@@ -62,9 +105,9 @@ def load_csv(path: str) -> Panel:
             line = line.rstrip("\n")
             if not line:
                 continue
-            tokens = line.split(",")
-            keys.append(tokens[0])
-            rows.append([float(t) for t in tokens[1:]])
+            key, rest = _split_key(line)
+            keys.append(key)
+            rows.append([float(t) for t in rest.split(",")])
     return Panel(index, jnp.asarray(np.asarray(rows, dtype=np.float64)), keys)
 
 

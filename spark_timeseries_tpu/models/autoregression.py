@@ -19,8 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.lag import lag_matrix
-from ..ops.linalg import ols
+from ..ops.lag import lag_matvec, lag_stack
+from ..ops.linalg import ols_gram
 
 
 class ARModel(NamedTuple):
@@ -43,8 +43,7 @@ class ARModel(NamedTuple):
         p = coefs.shape[-1]
         pad = [(0, 0)] * (ts.ndim - 1) + [(p, 0)]
         padded = jnp.pad(ts, pad)
-        lm = lag_matrix(padded, p)                      # (..., n, p)
-        ar_part = jnp.einsum("...np,...p->...n", lm, coefs)
+        ar_part = lag_matvec(padded, coefs, p)          # (..., n)
         return ts - c[..., None] - ar_part if c.ndim else ts - c - ar_part
 
     def add_time_dependent_effects(self, ts: jnp.ndarray) -> jnp.ndarray:
@@ -77,8 +76,8 @@ def fit(ts: jnp.ndarray, max_lag: int = 1, no_intercept: bool = False) -> ARMode
     dims are batched through one QR solve."""
     ts = jnp.asarray(ts)
     y = ts[..., max_lag:]
-    X = lag_matrix(ts, max_lag)
-    res = ols(X, y, add_intercept=not no_intercept)
+    X = lag_stack(ts, max_lag)
+    res = ols_gram(X, y, add_intercept=not no_intercept)
     if no_intercept:
         c = jnp.zeros(ts.shape[:-1], ts.dtype)
         return ARModel(c, res.beta)

@@ -29,6 +29,45 @@ def lag_matrix(x: jnp.ndarray, max_lag: int,
     return jnp.stack(cols, axis=-1)
 
 
+def lag_stack(x: jnp.ndarray, max_lag: int,
+              include_original: bool = False) -> jnp.ndarray:
+    """``lag_matrix`` transposed: ``(..., cols, n - max_lag)`` with the lag
+    index on the *second-minor* axis.
+
+    Same contents as ``lag_matrix(x, max_lag).swapaxes(-1, -2)`` but built in
+    this orientation on purpose: TPU tiling pads the two minor axes to
+    (8, 128), so a ``(..., rows, cols)`` design with small ``cols`` (every
+    AR/MA order in practice) inflates ~``128/cols``× in HBM, while this
+    layout pads only ``8/cols``× — the difference between fitting a
+    100k-series chunk and OOMing on it.  Use with :func:`ols_gram`.
+    """
+    n = x.shape[-1]
+    if max_lag >= n:
+        raise ValueError(f"max_lag {max_lag} must be < series length {n}")
+    initial = 0 if include_original else 1
+    rows = [x[..., max_lag - lag:n - lag] for lag in range(initial, max_lag + 1)]
+    return jnp.stack(rows, axis=-2)
+
+
+def lag_matvec(x: jnp.ndarray, coef: jnp.ndarray, max_lag: int) -> jnp.ndarray:
+    """``lag_matrix(x, max_lag) @ coef`` without materializing the matrix —
+    a sum of ``max_lag`` shifted slices, so the largest intermediate is one
+    ``(..., n - max_lag)`` array (the lag matrix itself pads ~128/cols× on
+    TPU; see :func:`lag_stack`).
+
+    ``x (..., n)``, ``coef (..., max_lag)`` in increasing lag order →
+    ``(..., n - max_lag)``.
+    """
+    n = x.shape[-1]
+    out = None
+    for c in range(max_lag):
+        term = coef[..., c:c + 1] * x[..., max_lag - c - 1:n - c - 1]
+        out = term if out is None else out + term
+    if out is None:
+        return jnp.zeros((*x.shape[:-1], n), x.dtype)[..., :n - max_lag]
+    return out
+
+
 def lag_matrix_multi(x: jnp.ndarray, max_lag: int,
                      include_original: bool = False) -> jnp.ndarray:
     """Lag each column of a multi-column input and concatenate

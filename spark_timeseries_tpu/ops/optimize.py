@@ -86,13 +86,14 @@ def _minimize_lm_one(residual_fn, x0, tol, max_iter, lam0=1e-3,
     p = x0.shape[-1]
     eye = jnp.eye(p, dtype=x0.dtype)
 
-    def resid_and_aux(x):
-        r = residual_fn(x)
-        return r, r
-
     def normal_eqs(x):
-        J, r = jax.jacfwd(resid_and_aux, has_aux=True)(x)   # (m, p), (m,)
-        return J.T @ J, J.T @ r, jnp.sum(r * r)
+        # row-major Jacobian (p, m) via linearize: one primal pass, p tangent
+        # passes.  Orientation matters on TPU — under vmap a (batch, m, p)
+        # Jacobian pads its minor p axis to 128 lanes (~25x HBM at p=5),
+        # while (batch, p, m) pads p only to 8 sublanes.
+        r, fwd = jax.linearize(residual_fn, x)
+        Jr = jax.vmap(fwd)(eye)                             # (p, m)
+        return Jr @ Jr.T, Jr @ r, jnp.sum(r * r)
 
     def body(s: _LMState):
         # Marquardt scaling: damp by lam * diag(JTJ) for scale invariance

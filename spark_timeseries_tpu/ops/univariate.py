@@ -126,24 +126,40 @@ def fill_spline(x) -> np.ndarray:
     ``SplineInterpolator`` behavior (ref ``:301-321``): positions outside
     [first knot, last knot] are left untouched.  Accepts ``(n,)`` or
     ``(batch, n)`` numpy arrays.
+
+    Panel-scale behavior: fully-observed rows are skipped outright, and rows
+    sharing a missingness pattern are solved in ONE vectorized
+    ``CubicSpline`` call (scipy splines batch along an axis), so the cost
+    scales with the number of *distinct* NaN patterns — the per-row Python
+    loop survives only in the worst case where every row's pattern is
+    unique.
     """
     from scipy.interpolate import CubicSpline
 
     arr = np.array(x, dtype=np.float64, copy=True)
     batched = arr.ndim > 1
     rows = arr.reshape(-1, arr.shape[-1]) if batched else arr[None, :]
-    for row in rows:
-        knots = np.flatnonzero(~np.isnan(row))
+    nan_mask = np.isnan(rows)
+    todo = np.flatnonzero(nan_mask.any(axis=1))
+
+    patterns: dict = {}
+    for i in todo:
+        patterns.setdefault(nan_mask[i].tobytes(), []).append(int(i))
+    for mask_bytes, idxs in patterns.items():
+        knots = np.flatnonzero(~nan_mask[idxs[0]])
         if knots.size < 2:
             continue
+        grid = np.arange(knots[0], knots[-1] + 1)
+        sub = rows[idxs]
         if knots.size < 3:
-            # two knots: natural spline degenerates to linear
-            interp = np.interp(np.arange(knots[0], knots[-1] + 1),
-                               knots, row[knots])
+            # two knots: natural spline degenerates to linear (vectorized)
+            v0 = sub[:, knots[0]:knots[0] + 1]
+            v1 = sub[:, knots[-1]:knots[-1] + 1]
+            interp = v0 + (v1 - v0) * (grid - knots[0]) / (knots[-1] - knots[0])
         else:
-            cs = CubicSpline(knots, row[knots], bc_type="natural")
-            interp = cs(np.arange(knots[0], knots[-1] + 1))
-        row[knots[0]:knots[-1] + 1] = interp
+            cs = CubicSpline(knots, sub[:, knots], axis=1, bc_type="natural")
+            interp = cs(grid)
+        rows[np.ix_(idxs, grid)] = interp
     return rows.reshape(arr.shape) if batched else rows[0]
 
 

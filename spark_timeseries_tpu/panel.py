@@ -255,11 +255,11 @@ class Panel:
         n = len(self.index)
         new_index = self.index.islice(start, n)
         # host: for each kept instant, the location of (t - frequency), at or
-        # before; -1 clamps to 0 like the reference
-        prev_locs = np.empty(n - start, dtype=np.int64)
-        for j, i in enumerate(range(start, n)):
-            prev_nanos = frequency.advance(self.index.nanos_at_loc(i), -1, zone)
-            prev_locs[j] = max(self.index.loc_at_or_before(prev_nanos), 0)
+        # before — one vectorized advance + one searchsorted over the whole
+        # index; -1 clamps to 0 like the reference
+        all_nanos = self.index.to_nanos_array()
+        prev_nanos = frequency.advance_each(all_nanos[start:], -1, zone)
+        prev_locs = np.maximum(self.index.locs_at_or_before(prev_nanos), 0)
 
         vals = self.values
         valid = ~jnp.isnan(vals)

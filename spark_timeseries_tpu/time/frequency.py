@@ -66,6 +66,43 @@ def _wall_to_nanos(local: _dt.datetime) -> int:
     return datetime_to_nanos(local)
 
 
+def _is_utc(zi: ZoneInfo) -> bool:
+    return getattr(zi, "key", None) in ("UTC", "Etc/UTC", "Z")
+
+
+def _offsets_at_instants(ns: np.ndarray, zi: ZoneInfo) -> np.ndarray:
+    """UTC offset (nanos) of zone ``zi`` at each *instant*, vectorized by
+    resolving one offset per unique UTC hour (offsets are piecewise-constant
+    with transitions on hour boundaries in practice; zones with sub-hour
+    transition instants mis-resolve only inside that single hour)."""
+    ns = np.asarray(ns, dtype=np.int64)
+    if _is_utc(zi):
+        return np.zeros(ns.shape, np.int64)
+    hours, inverse = np.unique(ns // NANOS_PER_HOUR, return_inverse=True)
+    offs = np.empty(hours.shape, np.int64)
+    for i, h in enumerate(hours):
+        dt = _dt.datetime.fromtimestamp(int(h) * 3600,
+                                        tz=_dt.timezone.utc).astimezone(zi)
+        offs[i] = int(dt.utcoffset().total_seconds()) * NANOS_PER_SECOND
+    return offs[inverse].reshape(ns.shape)
+
+
+def _offsets_at_walls(wall_ns: np.ndarray, zi: ZoneInfo) -> np.ndarray:
+    """UTC offset (nanos) of zone ``zi`` at each *wall-clock* time (fold=0 on
+    ambiguity/gaps, matching the scalar path), one lookup per unique hour."""
+    wall_ns = np.asarray(wall_ns, dtype=np.int64)
+    if _is_utc(zi):
+        return np.zeros(wall_ns.shape, np.int64)
+    hours, inverse = np.unique(wall_ns // NANOS_PER_HOUR, return_inverse=True)
+    offs = np.empty(hours.shape, np.int64)
+    for i, h in enumerate(hours):
+        naive = _dt.datetime.fromtimestamp(int(h) * 3600,
+                                           tz=_dt.timezone.utc)
+        local = naive.replace(tzinfo=zi)
+        offs[i] = int(local.utcoffset().total_seconds()) * NANOS_PER_SECOND
+    return offs[inverse].reshape(wall_ns.shape)
+
+
 class Frequency(ABC):
     """Abstract step used by uniform indices (ref ``Frequency.scala:29-39``)."""
 
@@ -77,12 +114,24 @@ class Frequency(ABC):
     def difference(self, nanos1: Nanos, nanos2: Nanos, zone=None) -> int:
         """Whole number of steps from ``nanos1`` to ``nanos2``, rounded toward zero."""
 
-    def advance_array(self, nanos: Nanos, steps: np.ndarray, zone=None) -> np.ndarray:
-        """Vectorized advance over an int array of step counts (host-side)."""
+    def advance_each(self, nanos: np.ndarray, steps, zone=None) -> np.ndarray:
+        """Element-wise advance: instant ``nanos[i]`` moved ``steps[i]``
+        (broadcastable) whole frequencies.  Subclasses override with numpy
+        field-decomposition implementations; this fallback loops on host."""
+        nanos = np.asarray(nanos, dtype=np.int64)
+        steps_b = np.broadcast_to(np.asarray(steps, dtype=np.int64),
+                                  nanos.shape)
         return np.asarray(
-            [self.advance(nanos, int(k), zone) for k in np.asarray(steps).ravel()],
-            dtype=np.int64,
-        ).reshape(np.shape(steps))
+            [self.advance(int(t), int(k), zone)
+             for t, k in zip(nanos.ravel(), steps_b.ravel())],
+            dtype=np.int64).reshape(nanos.shape)
+
+    def advance_array(self, nanos: Nanos, steps: np.ndarray, zone=None) -> np.ndarray:
+        """Vectorized advance of one base instant over an int array of step
+        counts (host-side)."""
+        steps = np.asarray(steps, dtype=np.int64)
+        return self.advance_each(
+            np.broadcast_to(np.int64(nanos), steps.shape), steps, zone)
 
     # subclasses override __str__ to produce the save/load token (e.g. "days 1")
 
@@ -105,6 +154,10 @@ class DurationFrequency(Frequency):
 
     def advance_array(self, nanos, steps, zone=None) -> np.ndarray:
         return np.int64(nanos) + np.asarray(steps, dtype=np.int64) * np.int64(self.duration_nanos)
+
+    def advance_each(self, nanos, steps, zone=None) -> np.ndarray:
+        return np.asarray(nanos, dtype=np.int64) \
+            + np.asarray(steps, dtype=np.int64) * np.int64(self.duration_nanos)
 
     def __eq__(self, other):
         return isinstance(other, DurationFrequency) \
@@ -205,6 +258,18 @@ class DayFrequency(PeriodFrequency):
             days -= 1
         return days // self.days
 
+    def advance_each(self, nanos, steps, zone=None) -> np.ndarray:
+        """Vectorized: calendar-day addition is uniform in *wall-clock*
+        space, so shift into the zone's wall frame, add whole days, and
+        re-resolve the offset at each landing wall time (preserves full
+        nanosecond precision, like java.time)."""
+        zi = zone_of(zone)
+        nanos = np.asarray(nanos, dtype=np.int64)
+        steps = np.asarray(steps, dtype=np.int64)
+        wall = nanos + _offsets_at_instants(nanos, zi) \
+            + steps * np.int64(self.days * NANOS_PER_DAY)
+        return wall - _offsets_at_walls(wall, zi)
+
     def __str__(self):
         return f"days {self.days}"
 
@@ -246,6 +311,32 @@ class MonthFrequency(PeriodFrequency):
             months += 1
         return int(months // self.months) if months >= 0 else -int((-months) // self.months)
 
+    def advance_each(self, nanos, steps, zone=None) -> np.ndarray:
+        """Vectorized month addition via numpy datetime64 field
+        decomposition: split each wall time into (month index, day-of-month,
+        time-of-day), add months, clamp the day to the target month's length
+        (java.time ``plusMonths`` semantics), reassemble, re-resolve zone
+        offsets."""
+        zi = zone_of(zone)
+        nanos = np.asarray(nanos, dtype=np.int64)
+        steps = np.asarray(steps, dtype=np.int64)
+        wall = nanos + _offsets_at_instants(nanos, zi)
+
+        w64 = wall.astype("datetime64[ns]")
+        m0 = w64.astype("datetime64[M]")
+        day0 = (w64.astype("datetime64[D]") - m0.astype("datetime64[D]")
+                ).astype(np.int64)                       # day-of-month - 1
+        tod = wall - w64.astype("datetime64[D]").astype(
+            "datetime64[ns]").astype(np.int64)
+        m2 = m0 + (steps * np.int64(self.months)).astype("timedelta64[M]")
+        mstart = m2.astype("datetime64[D]")
+        dim = ((m2 + np.timedelta64(1, "M")).astype("datetime64[D]")
+               - mstart).astype(np.int64)                # days in month
+        day2 = np.minimum(day0, dim - 1)
+        wall2 = mstart.astype("datetime64[ns]").astype(np.int64) \
+            + day2 * np.int64(NANOS_PER_DAY) + tod
+        return wall2 - _offsets_at_walls(wall2, zi)
+
     def __str__(self):
         return f"months {self.months}"
 
@@ -263,6 +354,10 @@ class YearFrequency(PeriodFrequency):
         months = MonthFrequency(1).difference(nanos1, nanos2, zone)
         years = months // 12 if months >= 0 else -((-months) // 12)
         return years // self.years if years >= 0 else -((-years) // self.years)
+
+    def advance_each(self, nanos, steps, zone=None) -> np.ndarray:
+        return MonthFrequency(12).advance_each(
+            nanos, np.asarray(steps, dtype=np.int64) * self.years, zone)
 
     def __str__(self):
         return f"years {self.years}"
@@ -328,6 +423,33 @@ class BusinessDayFrequency(Frequency):
         remaining = days_between % 7
         extra = 2 if aligned1 + remaining > 5 else 0
         return (days_between - weekend_days - extra) // self.days
+
+    def advance_each(self, nanos, steps, zone=None) -> np.ndarray:
+        """Vectorized weekday-skipping arithmetic: day-of-week comes from the
+        wall day number (epoch day 0 = Thursday), the weekend-skip count is
+        the same closed form as the scalar path, and zone offsets are
+        re-resolved at the landing wall times."""
+        zi = zone_of(zone)
+        nanos = np.asarray(nanos, dtype=np.int64)
+        steps = np.asarray(steps, dtype=np.int64)
+        wall = nanos + _offsets_at_instants(nanos, zi)
+        day = np.floor_divide(wall, NANOS_PER_DAY)
+        iso = (day + 3) % 7 + 1                          # 1970-01-01 = Thu(4)
+        aligned = (iso - self.first_day_of_week + 7) % 7 + 1
+        if np.any(aligned > 5):
+            bad = nanos[np.argmax(aligned > 5)]
+            raise ValueError(
+                f"{nanos_to_datetime(int(bad), zi)} is not a business day")
+        total = steps * np.int64(self.days)
+        mag = np.abs(total)
+        weekend = (mag // 5) * 2
+        remaining = mag % 5
+        extra_f = np.where(aligned + remaining > 5, 2, 0)
+        extra_b = np.where(aligned - remaining < 1, 2, 0)
+        shift = np.where(total >= 0, total + weekend + extra_f,
+                         -(mag + weekend + extra_b))
+        wall2 = wall + shift * np.int64(NANOS_PER_DAY)
+        return wall2 - _offsets_at_walls(wall2, zi)
 
     def __eq__(self, other):
         return isinstance(other, BusinessDayFrequency) and other.days == self.days \

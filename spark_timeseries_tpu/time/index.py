@@ -222,6 +222,15 @@ class DateTimeIndex(ABC):
         pos_c = np.clip(pos, 0, arr.size - 1)
         return np.where((pos < arr.size) & (arr[pos_c] == nanos), pos, -1).astype(np.int64)
 
+    def locs_at_or_before(self, nanos: np.ndarray) -> np.ndarray:
+        """Vectorized location of the last instant ``<=`` each value; -1
+        where every instant is later (unlike the scalar
+        ``loc_at_or_before``'s clamped edge returns, callers see the
+        out-of-range case explicitly)."""
+        arr = self.to_nanos_array()
+        return (np.searchsorted(arr, np.asarray(nanos, dtype=np.int64),
+                                side="right") - 1).astype(np.int64)
+
     # -- materialization ----------------------------------------------------
     @abstractmethod
     def to_nanos_array(self) -> np.ndarray:

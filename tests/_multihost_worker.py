@@ -1,0 +1,69 @@
+"""Worker process for the 2-process ``jax.distributed`` test.
+
+Invoked as ``python _multihost_worker.py <process_id> <port>``.  Each
+process owns 2 virtual CPU devices; together they form a 4-device global
+mesh — the CPU-local stand-in for two DCN-connected TPU hosts (the
+reference's analogue is Spark `local-cluster` testing,
+ref LocalSparkContext.scala:23-61).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from spark_timeseries_tpu import parallel  # noqa: E402
+
+got_pid, count = parallel.initialize_multihost(f"127.0.0.1:{port}", 2, pid)
+assert got_pid == pid, (got_pid, pid)
+assert count == 2, count
+assert len(jax.devices()) == 4          # 2 local x 2 processes
+
+mesh = parallel.make_mesh(4, 1)
+
+data = np.arange(48.0).reshape(8, 6)
+arr = jax.make_array_from_callback(
+    data.shape, parallel.series_sharding(mesh), lambda idx: data[idx])
+
+# driver-collect equivalent: every process materializes the full panel
+out = parallel.collect(arr)
+assert out.shape == (8, 6)
+np.testing.assert_allclose(out, data)
+
+# cross-shard OR-reduction (the aggregate/mask-reduce equivalent)
+mask = data > 40.0
+marr = jax.make_array_from_callback(
+    mask.shape, parallel.series_sharding(mesh), lambda idx: mask[idx])
+with mesh:
+    any_per_instant = parallel.instant_mask_any(marr, mesh)
+collected = parallel.collect(any_per_instant)
+np.testing.assert_array_equal(collected, mask.any(axis=0))
+
+# a batched model fit over the globally sharded panel
+import jax.numpy as jnp  # noqa: E402
+from spark_timeseries_tpu.models import ewma  # noqa: E402
+
+rng = np.random.default_rng(0)
+panel_np = rng.normal(size=(8, 64)).cumsum(axis=1)
+panel = jax.make_array_from_callback(
+    panel_np.shape, parallel.series_sharding(mesh), lambda i: panel_np[i])
+fitted = jax.jit(
+    lambda v: ewma.fit(v, max_iter=20).smoothing,
+    in_shardings=parallel.series_sharding(mesh))(panel)
+sm = parallel.collect(fitted)
+assert sm.shape == (8,)
+assert np.all(np.isfinite(sm))
+
+print(f"MULTIHOST_OK {pid}", flush=True)

@@ -37,6 +37,32 @@ def test_csv_round_trip(tmp_path, panel):
     assert back.index.to_string() == panel.index.to_string()
 
 
+def test_csv_round_trip_keys_with_delimiters(tmp_path):
+    """Keys containing commas/quotes survive save/load (the reference's raw
+    write corrupts them, TimeSeriesRDD.scala:498-509; quoting fixes the
+    data loss while plain keys keep the bare file contract)."""
+    idx = dtindex.uniform("2020-01-01T00:00Z", 4, freq.DayFrequency(1))
+    keys = ['plain', 'a,b', 'quo"te', 'both",and,']
+    vals = jnp.asarray(np.arange(16, dtype=np.float64).reshape(4, 4))
+    path = str(tmp_path / "panel_csv2")
+    stio.save_csv(stt.Panel(idx, vals, keys), path)
+    back = stio.load_csv(path)
+    assert back.keys == keys
+    np.testing.assert_allclose(np.asarray(back.values), np.asarray(vals))
+    # plain keys still written bare (reference-compatible)
+    with open(path + "/data.csv") as f:
+        assert f.readline().startswith("plain,")
+    # newline keys cannot survive a line-per-series format: reject at save
+    with pytest.raises(ValueError, match="newline"):
+        stio.save_csv(stt.Panel(idx, vals, ["a\nb", "c", "d", "e"]), path)
+    # a reference-written file whose raw key starts with a quote still loads
+    with open(path + "/data.csv", "w") as f:
+        f.write('"rawquote,1.0,2.0,3.0,4.0\n')
+    back2 = stio.load_csv(path)
+    assert back2.keys == ['"rawquote']
+    np.testing.assert_allclose(np.asarray(back2.values)[0], [1, 2, 3, 4])
+
+
 def test_parquet_round_trip(tmp_path, panel):
     path = str(tmp_path / "panel.parquet")
     stio.save_parquet(panel, path)

@@ -81,6 +81,27 @@ class TestFills:
         assert not np.isnan(out[1]) and not np.isnan(out[3])
         assert np.isnan(out[5])
 
+    def test_fill_spline_batched_patterns(self):
+        # rows: fully observed (skipped), two sharing one NaN pattern (one
+        # vectorized spline call), one 2-knot (linear degenerate), one
+        # all-NaN (untouched) — the panel-scale grouping paths
+        rows = np.array([
+            [1.0, 2.0, 3.0, 4.0, 5.0, 6.0],
+            [1.0, nan, 9.0, nan, 25.0, nan],
+            [2.0, nan, 18.0, nan, 50.0, nan],
+            [nan, 4.0, nan, nan, 10.0, nan],
+            [nan, nan, nan, nan, nan, nan],
+        ])
+        out = fill_spline(rows)
+        np.testing.assert_allclose(out[0], rows[0])
+        for r in (1, 2):
+            # same answers as the single-row path
+            np.testing.assert_allclose(out[r], fill_spline(rows[r]),
+                                       equal_nan=True)
+        np.testing.assert_allclose(out[3, 1:5], [4.0, 6.0, 8.0, 10.0])
+        assert np.isnan(out[3, 0]) and np.isnan(out[3, 5])
+        assert np.all(np.isnan(out[4]))
+
     def test_fillts_dispatch_and_batch(self):
         x = jnp.stack([arr(1, nan, 3), arr(nan, 2, nan)])
         out = np.asarray(fillts(x, "previous"))
