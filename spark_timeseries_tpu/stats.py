@@ -15,13 +15,14 @@ the same sources the reference credits (statsmodels / R tseries).
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 from jax.scipy.stats import chi2, norm
 
-from .ops.lag import lag_matrix
+from .ops.lag import lag_matrix, lag_stack
 from .ops.linalg import ols, r_squared, t_statistics
 
 # ---------------------------------------------------------------------------
@@ -114,9 +115,12 @@ def mackinnonp(test_stat: jnp.ndarray, regression: str = "c",
     return jnp.where(stat < _ADF_TAU_MIN[regression][i], 0.0, p)
 
 
+@functools.lru_cache(maxsize=64)
 def _trend_columns(n_obs: int, regression: str, dtype) -> jnp.ndarray:
     """Deterministic trend regressors [1, t, t^2][:order+1], t = 1..n
-    (ref ``addTrend``/``vanderflipped`` ``TimeSeriesStatisticalTests.scala:161-196``)."""
+    (ref ``addTrend``/``vanderflipped`` ``TimeSeriesStatisticalTests.scala:161-196``).
+    Cached per (length, regression, dtype) — repeated KPSS/ADF sweeps reuse
+    the same design."""
     order = {"nc": -1, "c": 0, "ct": 1, "ctt": 2}[regression]
     t = np.arange(1, n_obs + 1, dtype=np.float64)
     cols = [t ** k for k in range(order + 1)]
@@ -216,14 +220,25 @@ def bptest(residuals: jnp.ndarray,
 def _newey_west_variance(errors: jnp.ndarray, lag: int) -> jnp.ndarray:
     """Newey-West long-run variance with Bartlett weights, batched
     (ref ``TimeSeriesStatisticalTests.scala:405-431``, itself following R
-    tseries' ppsum.c)."""
+    tseries' ppsum.c).
+
+    All ``lag`` autocovariances come from ONE stacked contraction (an MXU
+    matmul over the panel) instead of a per-lag reduction loop — KPSS runs
+    ``max_d + 1`` times over the whole panel inside ``auto_fit_panel``, so
+    this is on the batch hot path."""
     e = jnp.asarray(errors)
     n = e.shape[-1]
-    acc = jnp.zeros(e.shape[:-1], e.dtype)
-    for i in range(1, lag + 1):
-        cov = jnp.sum(e[..., i:] * e[..., :n - i], axis=-1)
-        acc = acc + cov * (1.0 - i / (lag + 1.0))
-    return 2.0 * acc / n + jnp.sum(e * e, axis=-1) / n
+    var0 = jnp.sum(e * e, axis=-1) / n
+    if lag == 0:
+        return var0
+    # left-pad so every lag-i row aligns with e over the full [0, n) range:
+    # row i of the stack is [0]*i ++ e[:n-i], and row_i · e = Σ_t e[t-i]e[t]
+    ep = jnp.concatenate(
+        [jnp.zeros((*e.shape[:-1], lag), e.dtype), e], axis=-1)
+    stk = lag_stack(ep, lag)                       # (..., lag, n)
+    covs = jnp.einsum("...ln,...n->...l", stk, e)
+    w = 1.0 - jnp.arange(1, lag + 1, dtype=e.dtype) / (lag + 1.0)
+    return 2.0 * jnp.sum(covs * w, axis=-1) / n + var0
 
 
 def kpsstest(ts: jnp.ndarray, method: str = "c"
