@@ -127,14 +127,24 @@ def main():
         to host materialization of every chunk's coefficients (on the
         tunneled TPU platform block_until_ready alone does not synchronize),
         and includes the H2D transfer of each chunk — the real pipeline
-        cost shape for a panel larger than device memory."""
+        cost shape for a panel larger than device memory.
+
+        Double-buffered: chunk ``i+1``'s transfer + fit are dispatched
+        (JAX dispatch is async) before chunk ``i``'s coefficients are pulled
+        to host, so H2D/compute/D2H overlap; at most two chunks are live in
+        HBM at once."""
         t0 = time.perf_counter()
+        pending = None
         for start in range(0, values.shape[0], chunk_n):
             part = values[start:start + chunk_n]
             if part.shape[0] != chunk_n:    # ragged tail: pad to one shape
                 pad = np.zeros((chunk_n - part.shape[0], n_obs), part.dtype)
                 part = np.concatenate([part, pad])
-            np.asarray(fit(jnp.asarray(part, dtype)))
+            out = fit(jnp.asarray(part, dtype))
+            if pending is not None:
+                np.asarray(pending)
+            pending = out
+        np.asarray(pending)
         return time.perf_counter() - t0
 
     # scaling curve: does the small-panel rate hold at 1M?  Each point uses

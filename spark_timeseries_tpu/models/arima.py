@@ -35,14 +35,14 @@ import numpy as np
 from jax import lax
 
 from ..ops.lag import lag_matvec, lag_stack
-from ..ops.linalg import ols_gram
+from ..ops.linalg import ols_gram, spd_solve
 from ..ops.optimize import (minimize_bfgs, minimize_box,
                             minimize_least_squares)
 from ..ops.univariate import (differences_of_order_d,
                               inverse_differences_of_order_d)
 from ..stats import kpsstest
 from . import autoregression
-from .base import FitDiagnostics, diagnostics_from
+from .base import FitDiagnostics, diagnostics_from, scan_unroll
 
 
 # ---------------------------------------------------------------------------
@@ -104,7 +104,8 @@ def _one_step_errors(params: jnp.ndarray, y: jnp.ndarray,
         return jnp.concatenate([e[None], errs[:-1]]), (yhat, e)
 
     errs0 = jnp.zeros((q,), y.dtype)
-    _, (yhat, err) = lax.scan(step, errs0, (base, y_t))
+    _, (yhat, err) = lax.scan(step, errs0, (base, y_t),
+                              unroll=scan_unroll())
     return yhat, err
 
 
@@ -153,7 +154,8 @@ def _remove_effects_one(params: jnp.ndarray, ts: jnp.ndarray,
         out = b - theta @ errs
         return jnp.concatenate([out[None], errs[:-1]]), out
 
-    _, out = lax.scan(step, jnp.zeros((q,), ts.dtype), base)
+    _, out = lax.scan(step, jnp.zeros((q,), ts.dtype), base,
+                      unroll=scan_unroll())
     return out
 
 
@@ -185,7 +187,7 @@ def _add_effects_one(params: jnp.ndarray, ts: jnp.ndarray,
             return jnp.concatenate([out_t[None], recent[:-1]]), out_t
 
         recent0 = jnp.full((p,), c, ts.dtype)
-        _, out = lax.scan(step, recent0, drive)
+        _, out = lax.scan(step, recent0, drive, unroll=scan_unroll())
 
     return inverse_differences_of_order_d(out, d)
 
@@ -246,7 +248,8 @@ def _forecast_one(params: jnp.ndarray, ts: jnp.ndarray, n_future: int,
             errs = jnp.concatenate([jnp.zeros((1,), ts.dtype), errs[:-1]])
         return (recent, errs), out
 
-    (_, _), fwd = lax.scan(fwd_step, (recent0, errs0), None, length=n_future)
+    (_, _), fwd = lax.scan(fwd_step, (recent0, errs0), None, length=n_future,
+                           unroll=scan_unroll())
 
     results = jnp.zeros((n + n_future,), ts.dtype)
     results = results.at[:d].set(ts[:d])
@@ -774,11 +777,11 @@ def _auto_fit_grid_kernel(diffed: jnp.ndarray, masks: jnp.ndarray,
     target = y_trunc[..., mx:]
     N = jnp.einsum("skn,sln->skl", Xs, Xs)           # XᵀX (S, k, k)
     b = jnp.einsum("skn,sn->sk", Xs, target)
-    # candidate-masked normal equations: (M N M + (I - M)) β = M b
+    # candidate-masked normal equations: (M N M + (I - M)) β = M b — SPD
+    # (masked gram + identity fill), so the unrolled Cholesky path applies
     Mn = masks[:, None, :, None] * N[None] * masks[:, None, None, :]
     ident = jnp.eye(k, dtype=diffed.dtype) * (1.0 - masks)[:, None, :, None]
-    init = jnp.linalg.solve(Mn + ident,
-                            (masks[:, None] * b[None])[..., None])[..., 0]
+    init = spd_solve(Mn + ident, masks[:, None] * b[None])
 
     def resid(prm, y, mask):
         return _one_step_errors(prm * mask, y, max_p, max_q, 1)[1]

@@ -21,6 +21,7 @@ from jax import lax
 
 from ..ops.lag import lag_matvec, lag_stack
 from ..ops.linalg import ols_gram
+from .base import scan_unroll
 
 
 class ARModel(NamedTuple):
@@ -60,7 +61,7 @@ class ARModel(NamedTuple):
             d = c + x_t + jnp.sum(coefs * carry, axis=-1)
             return jnp.concatenate([d[..., None], carry[..., :-1]], axis=-1), d
 
-        _, out = lax.scan(step, carry0, xs)
+        _, out = lax.scan(step, carry0, xs, unroll=scan_unroll())
         return jnp.moveaxis(out, 0, -1)
 
     def sample(self, n: int, key, shape=()) -> jnp.ndarray:

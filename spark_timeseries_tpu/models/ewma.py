@@ -22,7 +22,7 @@ from jax import lax
 
 from ..ops.optimize import (minimize_bfgs, minimize_box,
                             minimize_least_squares)
-from .base import FitDiagnostics, diagnostics_from
+from .base import FitDiagnostics, diagnostics_from, scan_unroll
 
 # floor for the smoothing parameter when *inverting* the recurrence: the
 # box method's lower bound (EWMA.scala's unbounded CGD shares the hazard —
@@ -47,7 +47,7 @@ class EWMAModel(NamedTuple):
             s = a * x_t + (1.0 - a) * s_prev
             return s, s
 
-        _, out = lax.scan(step, xs[0], xs[1:])
+        _, out = lax.scan(step, xs[0], xs[1:], unroll=scan_unroll())
         return jnp.moveaxis(jnp.concatenate([xs[:1], out]), 0, -1)
 
     def remove_time_dependent_effects(self, ts: jnp.ndarray) -> jnp.ndarray:

@@ -24,7 +24,7 @@ from jax import lax
 
 from ..ops.lag import lag_matrix
 from ..ops.optimize import minimize_box
-from .base import FitDiagnostics, diagnostics_from
+from .base import FitDiagnostics, diagnostics_from, scan_unroll
 
 
 def _kernel(period: int) -> np.ndarray:
@@ -127,7 +127,8 @@ class HoltWintersModel(NamedTuple):
                 [seasons[..., 1:], new_season[..., None]], axis=-1)
             return (new_level, new_trend, seasons), dest
 
-        final, dests = lax.scan(step, (level0, trend0, season0), xs)
+        final, dests = lax.scan(step, (level0, trend0, season0), xs,
+                                unroll=scan_unroll())
         fitted = jnp.concatenate(
             [jnp.zeros((*ts.shape[:-1], period), ts.dtype),
              jnp.moveaxis(dests, 0, -1)], axis=-1)

@@ -12,7 +12,99 @@ from __future__ import annotations
 from typing import NamedTuple
 
 import jax.numpy as jnp
-from jax.scipy.linalg import solve_triangular
+from jax.scipy.linalg import cho_solve, solve_triangular
+
+# Batched tiny SPD systems ((..., p, p) with p ≤ ~16) dominate every fit's
+# inner loop: LM normal equations, gram-matrix OLS, the auto-fit candidate
+# grid.  XLA lowers ``jnp.linalg.solve``/``inv`` on TPU to a pivoted LU with
+# dynamic control flow — measured 48ms per solve at (32768, 5, 5) f32 on
+# v5e, ~15x slower than a fully unrolled Cholesky (3.3ms) whose ops are just
+# fused elementwise arithmetic over the batch.  Everything here routes small
+# SPD systems through the unrolled path.
+_SPD_UNROLL_MAX = 16
+
+
+def _chol_unrolled(A: jnp.ndarray, p: int):
+    """Lower Cholesky factor of SPD ``A (..., p, p)`` as a list-of-lists of
+    ``(...)`` lanes — fully unrolled, no control flow."""
+    L = [[None] * p for _ in range(p)]
+    for i in range(p):
+        for j in range(i + 1):
+            s = A[..., i, j]
+            for k in range(j):
+                s = s - L[i][k] * L[j][k]
+            L[i][j] = jnp.sqrt(s) if i == j else s / L[j][j]
+    return L
+
+
+def _fwd_sub(L, b_cols, p: int):
+    """Solve ``L y = b`` for each entry of ``b_cols`` (list of ``(...)``)."""
+    y = [None] * p
+    for i in range(p):
+        s = b_cols[i]
+        for k in range(i):
+            s = s - L[i][k] * y[k]
+        y[i] = s / L[i][i]
+    return y
+
+
+def _back_sub(L, y, p: int):
+    """Solve ``Lᵀ x = y`` (list form)."""
+    x = [None] * p
+    for i in reversed(range(p)):
+        s = y[i]
+        for k in range(i + 1, p):
+            s = s - L[k][i] * x[k]
+        x[i] = s / L[i][i]
+    return x
+
+
+def spd_solve(A: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Solve SPD ``A (..., p, p) @ x = b (..., p)`` by Cholesky.
+
+    Unrolled elementwise arithmetic for ``p ≤ 16`` (the TPU fast path);
+    batched ``cho_solve`` beyond.  A non-SPD lane yields NaNs (sqrt of a
+    negative pivot) rather than an LU's garbage solution — callers already
+    quarantine non-finite lanes.
+    """
+    p = A.shape[-1]
+    if p == 0:
+        return jnp.zeros_like(b)
+    if p > _SPD_UNROLL_MAX:
+        return cho_solve((jnp.linalg.cholesky(A), True),
+                         b[..., None])[..., 0]
+    L = _chol_unrolled(A, p)
+    x = _back_sub(L, _fwd_sub(L, [b[..., i] for i in range(p)], p), p)
+    return jnp.stack(x, axis=-1)
+
+
+def spd_inverse(A: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of SPD ``A (..., p, p)`` via the unrolled Cholesky:
+    ``A⁻¹ = L⁻ᵀ L⁻¹`` with the triangular inverse unrolled for ``p ≤ 16``."""
+    p = A.shape[-1]
+    if p == 0 or p > _SPD_UNROLL_MAX:
+        eye = jnp.broadcast_to(jnp.eye(p, dtype=A.dtype), A.shape)
+        return cho_solve((jnp.linalg.cholesky(A), True), eye)
+    L = _chol_unrolled(A, p)
+    # Y = L^-1 (lower triangular), column by column
+    Y = [[None] * p for _ in range(p)]
+    for j in range(p):
+        Y[j][j] = 1.0 / L[j][j]
+        for i in range(j + 1, p):
+            s = L[i][j] * Y[j][j]
+            for k in range(j + 1, i):
+                s = s + L[i][k] * Y[k][j]
+            Y[i][j] = -s / L[i][i]
+    rows = []
+    for i in range(p):
+        row = []
+        for j in range(p):
+            s = 0.0
+            for k in range(max(i, j), p):
+                s = s + Y[k][i] * Y[k][j]
+            row.append(s)
+        rows.append(jnp.stack(row, axis=-1))
+    return jnp.stack(rows, axis=-2)
 
 
 class OLSResult(NamedTuple):
@@ -68,7 +160,7 @@ def ols_gram(Xs: jnp.ndarray, y: jnp.ndarray,
     n, p = Xs.shape[-1], Xs.shape[-2]
     N = jnp.einsum("...pn,...qn->...pq", Xs, Xs)
     b = jnp.einsum("...pn,...n->...p", Xs, y)
-    xtx_inv = jnp.linalg.inv(N)
+    xtx_inv = spd_inverse(N)    # gram matrices are SPD: unrolled Cholesky
     beta = jnp.einsum("...pq,...q->...p", xtx_inv, b)
     fitted = jnp.einsum("...pn,...p->...n", Xs, beta)
     resid = y - fitted

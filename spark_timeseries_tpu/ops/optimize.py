@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .linalg import spd_solve
+
 
 class MinimizeResult(NamedTuple):
     """Batched optimization artifacts (leading dims ``...`` = batch)."""
@@ -96,9 +98,11 @@ def _minimize_lm_one(residual_fn, x0, tol, max_iter, lam0=1e-3,
         return Jr @ Jr.T, Jr @ r, jnp.sum(r * r)
 
     def body(s: _LMState):
-        # Marquardt scaling: damp by lam * diag(JTJ) for scale invariance
+        # Marquardt scaling: damp by lam * diag(JTJ) for scale invariance.
+        # JTJ + positive diagonal is SPD -> unrolled Cholesky (spd_solve);
+        # the LU this replaces was ~90% of the LM iteration cost on TPU.
         damp = s.lam * jnp.diagonal(s.jtj) + 1e-12
-        delta = jnp.linalg.solve(s.jtj + damp * eye, s.jtr)
+        delta = spd_solve(s.jtj + damp * eye, s.jtr)
         x_new = s.x - delta
         jtj_new, jtr_new, f_new = normal_eqs(x_new)
         ok = jnp.all(jnp.isfinite(jtj_new)) & jnp.all(jnp.isfinite(jtr_new))

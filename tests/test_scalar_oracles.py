@@ -1,0 +1,222 @@
+"""Independent scalar oracles for the batched statistical tests.
+
+statsmodels and R are not available in this image (the GARCH MLE anchor in
+``test_garch.py`` records the same), so each oracle here is a deliberately
+*scalar, loop-based numpy* re-implementation written from the textbook
+formula — sharing no code with the batched JAX kernels under test.  They
+catch exactly the class of bug external oracles would: vectorization/axis
+errors, off-by-one sample windows, wrong normalizations.
+
+(If statsmodels ever lands in the image, `_HAVE_SM` flips these tests to
+cross-check against it as well.)
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_timeseries_tpu import stats
+
+try:  # pragma: no cover - absent in this image
+    import statsmodels.api  # noqa: F401
+    _HAVE_SM = True
+except ImportError:
+    _HAVE_SM = False
+
+
+def _ar1(n, phi, seed, const=0.0):
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=n)
+    y = np.zeros(n)
+    for t in range(1, n):
+        y[t] = const + phi * y[t - 1] + e[t]
+    return y
+
+
+def _scalar_ols_tstat(X, y, col):
+    """t statistic of ``beta[col]`` from first-principles OLS."""
+    XtX = X.T @ X
+    beta = np.linalg.solve(XtX, X.T @ y)
+    resid = y - X @ beta
+    dof = X.shape[0] - X.shape[1]
+    sigma2 = resid @ resid / dof
+    se = np.sqrt(sigma2 * np.linalg.inv(XtX)[col, col])
+    return beta[col] / se
+
+
+def test_adftest_statistic_matches_scalar_ols():
+    """ADF statistic == t-stat of the lagged level in the scalar Dickey-
+    Fuller regression built row by row (statsmodels' construction, which the
+    reference ports: ``TimeSeriesStatisticalTests.scala:28-31,209-242``)."""
+    for regression, trend_order in (("nc", 0), ("c", 1), ("ct", 2),
+                                    ("ctt", 3)):
+        for phi, seed in ((0.5, 0), (0.95, 1)):
+            y = _ar1(500, phi, seed)
+            max_lag = 4
+            n = y.shape[0]
+            dy = np.diff(y)
+            rows = []
+            targets = []
+            for t in range(max_lag, n - 1):
+                lagged_diffs = [dy[t - k] for k in range(1, max_lag + 1)]
+                trend = [t + 1.0] if trend_order >= 1 else []
+                # deterministic terms: 1, s, s^2 with s = row index + 1
+                s = t - max_lag + 1.0
+                det = [s ** k for k in range(1, trend_order)]
+                rows.append([y[t]] + lagged_diffs + [1.0] * (trend_order >= 1)
+                            + det)
+                targets.append(dy[t])
+            X = np.asarray(rows)
+            if trend_order == 0:
+                X = X[:, :1 + max_lag]
+            ref_stat = _scalar_ols_tstat(X, np.asarray(targets), 0)
+            stat, _ = stats.adftest(jnp.asarray(y), max_lag, regression)
+            np.testing.assert_allclose(float(stat), ref_stat,
+                                       rtol=1e-6, atol=1e-8)
+
+
+def test_kpsstest_statistic_matches_scalar_loop():
+    """KPSS eta statistic from the scalar textbook formula
+    (Kwiatkowski et al. 1992 / R tseries): partial sums of demeaned (or
+    detrended) residuals over the Newey-West long-run variance."""
+    for phi, seed in ((0.3, 2), (0.9, 3)):
+        y = _ar1(600, phi, seed)
+        n = y.shape[0]
+        lag = int(3 * np.sqrt(n) / 13)
+
+        for method in ("c", "ct"):
+            if method == "c":
+                resid = y - y.mean()
+            else:
+                t = np.arange(1, n + 1, dtype=float)
+                X = np.column_stack([np.ones(n), t])
+                beta = np.linalg.lstsq(X, y, rcond=None)[0]
+                resid = y - X @ beta
+            s = np.cumsum(resid)
+            # scalar Newey-West long-run variance with Bartlett weights
+            lrv = resid @ resid / n
+            for i in range(1, lag + 1):
+                w = 1.0 - i / (lag + 1.0)
+                lrv += 2.0 * w * (resid[i:] @ resid[:-i]) / n
+            ref_stat = (s @ s) / (lrv * n * n)
+
+            stat, _ = stats.kpsstest(jnp.asarray(y), method)
+            np.testing.assert_allclose(float(stat), ref_stat, rtol=1e-6)
+
+
+def test_dwtest_matches_scalar_loop():
+    u = _ar1(400, 0.4, 4)
+    num = sum((u[t] - u[t - 1]) ** 2 for t in range(1, len(u)))
+    ref = num / (u @ u)
+    np.testing.assert_allclose(float(stats.dwtest(jnp.asarray(u))), ref,
+                               rtol=1e-10)
+
+
+def test_lbtest_matches_scalar_loop():
+    """The autocorrelation estimator is the *reference's* convention — a
+    per-lag Pearson correlation of the two slices, each demeaned separately
+    (``UnivariateTimeSeries.scala:70-96``) — not the textbook single-mean
+    ACF; the scalar oracle reproduces that definition loop-wise, and the
+    textbook version is checked to O(lags/n) alongside."""
+    u = _ar1(800, 0.3, 5)
+    n = len(u)
+    um = u - u.mean()
+    denom = um @ um
+    for lags in (1, 5, 10):
+        q = 0.0
+        q_textbook = 0.0
+        for k in range(1, lags + 1):
+            s1, s2 = u[k:], u[:-k]
+            d1, d2 = s1 - s1.mean(), s2 - s2.mean()
+            rho = (d1 @ d2) / np.sqrt((d1 @ d1) * (d2 @ d2))
+            q += rho * rho / (n - k)
+            rho_tb = (um[k:] @ um[:-k]) / denom
+            q_textbook += rho_tb * rho_tb / (n - k)
+        ref_stat = n * (n + 2) * q
+        stat, p = stats.lbtest(jnp.asarray(u), lags)
+        np.testing.assert_allclose(float(stat), ref_stat, rtol=1e-6)
+        from scipy.stats import chi2 as sp_chi2
+        np.testing.assert_allclose(float(p), sp_chi2.sf(ref_stat, lags),
+                                   atol=1e-10)
+        # the two estimator conventions agree to O(lags/n)
+        np.testing.assert_allclose(ref_stat, n * (n + 2) * q_textbook,
+                                   rtol=0.05)
+
+
+def test_bptest_matches_scalar_aux_regression():
+    rng = np.random.default_rng(6)
+    n = 500
+    X = rng.normal(size=(n, 2))
+    u = rng.normal(size=n) * (1.0 + 0.5 * np.abs(X[:, 0]))
+    u2 = u * u
+    Xa = np.column_stack([np.ones(n), X])
+    beta = np.linalg.lstsq(Xa, u2, rcond=None)[0]
+    fitted = Xa @ beta
+    ss_res = np.sum((u2 - fitted) ** 2)
+    ss_tot = np.sum((u2 - u2.mean()) ** 2)
+    ref_stat = n * (1.0 - ss_res / ss_tot)
+    stat, _ = stats.bptest(jnp.asarray(u), jnp.asarray(X))
+    np.testing.assert_allclose(float(stat), ref_stat, rtol=1e-6)
+
+
+def test_bgtest_matches_scalar_aux_regression():
+    """Trimmed-sample Breusch-Godfrey (the reference's construction,
+    ``TimeSeriesStatisticalTests.scala:276-288``), built row by row."""
+    rng = np.random.default_rng(7)
+    n = 1000
+    X = rng.normal(size=(n, 2))
+    u = _ar1(n, 0.2, 8)
+    max_lag = 2
+
+    rows = []
+    targets = []
+    for t in range(max_lag, n):
+        rows.append([1.0, X[t, 0], X[t, 1]]
+                    + [u[t - k] for k in range(1, max_lag + 1)])
+        targets.append(u[t])
+    Xa = np.asarray(rows)
+    ya = np.asarray(targets)
+    beta = np.linalg.lstsq(Xa, ya, rcond=None)[0]
+    fitted = Xa @ beta
+    ss_res = np.sum((ya - fitted) ** 2)
+    ss_tot = np.sum((ya - ya.mean()) ** 2)
+    n_obs = n - max_lag
+    ref_stat = n_obs * (1.0 - ss_res / ss_tot)
+    stat, _ = stats.bgtest(jnp.asarray(u), jnp.asarray(X), max_lag)
+    np.testing.assert_allclose(float(stat), ref_stat, rtol=1e-6)
+
+
+def test_ewma_fit_matches_scalar_golden_section():
+    """The EWMA fit minimizes one-step SSE with S_0 = X_0; a scalar
+    golden-section search over the same loop-based SSE is the oracle
+    (ref ``EWMA.scala:45-96``)."""
+    from scipy.optimize import minimize_scalar
+
+    from spark_timeseries_tpu.models import ewma
+
+    y = _ar1(300, 0.7, 9, const=0.3) + 5.0
+
+    def sse(a):
+        s = y[0]
+        total = 0.0
+        for t in range(1, len(y)):
+            total += (y[t] - s) ** 2
+            s = a * y[t] + (1 - a) * s
+        return total
+
+    ref = minimize_scalar(sse, bounds=(1e-4, 1.0), method="bounded",
+                          options={"xatol": 1e-10})
+    model = ewma.fit(jnp.asarray(y))
+    np.testing.assert_allclose(float(model.smoothing), ref.x, atol=1e-3)
+
+
+@pytest.mark.skipif(not _HAVE_SM, reason="statsmodels not in this image")
+def test_against_statsmodels_when_available():  # pragma: no cover
+    from statsmodels.tsa.stattools import adfuller
+
+    y = _ar1(500, 0.5, 0)
+    stat, p = stats.adftest(jnp.asarray(y), 4, "c")
+    ref_stat, ref_p, *_ = adfuller(y, maxlag=4, regression="c", autolag=None)
+    np.testing.assert_allclose(float(stat), ref_stat, rtol=1e-6)
+    np.testing.assert_allclose(float(p), ref_p, atol=1e-4)
