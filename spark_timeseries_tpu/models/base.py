@@ -26,14 +26,16 @@ def scan_unroll() -> int:
 
     The recurrences carry tiny per-step state (ring buffers, level/trend/
     season scalars), so on TPU the scans are latency-bound on the loop, not
-    FLOPs; unrolling 16 steps per XLA while-iteration halved the ARIMA
-    fit's fused residual+Jacobian pass at bench scale (4.1ms -> 2.1ms,
-    32768x128 float32, v5e).  On CPU (the test mesh) runtime is
-    FLOP-bound and the 16x larger scan bodies only inflate compile time,
-    so the factor stays 1.  Evaluated lazily at trace time — importing the
-    package must not initialize a JAX backend."""
+    FLOPs; unrolling 8 steps per XLA while-iteration halves the ARIMA
+    fit's fused residual+Jacobian pass at bench scale (4.1ms -> 2.0ms,
+    32768x128 float32, v5e) and nearly triples the EWMA fit (298k -> 842k
+    series/sec at 65536x128; 16 was measured *worse* there — 389k — the
+    wider body spills).  On CPU (the test mesh) runtime is FLOP-bound and
+    larger scan bodies only inflate compile time, so the factor stays 1.
+    Evaluated lazily at trace time — importing the package must not
+    initialize a JAX backend."""
     import jax
-    return 16 if jax.default_backend() != "cpu" else 1
+    return 8 if jax.default_backend() != "cpu" else 1
 
 
 class FitDiagnostics(NamedTuple):

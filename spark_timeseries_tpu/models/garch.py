@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.optimize import minimize_bfgs, minimize_box
+from ..ops.optimize import minimize_bfgs, minimize_box, minimize_newton
 from . import autoregression
 from .base import FitDiagnostics, diagnostics_from, scan_unroll
 
@@ -173,7 +173,8 @@ def _constrain(params):
 
 
 def fit(ts: jnp.ndarray, init=(0.2, 0.2, 0.2), tol: float = 1e-6,
-        max_iter: int = 500) -> GARCHModel:
+        max_iter: Optional[int] = None,
+        method: str = "newton") -> GARCHModel:
     """Fit GARCH(1,1) by maximum likelihood (ref ``GARCH.scala:33-53``; same
     (.2, .2, .2) initial guess).
 
@@ -181,10 +182,18 @@ def fit(ts: jnp.ndarray, init=(0.2, 0.2, 0.2), tol: float = 1e-6,
     relies on the iterates staying inside the stationarity region
     ``omega > 0, alpha + beta < 1`` (outside it ``h_0`` goes negative and the
     likelihood is NaN).  Batched solves can't afford per-lane luck, so the
-    BFGS here runs in an unconstrained reparameterization of that region —
+    solve here runs in an unconstrained reparameterization of that region —
     ``omega = exp(u)``, ``alpha + beta = sigmoid(s)``,
     ``alpha = sigmoid(r)·(alpha+beta)`` — where the likelihood is smooth
     everywhere; results are mapped back.
+
+    ``method="newton"`` (default): batched damped Newton on the 3x3
+    autodiff Hessian — quadratic convergence, ~10-30 iterations, and it
+    reaches optima the vmapped-BFGS line search sometimes gives up short of.
+    ``method="bfgs"`` keeps the previous solver.
+
+    ``max_iter`` defaults per method (100 for Newton, 500 for BFGS — the
+    previous solver keeps its previous budget).
 
     ``ts (..., n)``; leading dims fit in one batched solve.
     """
@@ -197,7 +206,14 @@ def fit(ts: jnp.ndarray, init=(0.2, 0.2, 0.2), tol: float = 1e-6,
     o0, a0, b0 = (jnp.asarray(v, ts.dtype) for v in init)
     x0 = jnp.broadcast_to(jnp.stack(_unconstrain(o0, a0, b0), axis=-1),
                           (*ts.shape[:-1], 3))
-    res = minimize_bfgs(neg_ll, x0, ts, tol=tol, max_iter=max_iter)
+    if method == "newton":
+        res = minimize_newton(neg_ll, x0, ts, tol=tol,
+                              max_iter=100 if max_iter is None else max_iter)
+    elif method == "bfgs":
+        res = minimize_bfgs(neg_ll, x0, ts, tol=tol,
+                            max_iter=500 if max_iter is None else max_iter)
+    else:
+        raise ValueError(f"unknown method {method!r}")
     ok = jnp.all(jnp.isfinite(res.x), axis=-1, keepdims=True)
     params = jnp.where(ok, res.x, x0)
     return GARCHModel(*_constrain(params),
@@ -433,8 +449,9 @@ def _eg_constrain(params):
             params[..., 3])
 
 
-def fit_egarch(ts: jnp.ndarray, init=(0.2, 0.9, 0.0), tol: float = 1e-12,
-               max_iter: int = 1000) -> EGARCHModel:
+def fit_egarch(ts: jnp.ndarray, init=(0.2, 0.9, 0.0),
+               tol: Optional[float] = None, max_iter: Optional[int] = None,
+               method: str = "newton") -> EGARCHModel:
     """Fit EGARCH(1,1) by maximum likelihood, batched over leading dims.
 
     ``init = (alpha0, beta0, gamma0)``; ``omega0`` is implied by matching
@@ -443,13 +460,20 @@ def fit_egarch(ts: jnp.ndarray, init=(0.2, 0.9, 0.0), tol: float = 1e-12,
     log-variance form needs no positivity constraints — that is EGARCH's
     selling point, and what makes the batched solve well-behaved).
 
-    The solver is the batched Armijo-backtracking descent
-    (:func:`~spark_timeseries_tpu.ops.optimize.minimize_box` with infinite
-    bounds): the raw likelihood's gradient is badly scaled at the variance-
-    matched start (∂/∂gamma is ~10x ∂/∂beta) and BFGS's first line search
-    fails outright there, while the backtracking descent reaches the same
-    optimum as a derivative-free scalar oracle (see
-    ``tests/test_garch.py::test_egarch_fit_matches_independent_scalar_mle``).
+    ``method="newton"`` (default): batched damped Newton on the 4x4
+    autodiff Hessian (~10-30 iterations).  ``method="descent"``: batched
+    Armijo-backtracking descent — the robust first-order fallback, needing
+    on the order of hundreds of iterations.  Raw BFGS is not offered: the
+    likelihood's gradient is badly scaled at the variance-matched start
+    (∂/∂gamma is ~10x ∂/∂beta) and its first line search fails outright.
+    Both solvers reach the same optimum as a derivative-free scalar oracle
+    (see ``tests/test_garch.py::test_egarch_fit_matches_independent_scalar_mle``
+    and ``test_egarch_descent_matches_newton``).
+
+    ``tol`` and ``max_iter`` default per method and dtype (Newton: the
+    solver's dtype-aware tolerance — 1e-6 in float32, where a 1e-12
+    relative-drop test would be unreachable — and 200 iterations; descent:
+    1e-12 and 1000 iterations); explicit values are honored as given.
     """
     ts = jnp.asarray(ts)
 
@@ -462,8 +486,15 @@ def fit_egarch(ts: jnp.ndarray, init=(0.2, 0.9, 0.0), tol: float = 1e-12,
     w0 = (1.0 - b0) * logvar
     x0 = jnp.stack(jnp.broadcast_arrays(
         w0, a0, jnp.arctanh(b0), g0), axis=-1).astype(ts.dtype)
-    res = minimize_box(neg_ll, x0, -jnp.inf, jnp.inf, ts,
-                       tol=tol, max_iter=max_iter)
+    if method == "newton":
+        res = minimize_newton(neg_ll, x0, ts, tol=tol,
+                              max_iter=200 if max_iter is None else max_iter)
+    elif method == "descent":
+        res = minimize_box(neg_ll, x0, -jnp.inf, jnp.inf, ts,
+                           tol=1e-12 if tol is None else tol,
+                           max_iter=1000 if max_iter is None else max_iter)
+    else:
+        raise ValueError(f"unknown method {method!r}")
     ok = jnp.all(jnp.isfinite(res.x), axis=-1, keepdims=True)
     params = jnp.where(ok, res.x, x0)
     return EGARCHModel(*_eg_constrain(params),

@@ -167,6 +167,25 @@ def test_fit_matches_independent_scalar_mle():
     assert abs(-oracle.fun - ll_ours) < 0.5
 
 
+def test_fit_bfgs_fallback_matches_newton():
+    """The previous BFGS solver stays available and lands on the same
+    optimum as the Newton default (where both converge)."""
+    gen = garch.GARCHModel(jnp.asarray(0.15), jnp.asarray(0.2),
+                           jnp.asarray(0.6))
+    ts = gen.sample(3000, jax.random.PRNGKey(21), shape=(3,))
+    mn = garch.fit(ts)
+    mb = garch.fit(ts, method="bfgs")
+    both = np.asarray(mn.diagnostics.converged) \
+        & np.asarray(mb.diagnostics.converged)
+    assert both.any()
+    for field in ("omega", "alpha", "beta"):
+        a = np.asarray(getattr(mn, field))[both]
+        b = np.asarray(getattr(mb, field))[both]
+        np.testing.assert_allclose(a, b, atol=5e-3)
+    with pytest.raises(ValueError):
+        garch.fit(ts, method="nope")
+
+
 # -- EGARCH (beyond-reference: the reference declares this model but leaves
 # -- every method unsupported, GARCH.scala:262-283) --------------------------
 
@@ -229,6 +248,23 @@ def test_egarch_fit_recovers_parameters_batched():
     assert abs(float(jnp.median(fitted.beta)) - 0.85) < 0.08
     assert abs(float(jnp.median(fitted.alpha)) - 0.25) < 0.10
     assert abs(float(jnp.median(fitted.gamma)) + 0.25) < 0.10
+
+
+def test_egarch_descent_matches_newton():
+    """The first-order descent fallback reaches the Newton optimum, and an
+    explicit max_iter is honored rather than floored."""
+    gen = garch.EGARCHModel(jnp.asarray(0.1), jnp.asarray(0.3),
+                            jnp.asarray(0.8), jnp.asarray(-0.2))
+    ts = gen.sample(1500, jax.random.PRNGKey(22), shape=(2,))
+    mn = garch.fit_egarch(ts)
+    md = garch.fit_egarch(ts, method="descent")
+    for field in ("omega", "alpha", "beta", "gamma"):
+        np.testing.assert_allclose(np.asarray(getattr(mn, field)),
+                                   np.asarray(getattr(md, field)), atol=0.02)
+    capped = garch.fit_egarch(ts, max_iter=3, method="descent")
+    assert int(jnp.max(capped.diagnostics.n_iter)) <= 3
+    with pytest.raises(ValueError):
+        garch.fit_egarch(ts, method="nope")
 
 
 def test_egarch_fit_matches_independent_scalar_mle():

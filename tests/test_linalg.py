@@ -1,0 +1,67 @@
+"""Unit tests for the batched dense-linear-algebra kernels, especially the
+unrolled small-SPD Cholesky paths that replaced ``jnp.linalg.solve``/``inv``
+on the fit hot loops (they are exercised indirectly by every model test;
+these pin the numerics directly against numpy)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from spark_timeseries_tpu.ops.linalg import (ols, ols_gram, spd_inverse,
+                                             spd_solve)
+
+
+def _spd(batch, p, seed):
+    rng = np.random.default_rng(seed)
+    A = rng.normal(size=(*batch, p, p))
+    return A @ np.swapaxes(A, -1, -2) + 3.0 * np.eye(p)
+
+
+def test_spd_solve_matches_numpy_across_sizes():
+    # p=1..16 exercises the unrolled path, p=20 the cho_solve fallback
+    for p in (1, 2, 3, 5, 8, 16, 20):
+        A = _spd((7,), p, p)
+        b = np.random.default_rng(p + 100).normal(size=(7, p))
+        x = np.asarray(spd_solve(jnp.asarray(A), jnp.asarray(b)))
+        ref = np.linalg.solve(A, b[..., None])[..., 0]
+        np.testing.assert_allclose(x, ref, rtol=1e-9, atol=1e-9)
+
+
+def test_spd_solve_zero_width():
+    x = spd_solve(jnp.zeros((4, 0, 0)), jnp.zeros((4, 0)))
+    assert x.shape == (4, 0)
+
+
+def test_spd_inverse_matches_numpy_across_sizes():
+    for p in (1, 2, 5, 11, 16, 20):
+        A = _spd((5,), p, p + 1)
+        inv = np.asarray(spd_inverse(jnp.asarray(A)))
+        np.testing.assert_allclose(inv, np.linalg.inv(A), rtol=1e-8,
+                                   atol=1e-9)
+
+
+def test_spd_solve_non_spd_lane_yields_nan_not_garbage():
+    """A non-SPD lane must surface as NaN (negative pivot under sqrt) so the
+    callers' per-lane quarantine masks catch it."""
+    A = _spd((3,), 4, 0)
+    A[1] = -np.eye(4)                       # negative definite lane
+    b = np.ones((3, 4))
+    x = np.asarray(spd_solve(jnp.asarray(A), jnp.asarray(b)))
+    assert np.isfinite(x[0]).all() and np.isfinite(x[2]).all()
+    assert np.isnan(x[1]).any()
+
+
+def test_ols_gram_matches_qr_ols():
+    rng = np.random.default_rng(1)
+    S, n, p = 6, 200, 4
+    X = rng.normal(size=(S, n, p))
+    beta_true = rng.normal(size=(S, p))
+    y = np.einsum("snp,sp->sn", X, beta_true) + 0.01 * rng.normal(size=(S, n))
+    Xs = jnp.asarray(np.swapaxes(X, -1, -2))        # stacked (S, p, n)
+    res_g = ols_gram(Xs, jnp.asarray(y))
+    res_q = ols(jnp.asarray(X), jnp.asarray(y))
+    np.testing.assert_allclose(np.asarray(res_g.beta),
+                               np.asarray(res_q.beta), rtol=1e-6, atol=1e-8)
+    np.testing.assert_allclose(np.asarray(res_g.xtx_inv),
+                               np.asarray(res_q.xtx_inv), rtol=1e-6,
+                               atol=1e-8)

@@ -60,7 +60,6 @@ def test_adftest_statistic_matches_scalar_ols():
             targets = []
             for t in range(max_lag, n - 1):
                 lagged_diffs = [dy[t - k] for k in range(1, max_lag + 1)]
-                trend = [t + 1.0] if trend_order >= 1 else []
                 # deterministic terms: 1, s, s^2 with s = row index + 1
                 s = t - max_lag + 1.0
                 det = [s ** k for k in range(1, trend_order)]
