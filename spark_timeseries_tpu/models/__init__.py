@@ -13,13 +13,14 @@ from .arima import ARIMAModel
 from .arimax import ARIMAXModel
 from .autoregression import ARModel
 from .autoregression_x import ARXModel
-from .base import FitDiagnostics, TimeSeriesModel
+from .base import FitDiagnostics, TimeSeriesModel, refit_unconverged
 from .ewma import EWMAModel
 from .garch import ARGARCHModel, EGARCHModel, GARCHModel
 from .holt_winters import HoltWintersModel
 from .regression_arima import RegressionARIMAModel
 
-__all__ = ["TimeSeriesModel", "FitDiagnostics", "ewma", "EWMAModel",
+__all__ = ["TimeSeriesModel", "FitDiagnostics", "refit_unconverged",
+           "ewma", "EWMAModel",
            "autoregression", "ARModel",
            "autoregression_x", "ARXModel",
            "arima", "ARIMAModel", "arimax", "ARIMAXModel",

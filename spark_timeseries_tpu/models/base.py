@@ -67,6 +67,88 @@ def diagnostics_from(res, lane_ok=None) -> FitDiagnostics:
                           jnp.asarray(res.n_iter), fun)
 
 
+def refit_unconverged(values, model, fit_fn, min_bucket: int = 256):
+    """Compact-and-refit the lanes of a batched fit that did not converge.
+
+    The batched answer to heterogeneous convergence (SURVEY.md §7 hard part
+    #3): under ``vmap`` every lane pays the slowest lane's iterations, so
+    production fits cap the iteration budget (e.g. ``arima.fit``'s LM cap)
+    and a tail of hard lanes — near-unit-root series, poor inits — reports
+    ``diagnostics.converged == False``.  Instead of re-running the whole
+    panel with a larger budget (reference analogue: the per-series ``Try``
+    fallback re-fits, ``ARIMA.scala:315-319``), this gathers just those
+    lanes into a small padded batch, re-fits them there, and scatters the
+    results back.  Cost scales with the unconverged fraction, not the panel.
+
+    ``values (n_series, n)`` is the data the model was fitted on; ``model``
+    is any fitted model pytree whose ``diagnostics.converged`` has one entry
+    per series.  ``fit_fn(sub_values, sub_model) -> sub_fitted`` re-fits the
+    compacted subset — it receives the per-lane slice of the original model
+    so it can warm-start, e.g.::
+
+        model = arima.fit(2, 1, 2, values)                  # capped budget
+        model = refit_unconverged(
+            values, model,
+            lambda v, m: arima.fit(2, 1, 2, v, max_iter=500,
+                                   user_init_params=m.coefficients))
+
+    The compacted batch is padded (repeating the first hard lane) up to a
+    power-of-two size ``>= min_bucket`` so repeated refits compile a bounded
+    set of shapes.  Lanes already converged are returned bit-identical.
+    """
+    import numpy as np
+
+    if getattr(model, "diagnostics", None) is None:
+        raise ValueError("model carries no diagnostics; fit it first")
+    conv = np.asarray(model.diagnostics.converged)
+    if conv.ndim == 0:
+        # unbatched model: its leaves are scalars, so a scatter-merge has
+        # nothing to index — re-run the fit directly instead
+        raise ValueError(
+            "model is unbatched (scalar diagnostics); refit_unconverged "
+            "needs a batched fit — re-fit the single series directly")
+    conv = conv.reshape(-1)
+    n_series = conv.shape[0]
+    values = jnp.asarray(values)
+    if values.ndim < 2 or values.shape[0] != n_series:
+        raise ValueError(
+            f"values {values.shape} does not match the model's "
+            f"{n_series} diagnosed lanes")
+    idx = np.flatnonzero(~conv)
+    if idx.size == 0:
+        return model
+
+    # never refit a batch larger than the panel itself (a tiny panel would
+    # otherwise be padded up to min_bucket and cost MORE than a full re-fit)
+    bucket = max(min_bucket, 1 << (int(idx.size) - 1).bit_length())
+    if bucket > n_series:
+        bucket = n_series
+    pad_idx = idx if bucket == idx.size else np.concatenate(
+        [idx, np.full(bucket - idx.size, idx[0], idx.dtype)])
+
+    import jax
+
+    def _slice(leaf):
+        arr = jnp.asarray(leaf)
+        if arr.ndim >= 1 and arr.shape[0] == n_series:
+            return arr[pad_idx]
+        return leaf
+
+    sub_fitted = fit_fn(values[pad_idx],
+                        jax.tree_util.tree_map(_slice, model))
+
+    k = idx.size
+
+    def _merge(orig, new):
+        arr = jnp.asarray(orig)
+        if arr.ndim >= 1 and arr.shape[0] == n_series:
+            return arr.at[idx].set(
+                jnp.asarray(new)[:k].astype(arr.dtype))
+        return orig
+
+    return jax.tree_util.tree_map(_merge, model, sub_fitted)
+
+
 class TimeSeriesModel:
     """Informal interface; concrete models are NamedTuple pytrees."""
 
