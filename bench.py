@@ -94,12 +94,16 @@ def _baseline_rate(panel: np.ndarray, sample: int = BASELINE_SAMPLE):
 
 
 def _peak_memory_bytes():
+    """Device peak memory, or None when the platform doesn't expose
+    ``memory_stats`` (the tunneled axon runtime reports nothing — emitting
+    0.0 would read as a measurement)."""
     import jax
     try:
         stats = jax.local_devices()[0].memory_stats()
-        return int(stats.get("peak_bytes_in_use", 0)) if stats else 0
+        peak = (stats or {}).get("peak_bytes_in_use")
+        return int(peak) if peak else None
     except Exception:
-        return 0
+        return None
 
 
 def main():
@@ -170,7 +174,9 @@ def main():
         "unit": "series/sec",
         "vs_baseline": round(rate_1m / cpu_rate, 2),
         "scaling_curve": curve,
-        "peak_device_memory_mb": round(_peak_memory_bytes() / 2**20, 1),
+        "peak_device_memory_mb": (
+            round(_peak_memory_bytes() / 2**20, 1)
+            if _peak_memory_bytes() is not None else None),
         "baseline_emulation": {
             "kind": "per-series scipy Powell on the same CSS objective",
             "sample": BASELINE_SAMPLE,
