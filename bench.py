@@ -124,7 +124,18 @@ def main():
 
     panel = _synthetic_arima_panel(n_target, n_obs)
 
-    fit = jax.jit(lambda v: arima.fit(2, 1, 2, v, warn=False).coefficients)
+    def _fit(v, n_real):
+        m = arima.fit(2, 1, 2, v, warn=False)
+        # converged-lane count rides along so the throughput number is
+        # auditable (speed not bought by silent non-convergence); one extra
+        # scalar per chunk, no extra passes.  ``n_real`` masks the ragged
+        # tail's zero-padded lanes out of the count (traced, so the tail
+        # reuses the same executable).
+        lane = jnp.arange(v.shape[0]) < n_real
+        return (m.coefficients,
+                jnp.sum(jnp.where(lane, m.diagnostics.converged, False)))
+
+    fit = jax.jit(_fit)
 
     def run(values: np.ndarray, chunk_n: int) -> float:
         """Fit a panel chunked through HBM; returns wall seconds.  Timing is
@@ -139,30 +150,42 @@ def main():
         HBM at once."""
         t0 = time.perf_counter()
         pending = None
+        converged = 0
+
+        def pull(out):
+            nonlocal converged
+            np.asarray(out[0])
+            converged += int(out[1])
+
         for start in range(0, values.shape[0], chunk_n):
             part = values[start:start + chunk_n]
-            if part.shape[0] != chunk_n:    # ragged tail: pad to one shape
-                pad = np.zeros((chunk_n - part.shape[0], n_obs), part.dtype)
+            n_real = part.shape[0]
+            if n_real != chunk_n:           # ragged tail: pad to one shape
+                pad = np.zeros((chunk_n - n_real, n_obs), part.dtype)
                 part = np.concatenate([part, pad])
-            out = fit(jnp.asarray(part, dtype))
+            out = fit(jnp.asarray(part, dtype), jnp.asarray(n_real))
             if pending is not None:
-                np.asarray(pending)
+                pull(pending)
             pending = out
-        np.asarray(pending)
-        return time.perf_counter() - t0
+        pull(pending)
+        return time.perf_counter() - t0, converged
 
     # scaling curve: does the small-panel rate hold at 1M?  Each point uses
     # chunk = min(CHUNK, n) so small panels aren't padded up to the big
     # chunk shape (jit caches one executable per chunk shape)
     curve = {}
+    converged_target = 0
     for n in (8192, 65536, 524288, n_target):
         if n > n_target:
             continue
         c = min(chunk, n)
-        np.asarray(fit(jnp.asarray(panel[:c], dtype)))      # warm this shape
+        np.asarray(fit(jnp.asarray(panel[:c], dtype),
+                       jnp.asarray(c))[0])                  # warm this shape
         reps = 2 if n <= 65536 else 1
-        dt = min(run(panel[:n], c) for _ in range(reps))
+        dt, conv = min(run(panel[:n], c) for _ in range(reps))
         curve[str(n)] = round(n / dt, 1)
+        if n == n_target:
+            converged_target = conv
     rate_1m = curve[str(n_target)]
 
     cpu_rate, cpu_times = _baseline_rate(panel)
@@ -173,6 +196,7 @@ def main():
         "value": rate_1m,
         "unit": "series/sec",
         "vs_baseline": round(rate_1m / cpu_rate, 2),
+        "converged_pct": round(100.0 * converged_target / n_target, 2),
         "scaling_curve": curve,
         "peak_device_memory_mb": (
             round(_peak_memory_bytes() / 2**20, 1)
