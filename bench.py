@@ -138,7 +138,8 @@ def main():
     fit = jax.jit(_fit)
 
     def run(values: np.ndarray, chunk_n: int) -> float:
-        """Fit a panel chunked through HBM; returns wall seconds.  Timing is
+        """Fit a panel chunked through HBM; returns
+        ``(wall_seconds, converged_lane_count)``.  Timing is
         to host materialization of every chunk's coefficients (on the
         tunneled TPU platform block_until_ready alone does not synchronize),
         and includes the H2D transfer of each chunk — the real pipeline

@@ -356,13 +356,27 @@ def _all_roots_outside_unit_circle(polys: np.ndarray) -> np.ndarray:
     if k < 1:
         return np.ones(batch, dtype=bool)
     flat = polys.reshape(-1, k + 1)
-    comp = np.zeros((flat.shape[0], k, k))
-    with np.errstate(divide="ignore", invalid="ignore"):
-        comp[:, k - 1, :] = -flat[:, :k] / flat[:, k:k + 1]
-    if k > 1:
-        comp[:, :k - 1, 1:] = np.eye(k - 1)
-    roots = np.linalg.eigvals(comp)                     # (B, k)
-    ok = ~np.any(np.abs(roots) <= 1.0, axis=-1)
+    ok = np.ones(flat.shape[0], dtype=bool)
+    # a zero leading coefficient means the polynomial's effective degree is
+    # lower (e.g. an exactly-zero trailing AR coefficient) — dividing by it
+    # poisons the companion matrix and eigvals raises on non-finite input;
+    # peel degrees down, batching the eigvals call per effective degree
+    remaining = np.ones(flat.shape[0], dtype=bool)
+    ok &= np.all(np.isfinite(flat), axis=-1)            # NaN lane: not ok
+    remaining &= np.all(np.isfinite(flat), axis=-1)
+    for deg in range(k, 0, -1):
+        lead = np.abs(flat[:, deg]) > 1e-300
+        process = remaining & lead
+        if np.any(process):
+            sub = flat[process]
+            comp = np.zeros((sub.shape[0], deg, deg))
+            comp[:, deg - 1, :] = -sub[:, :deg] / sub[:, deg:deg + 1]
+            if deg > 1:
+                comp[:, :deg - 1, 1:] = np.eye(deg - 1)
+            roots = np.linalg.eigvals(comp)             # (b, deg)
+            ok[process] &= ~np.any(np.abs(roots) <= 1.0, axis=-1)
+        remaining &= ~lead
+    # lanes still remaining are constant polynomials: no roots, trivially ok
     return ok.reshape(batch) if batch else bool(ok.reshape(()))
 
 
@@ -622,6 +636,124 @@ def fit_panel(panel, p: int, d: int, q: int, **kwargs) -> ARIMAModel:
     """Batched fit over a Panel — the ``rdd.mapValues(ARIMA.fitModel(...))``
     equivalent (ref ``src/site/markdown/docs/users.md:107-118``)."""
     return fit(p, d, q, panel.values, **kwargs)
+
+
+def fit_long(p: int, d: int, q: int, ts: jnp.ndarray,
+             segment_len: int = 65536, **kwargs) -> ARIMAModel:
+    """ARIMA for ultra-long series: segment-parallel CSS fits combined by
+    precision weighting.
+
+    The CSS likelihood's MA recursion is inherently sequential in t, so a
+    direct fit of a multi-million-observation series serializes the time
+    axis (the EWMA/GARCH recurrences are associative scans; this one is
+    not).  Beyond-reference capability in the spirit of distributed-ARIMA /
+    divide-and-conquer estimation (DLSA; see PAPERS.md "Distributed ARIMA
+    Models for Ultra-long Time Series"): after differencing, the series is
+    split into ``n // segment_len`` contiguous segments, every segment is
+    fitted as one lane of the existing batched ARMA solve (time blocks
+    become the batch axis — embarrassingly parallel, mesh-shardable), and
+    the per-segment estimates ``theta_k`` are combined by inverse-covariance
+    weighting
+
+        theta* = (sum_k H_k)^{-1} sum_k H_k theta_k,
+
+    where ``H_k`` is the autodiff Hessian of the segment's negative CSS
+    log-likelihood at its optimum (the asymptotic precision of the CSS
+    estimator).  Segments with non-finite estimates or a non-PD Hessian get
+    weight 0; if no segment is weightable the result falls back to the
+    plain mean of finite segment estimates (and the quarantined HR inits
+    those contain), mirroring ``fit``'s quarantine-to-init behavior.
+
+    The head remainder (``n - d - n_segments*segment_len`` observations) is
+    dropped from estimation — the most recent data always participates;
+    per-segment CSS also drops its own ``max(p, q)`` burn-in, so cross-
+    boundary MA carry is ignored (each segment conditions on zero initial
+    errors, exactly like the reference's CSS on a whole series).
+
+    ``ts (n,)`` or ``(batch, n)``; returns a standard :class:`ARIMAModel`
+    (scalar or per-batch coefficients) whose diagnostics aggregate the
+    per-segment fits (``converged`` = at least one weightable segment whose
+    own fit converged, ``n_iter`` = max over segments, ``fun`` = the masked
+    sum of weightable segments' objectives).  ``kwargs`` pass through to
+    :func:`fit` (``method``, ``max_iter``, ``include_intercept``, ...);
+    ``warn`` keeps :func:`fit`'s default (warnings evaluated once, on the
+    combined model).
+    """
+    ts = jnp.asarray(ts)
+    single = ts.ndim == 1
+    if single:
+        ts = ts[None]
+    batch, n = ts.shape
+    diffed = differences_of_order_d(ts, d)[..., d:]
+    n_diff = diffed.shape[-1]
+    n_segments = n_diff // segment_len
+    if n_segments < 2:
+        raise ValueError(
+            f"series too short to segment: {n_diff} differenced obs at "
+            f"segment_len={segment_len} gives {n_segments} segment(s); "
+            "call fit() directly")
+    # keep the most recent complete segments; drop the head remainder
+    segs = diffed[..., n_diff - n_segments * segment_len:]
+    segs = segs.reshape(batch * n_segments, segment_len)
+
+    include_intercept = kwargs.get("include_intercept", True)
+    warn = kwargs.pop("warn", True)
+    m = fit(p, 0, q, segs, warn=False, **kwargs)
+
+    icpt = 1 if include_intercept else 0
+    dim = icpt + p + q
+    theta = m.coefficients.reshape(batch, n_segments, dim)
+
+    # per-segment precision: Hessian of the segment's negative CSS
+    # log-likelihood at the optimum (tiny dim x dim, batched)
+    def neg_ll(prm, y):
+        return -_log_likelihood_css_arma(prm, y, p, q, icpt)
+
+    H = jax.vmap(jax.hessian(neg_ll))(m.coefficients, segs)
+    H = H.reshape(batch, n_segments, dim, dim)
+
+    # weightable = finite estimate + finite, PD-ish Hessian.  A segment
+    # whose optimizer merely hit its iteration cap still carries its best
+    # parameters and a valid curvature — it contributes to the combination;
+    # convergence gates the reported flag below, not the weights.
+    finite_t = jnp.all(jnp.isfinite(theta), axis=-1)
+    ok = (finite_t
+          & jnp.all(jnp.isfinite(H), axis=(-2, -1))
+          & jnp.all(jnp.diagonal(H, axis1=-2, axis2=-1) > 0, axis=-1))
+    # zero out unusable segments with where (NaN * 0 is NaN — a poisoned
+    # segment must not leak through the weighted sums)
+    H_ok = jnp.where(ok[..., None, None], H, 0.0)
+    theta_ok = jnp.where(ok[..., None], theta, 0.0)
+    H_sum = jnp.sum(H_ok, axis=1)                          # (batch, dim, dim)
+    Ht_sum = jnp.sum(H_ok @ theta_ok[..., None], axis=1)   # (batch, dim, 1)
+    eye = jnp.eye(dim, dtype=H.dtype)
+    combined = spd_solve(H_sum + 1e-8 * eye, Ht_sum[..., 0])
+    # fallback chain: no weightable segment (H_sum ~ 0 solves to an exact
+    # zero vector, which would silently read as a "fit") or a non-finite
+    # solve -> plain mean of the finite segment estimates, which includes
+    # the quarantined HR inits; only if nothing is finite keep zeros
+    n_finite = jnp.maximum(jnp.sum(finite_t, axis=-1), 1)
+    mean_finite = (jnp.sum(jnp.where(finite_t[..., None], theta, 0.0), axis=1)
+                   / n_finite[..., None].astype(theta.dtype))
+    use_solve = (jnp.any(ok, axis=-1, keepdims=True)
+                 & jnp.all(jnp.isfinite(combined), axis=-1, keepdims=True))
+    combined = jnp.where(use_solve, combined, mean_finite)
+
+    fun = jnp.sum(jnp.where(ok, m.diagnostics.fun.reshape(batch, n_segments),
+                            0.0), axis=-1)
+    diags = FitDiagnostics(
+        jnp.any(ok & m.diagnostics.converged.reshape(batch, n_segments),
+                axis=-1),
+        jnp.max(m.diagnostics.n_iter.reshape(batch, n_segments), axis=-1),
+        fun)
+    if single:
+        combined = combined[0]
+        diags = FitDiagnostics(diags.converged[0], diags.n_iter[0],
+                               diags.fun[0])
+    model = ARIMAModel(p, d, q, combined, include_intercept,
+                       diagnostics=diags)
+    _warn_stationarity_invertibility(model, warn)
+    return model
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,89 @@
+"""Segment-parallel ARIMA for ultra-long series (``arima.fit_long``).
+
+Beyond-reference capability (PAPERS.md: distributed ARIMA / DLSA): the CSS
+MA recursion is sequential in t, so ultra-long series are fitted as
+contiguous segments on the batch axis and combined by inverse-covariance
+(Hessian) weighting.  The contract checked here: the combined estimate
+agrees with a direct full-series fit, batched input works, bad segments are
+down-weighted, and forecasting from the combined model works end to end.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from spark_timeseries_tpu.models import arima
+
+
+def _long_arma(n, phi=(0.5, -0.2), theta=(0.4,), c=0.3, seed=0, batch=None):
+    rng = np.random.default_rng(seed)
+    shape = (batch, n) if batch else (n,)
+    eps = rng.normal(size=(batch or 1, n + 2))
+    y = np.zeros((batch or 1, n))
+    for t in range(2, n):
+        y[:, t] = (c + phi[0] * y[:, t - 1] + phi[1] * y[:, t - 2]
+                   + eps[:, t + 2] + theta[0] * eps[:, t + 1])
+    out = y if batch else y[0]
+    return np.asarray(out).reshape(shape)
+
+
+def test_fit_long_matches_direct_fit():
+    y = _long_arma(16384)
+    direct = arima.fit(2, 0, 1, y, warn=False)
+    seg = arima.fit_long(2, 0, 1, y, segment_len=2048)
+    assert np.asarray(seg.diagnostics.converged)
+    np.testing.assert_allclose(np.asarray(seg.coefficients),
+                               np.asarray(direct.coefficients), atol=0.05)
+
+
+def test_fit_long_recovers_truth_with_differencing():
+    y = _long_arma(32768, seed=3)
+    ts = np.cumsum(y)                      # I(1)
+    m = arima.fit_long(2, 1, 1, ts, segment_len=4096)
+    c, phi, th = (np.asarray(m.intercept), np.asarray(m.ar_coefficients),
+                  np.asarray(m.ma_coefficients))
+    np.testing.assert_allclose(phi, [0.5, -0.2], atol=0.08)
+    np.testing.assert_allclose(th, [0.4], atol=0.08)
+    np.testing.assert_allclose(c, 0.3, atol=0.1)
+    # the combined model forecasts from the raw (undifferenced) tail
+    fc = m.forecast(ts[-512:], 8)
+    assert fc.shape == (520,)
+    assert np.all(np.isfinite(np.asarray(fc)))
+
+
+def test_fit_long_batched():
+    ts = _long_arma(8192, batch=3, seed=4)
+    m = arima.fit_long(2, 0, 1, ts, segment_len=2048)
+    assert np.asarray(m.coefficients).shape == (3, 4)
+    assert np.asarray(m.diagnostics.converged).shape == (3,)
+    direct = arima.fit(2, 0, 1, ts, warn=False)
+    np.testing.assert_allclose(np.asarray(m.coefficients),
+                               np.asarray(direct.coefficients), atol=0.06)
+
+
+def test_fit_long_downweights_poisoned_segment():
+    y = _long_arma(8192, seed=6)
+    y_bad = y.copy()
+    y_bad[:2048] = np.nan                  # oldest segment unusable
+    m = arima.fit_long(2, 0, 1, y_bad, segment_len=2048)
+    assert bool(np.asarray(m.diagnostics.converged))
+    assert np.all(np.isfinite(np.asarray(m.coefficients)))
+    clean = arima.fit_long(2, 0, 1, y, segment_len=2048)
+    np.testing.assert_allclose(np.asarray(m.coefficients),
+                               np.asarray(clean.coefficients), atol=0.1)
+
+
+def test_fit_long_all_segments_unusable_falls_back_finite():
+    # every segment NaN: no weightable segment, no finite estimate anywhere
+    # -> still returns finite coefficients (zeros) with converged=False,
+    # never a silent all-zero "fit" flagged as converged
+    y = np.full(8192, np.nan)
+    m = arima.fit_long(2, 0, 1, y, segment_len=2048)
+    assert not bool(np.asarray(m.diagnostics.converged))
+    assert np.all(np.isfinite(np.asarray(m.coefficients)))
+
+
+def test_fit_long_rejects_short_series():
+    y = _long_arma(1024)
+    with pytest.raises(ValueError, match="too short"):
+        arima.fit_long(1, 0, 1, y, segment_len=1024)
