@@ -399,6 +399,31 @@ def main():
     results.append(("long-series GARCH fit + EWMA smooth (obs/sec)",
                     n, n_obs, obs_rate, (cpu_obs_rate, 1)))
 
+    # 8. ultra-long ARIMA: segment-parallel fit_long vs the direct CSS fit
+    # on the same series.  The direct fit's lax.scan serializes the time
+    # axis (its wall time is scan-latency-bound); fit_long folds time
+    # blocks into the batch axis.  vs_baseline here is the measured speedup
+    # over the DIRECT TPU fit (an in-framework baseline, not the CPU
+    # emulation), with coefficient agreement asserted so the speed is not
+    # buying a different answer.
+    n, n_obs = 8, int(os.environ.get("BENCH_ULTRA_OBS", "262144"))
+    seg_len = max(4096, n_obs // 16)
+    ultra = _synthetic_arima_panel(n, n_obs, seed=7)
+    vals = jnp.asarray(ultra, dtype)
+    fit_direct = jax.jit(
+        lambda v: arima.fit(2, 1, 2, v, warn=False).coefficients)
+    fit_seg = jax.jit(
+        lambda v: arima.fit_long(2, 1, 2, v, segment_len=seg_len,
+                                 warn=False).coefficients)
+    dt_direct, out_d = _timed(fit_direct, vals, reps=1)
+    dt_seg, out_s = _timed(fit_seg, vals, reps=1)
+    agree = float(np.max(np.abs(out_d[0] - out_s[0])))
+    results.append(("ultra-long ARIMA fit_long (obs/sec)", n, n_obs,
+                    n * n_obs / dt_seg, (n * n_obs / dt_direct, 1)))
+    print(json.dumps({
+        "metric": f"fit_long vs direct coefficient max-abs-diff ({n}x{n_obs})",
+        "value": round(agree, 4), "unit": "coefficient delta"}))
+
     for name, n, n_obs, rate, baseline in results:
         unit = "obs/sec" if "obs/sec" in name else "series/sec"
         label = name.replace(" (obs/sec)", "")
