@@ -467,10 +467,18 @@ class ARIMAModel(NamedTuple):
     def forecast(self, ts: jnp.ndarray, n_future: int) -> jnp.ndarray:
         """Fitted 1-step-ahead historicals followed by ``n_future`` forecast
         periods (ref ``ARIMA.scala:696-764``)."""
+        ts = jnp.asarray(ts)
+        need = self.d + max(self.p, self.q) + 1
+        if ts.shape[-1] < need:
+            # the lag gathers would silently clamp and return garbage
+            raise ValueError(
+                f"forecast needs at least d + max(p, q) + 1 = {need} trailing"
+                f" observations for ARIMA({self.p},{self.d},{self.q}); "
+                f"got {ts.shape[-1]}")
         return _batched(
             lambda prm, y: _forecast_one(
                 prm, y, n_future, self.p, self.d, self.q, self._icpt),
-            jnp.asarray(self.coefficients), jnp.asarray(ts))
+            jnp.asarray(self.coefficients), ts)
 
     # -- diagnostics --------------------------------------------------------
 
@@ -588,7 +596,22 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
         return model._replace(diagnostics=FitDiagnostics(
             jnp.isfinite(fun), jnp.zeros(fun.shape, jnp.int32), fun))
 
+    max_lag = max(p, q)
+    if diffed.shape[-1] <= max_lag:
+        raise ValueError(
+            f"series too short to fit ARIMA({p},{d},{q}): the CSS window "
+            f"needs more than max(p, q) = {max_lag} observations after "
+            f"order-{d} differencing, got {diffed.shape[-1]}")
     if user_init_params is None:
+        # Hannan-Rissanen: AR(max_lag+1) fit, two truncations, then an OLS
+        # that needs at least as many rows as parameters
+        min_n = 2 * max_lag + 2 + p + q + icpt
+        if diffed.shape[-1] < min_n:
+            raise ValueError(
+                f"series too short to fit ARIMA({p},{d},{q}): the "
+                f"Hannan-Rissanen initialization needs >= {min_n} "
+                f"observations after order-{d} differencing, got "
+                f"{diffed.shape[-1]}; pass user_init_params to skip it")
         init = hannan_rissanen_init(p, q, diffed, include_intercept)
     else:
         init = jnp.broadcast_to(jnp.asarray(user_init_params, ts.dtype),

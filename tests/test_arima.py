@@ -259,3 +259,18 @@ def test_auto_fit_panel():
     # each winner must beat the intercept-only candidate it was compared to
     m0 = res.model_for(0)
     assert m0.p + m0.q > 0
+
+
+def test_short_series_errors_are_clear():
+    # too short for any CSS residuals
+    with pytest.raises(ValueError, match="CSS window"):
+        arima.fit(2, 0, 2, jnp.ones((2, 2)), warn=False)
+    # long enough for residuals but not for the HR initialization
+    with pytest.raises(ValueError, match="Hannan-Rissanen"):
+        arima.fit(2, 0, 2, jnp.asarray(np.random.default_rng(0).normal(
+            size=(2, 8))), warn=False)
+    # forecast on a tail shorter than the lag structure must refuse rather
+    # than silently clamp the gathers
+    m = arima.ARIMAModel(2, 1, 2, jnp.ones(6))
+    with pytest.raises(ValueError, match="trailing"):
+        m.forecast(jnp.ones(3), 4)
