@@ -224,6 +224,12 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray, xreg: jnp.ndarray,
     """
     ts = jnp.asarray(ts)
     xreg = jnp.asarray(xreg)
+    if xreg.ndim < 2 or xreg.shape[-2] != ts.shape[-1]:
+        # otherwise the mismatch surfaces later as an opaque concatenate
+        # shape error from the terms assembly
+        raise ValueError(
+            f"xreg must be (n, k) or (..., n, k) with n = series length "
+            f"{ts.shape[-1]}; got {xreg.shape}")
     diffed = differences_of_order_d(ts, d)[..., d:]
     # size-preserving per-column differencing once; the dropped-d view feeds
     # the terms assembly, the full-length view the ARX init

@@ -116,3 +116,10 @@ def test_gradient_zero_in_xreg_slots():
     assert g.shape == (5,)
     np.testing.assert_array_equal(g[3:], 0.0)
     assert np.any(g[:3] != 0.0)
+
+
+def test_xreg_row_mismatch_is_clear():
+    y = jnp.asarray(np.random.default_rng(1).normal(size=(3, 50)))
+    X = jnp.asarray(np.random.default_rng(0).normal(size=(30, 2)))
+    with pytest.raises(ValueError, match="series length"):
+        arimax.fit(1, 0, 1, y, X, xreg_max_lag=1)
