@@ -263,6 +263,10 @@ class UniformDateTimeIndex(DateTimeIndex):
                  zone: Union[str, None] = None):
         self.start_nanos = to_nanos(start)
         self.periods = int(periods)
+        if self.periods < 0:
+            # otherwise the first touch is an obscure "__len__() should
+            # return >= 0" far from the construction site
+            raise ValueError(f"periods must be >= 0, got {self.periods}")
         self.frequency = frequency
         if zone is None and isinstance(start, _dt.datetime) and start.tzinfo is not None \
                 and hasattr(start.tzinfo, "key"):
@@ -384,6 +388,11 @@ class IrregularDateTimeIndex(DateTimeIndex):
         else:
             vals = [to_nanos(x) for x in instants]
             self.instants = np.asarray(vals, dtype=np.int64)
+        if self.instants.size > 1 and np.any(np.diff(self.instants) < 0):
+            # every lookup is a binary search (ref DateTimeIndex.scala:352-360)
+            # — unsorted instants would return silently wrong locations
+            raise ValueError(
+                "irregular index instants must be in non-decreasing order")
         self.zone = str(zone) if zone is not None else "Z"
 
     @property

@@ -182,3 +182,13 @@ class TestStringRoundTrip:
     def test_minute_frequency_roundtrip(self):
         ix = uniform(nanos(2015, 4, 10, 9, 30), 100, MinuteFrequency(5))
         assert from_string(ix.to_string()) == ix
+
+
+def test_constructor_input_validation():
+    import pytest
+    with pytest.raises(ValueError, match="periods"):
+        uniform("2020-01-01T00:00Z", -5, DayFrequency(1))
+    with pytest.raises(ValueError, match="non-decreasing"):
+        irregular(["2020-01-03T00:00Z", "2020-01-01T00:00Z"])
+    # duplicates remain legal (touching instants appear in union output)
+    irregular(["2020-01-01T00:00Z", "2020-01-01T00:00Z"])
