@@ -67,7 +67,8 @@ class ARModel(NamedTuple):
     def sample(self, n: int, key, shape=()) -> jnp.ndarray:
         """Gaussian innovations pushed through the model
         (ref ``Autoregression.scala:90-94``)."""
-        noise = jax.random.normal(key, (*shape, n))
+        noise = jax.random.normal(
+            key, (*shape, n), dtype=jnp.asarray(self.coefficients).dtype)
         return self.add_time_dependent_effects(noise)
 
 

@@ -136,7 +136,9 @@ class GARCHModel(NamedTuple):
         """(ref ``GARCH.scala:165-177``; like the reference, index 0 of the
         sample stays 0 — only its variance seeds the recurrence)."""
         w, a, b = self._params
-        z = jax.random.normal(key, (n, *shape))
+        # draws in the parameters' dtype: float32 params under jax_enable_x64
+        # would otherwise mix f32/f64 in the scan carry and fail to trace
+        z = jax.random.normal(key, (n, *shape), dtype=jnp.asarray(w).dtype)
         var0 = jnp.broadcast_to(self._h0(), z.shape[1:])
 
         def step(carry, z_i):
@@ -289,7 +291,7 @@ class ARGARCHModel(NamedTuple):
         c, phi = jnp.asarray(self.c), jnp.asarray(self.phi)
         w, a, b = (jnp.asarray(self.omega), jnp.asarray(self.alpha),
                    jnp.asarray(self.beta))
-        z = jax.random.normal(key, (n, *shape))
+        z = jax.random.normal(key, (n, *shape), dtype=w.dtype)
         var0 = jnp.broadcast_to(self._h0(), z.shape[1:])
 
         def step(carry, z_i):
@@ -434,7 +436,8 @@ class EGARCHModel(NamedTuple):
                               shape=()) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Gaussian draws pushed through the filter; returns (ts, h) from
         the single associative-scan pass."""
-        z = jax.random.normal(key, (*shape, n))
+        z = jax.random.normal(key, (*shape, n),
+                              dtype=jnp.asarray(self.omega).dtype)
         ts, logh = self._filter_with_log_variances(z)
         return ts, jnp.exp(logh)
 

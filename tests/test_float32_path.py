@@ -1,0 +1,72 @@
+"""Production-dtype guard: the fits run float32 on TPU (SURVEY.md §7 hard
+part #7) while the rest of the suite pins float64 for R-oracle parity — so a
+float32-only regression (overflow in a likelihood, an underflowing line
+search) would otherwise surface only on hardware.  JAX weak typing keeps
+float32 inputs float32 through the kernels even with x64 enabled, so these
+run the production dtype path in CI.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from spark_timeseries_tpu.models import arima, ewma, garch, holt_winters
+
+
+def _ar1_panel(n_series=16, n=160, seed=0):
+    rng = np.random.default_rng(seed)
+    eps = rng.normal(size=(n_series, n))
+    y = np.zeros((n_series, n))
+    for t in range(1, n):
+        y[:, t] = 5.0 + 0.6 * y[:, t - 1] + eps[:, t]
+    return jnp.asarray(y, jnp.float32)
+
+
+def test_arima_fit_stays_float32_and_converges():
+    panel = _ar1_panel()
+    m = arima.fit(1, 0, 1, panel, warn=False)
+    assert m.coefficients.dtype == jnp.float32
+    assert np.all(np.isfinite(np.asarray(m.coefficients)))
+    assert np.asarray(m.diagnostics.converged).mean() > 0.5
+    ar = np.asarray(m.ar_coefficients)[:, 0]
+    assert np.median(np.abs(ar - 0.6)) < 0.15
+
+
+def test_ewma_garch_hw_float32():
+    panel = _ar1_panel(seed=1)
+    e = ewma.fit(panel)
+    assert e.smoothing.dtype == jnp.float32
+    assert np.all(np.isfinite(np.asarray(e.smoothing)))
+
+    gen = garch.GARCHModel(jnp.float32(0.05), jnp.float32(0.1),
+                           jnp.float32(0.85))
+    draws = gen.sample(512, jax.random.PRNGKey(0), shape=(8,))
+    g = garch.fit(jnp.asarray(draws, jnp.float32))
+    assert g.alpha.dtype == jnp.float32
+    assert np.all(np.isfinite(np.asarray(g.alpha)))
+    assert abs(float(np.median(np.asarray(g.alpha))) - 0.1) < 0.1
+
+    t = np.arange(96, dtype=np.float32)
+    hw_panel = jnp.asarray(
+        50 + 0.3 * t + 5 * np.sin(2 * np.pi * t / 12)
+        + 0.5 * np.random.default_rng(2).normal(size=(6, 96)),
+        jnp.float32)
+    h = holt_winters.fit(hw_panel, period=12)
+    assert h.alpha.dtype == jnp.float32
+    assert np.all(np.isfinite(np.asarray(h.alpha)))
+
+
+def test_fit_long_and_refit_float32():
+    panel = _ar1_panel(n=4096, seed=3)
+    m = arima.fit_long(1, 0, 1, panel, segment_len=1024)
+    assert m.coefficients.dtype == jnp.float32
+    assert np.all(np.isfinite(np.asarray(m.coefficients)))
+
+    from spark_timeseries_tpu.models import refit_unconverged
+    m0 = arima.fit(1, 0, 1, panel, warn=False, max_iter=2)
+    m1 = refit_unconverged(
+        panel, m0,
+        lambda v, mm: arima.fit(1, 0, 1, v, warn=False, max_iter=100,
+                                user_init_params=mm.coefficients),
+        min_bucket=8)
+    assert m1.coefficients.dtype == jnp.float32
