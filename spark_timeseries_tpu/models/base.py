@@ -128,7 +128,14 @@ def refit_unconverged(values, model, fit_fn, min_bucket: int = 256):
 
     import jax
 
+    def _is_array(leaf):
+        # static leaves (ints like ARIMA's p/d/q, strings like Holt-Winters'
+        # model_type) pass through untouched
+        return isinstance(leaf, (jnp.ndarray, np.ndarray))
+
     def _slice(leaf):
+        if not _is_array(leaf):
+            return leaf
         arr = jnp.asarray(leaf)
         if arr.ndim >= 1 and arr.shape[0] == n_series:
             return arr[pad_idx]
@@ -140,6 +147,8 @@ def refit_unconverged(values, model, fit_fn, min_bucket: int = 256):
     k = idx.size
 
     def _merge(orig, new):
+        if not _is_array(orig):
+            return orig
         arr = jnp.asarray(orig)
         if arr.ndim >= 1 and arr.shape[0] == n_series:
             return arr.at[idx].set(

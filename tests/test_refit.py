@@ -142,3 +142,26 @@ def test_refit_validates_inputs():
         refit_unconverged(
             panel, arima.ARIMAModel(1, 0, 1, m0.coefficients),
             lambda v, m: m)
+
+
+def test_holt_winters_refit_warm_start():
+    from spark_timeseries_tpu.models import holt_winters
+    rng = np.random.default_rng(11)
+    t = np.arange(120)
+    panel = jnp.asarray(40 + 0.2 * t + 6 * np.sin(2 * np.pi * t / 12)
+                        + rng.normal(scale=8.0, size=(12, 120)))
+    m0 = holt_winters.fit(panel, period=12, max_iter=3)
+    conv0 = np.asarray(m0.diagnostics.converged)
+    if conv0.all():
+        pytest.skip("budget of 3 unexpectedly converged everything")
+
+    m1 = refit_unconverged(
+        panel, m0,
+        lambda v, m: holt_winters.fit(
+            v, period=12, max_iter=1000,
+            init=jnp.stack([m.alpha, m.beta, m.gamma], axis=-1)),
+        min_bucket=4)
+    conv1 = np.asarray(m1.diagnostics.converged)
+    assert conv1.sum() > conv0.sum()
+    assert np.array_equal(np.asarray(m1.alpha)[conv0],
+                          np.asarray(m0.alpha)[conv0])
