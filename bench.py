@@ -220,6 +220,9 @@ def main():
             "seconds_incl_compile": round(time.perf_counter() - t0, 2),
         }
 
+    peak = _peak_memory_bytes()
+    peak_mb = round(peak / 2**20, 1) if peak is not None else None
+
     print(json.dumps({
         "metric": "ARIMA(2,1,2) series fitted/sec/chip "
                   f"({n_target}x{n_obs} panel, chunk={chunk})",
@@ -228,9 +231,7 @@ def main():
         "vs_baseline": round(rate_1m / cpu_rate, 2),
         "converged_pct": round(100.0 * converged_target / n_target, 2),
         "scaling_curve": curve,
-        "peak_device_memory_mb": (
-            round(_peak_memory_bytes() / 2**20, 1)
-            if _peak_memory_bytes() is not None else None),
+        "peak_device_memory_mb": peak_mb,
         "refit_demo": refit_demo,
         "baseline_emulation": {
             "kind": "per-series scipy Powell on the same CSS objective",
