@@ -84,7 +84,9 @@ def fit(ts: jnp.ndarray, init: float = 0.94, tol: float = 1e-9,
     [1e-4, 1] — the formally correct domain.
 
     ``ts`` may be ``(n,)`` or ``(n_series, n)``; the returned model's
-    ``smoothing`` is correspondingly scalar or ``(n_series,)``.
+    ``smoothing`` is correspondingly scalar or ``(n_series,)``.  ``init``
+    may be a per-lane ``(n_series,)`` array (e.g. a ``refit_unconverged``
+    warm start from a previous fit's ``smoothing``).
     """
     ts = jnp.asarray(ts)
 
@@ -95,7 +97,8 @@ def fit(ts: jnp.ndarray, init: float = 0.94, tol: float = 1e-9,
         smoothed = EWMAModel(params[0]).add_time_dependent_effects(series)
         return series[1:] - smoothed[:-1]
 
-    x0 = jnp.full((*ts.shape[:-1], 1), init, dtype=ts.dtype)
+    x0 = jnp.broadcast_to(jnp.asarray(init, ts.dtype)[..., None],
+                          (*ts.shape[:-1], 1))
     if method == "lm":
         res = minimize_least_squares(residuals, x0, ts, tol=tol,
                                      max_iter=max_iter)

@@ -165,3 +165,19 @@ def test_holt_winters_refit_warm_start():
     assert conv1.sum() > conv0.sum()
     assert np.array_equal(np.asarray(m1.alpha)[conv0],
                           np.asarray(m0.alpha)[conv0])
+
+
+def test_ewma_refit_warm_start_per_lane_init():
+    from spark_timeseries_tpu.models import ewma
+    panel = _arma_panel(n_series=8, seed=9)
+    m0 = ewma.fit(panel, max_iter=1)
+    conv0 = np.asarray(m0.diagnostics.converged)
+    if conv0.all():
+        pytest.skip("budget of 1 unexpectedly converged everything")
+    m1 = refit_unconverged(
+        panel, m0,
+        lambda v, m: ewma.fit(v, init=m.smoothing, max_iter=200),
+        min_bucket=4)
+    assert np.asarray(m1.diagnostics.converged).sum() > conv0.sum()
+    assert np.array_equal(np.asarray(m1.smoothing)[conv0],
+                          np.asarray(m0.smoothing)[conv0])
