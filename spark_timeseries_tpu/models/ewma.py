@@ -78,7 +78,9 @@ def fit(ts: jnp.ndarray, init: float = 0.94, tol: float = 1e-9,
     (ref ``EWMA.scala:45-69``; same 0.94 initial guess).
 
     ``method="lm"`` (default) runs batched Levenberg-Marquardt on the
-    one-step residuals — float32-robust on TPU; ``method="bfgs"``
+    one-step residuals — float32-robust on TPU — with the result projected
+    into the model domain [``SMOOTHING_FLOOR``, 1] (out-of-domain lanes are
+    flagged non-converged); ``method="bfgs"``
     reproduces the reference's unbounded optimization whose result "should
     always be sanity checked", while ``method="box"`` constrains ``a`` to
     [1e-4, 1] — the formally correct domain.
@@ -102,6 +104,16 @@ def fit(ts: jnp.ndarray, init: float = 0.94, tol: float = 1e-9,
     if method == "lm":
         res = minimize_least_squares(residuals, x0, ts, tol=tol,
                                      max_iter=max_iter)
+        # LM is unconstrained but the model domain is (0, 1]: a lane that
+        # converges outside it (possible on near-random-walk data, where
+        # the SSE is flat past a=1) would silently yield an oscillating,
+        # divergent smoother from add_time_dependent_effects.  Project such
+        # lanes back into the box and flag them non-converged so
+        # refit_unconverged can retry them (e.g. with method="box").
+        in_domain = jnp.all((res.x >= SMOOTHING_FLOOR) & (res.x <= 1.0),
+                            axis=-1)
+        res = res._replace(x=jnp.clip(res.x, SMOOTHING_FLOOR, 1.0),
+                           converged=res.converged & in_domain)
     elif method == "box":
         res = minimize_box(objective, x0, 1e-4, 1.0, ts,
                            tol=tol, max_iter=max_iter)

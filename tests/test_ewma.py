@@ -93,3 +93,25 @@ class TestFit:
         model = ewma.fit_panel(p)
         assert model.smoothing.shape == (n_series,)
         assert bool(jnp.all(jnp.isfinite(model.smoothing)))
+
+
+class TestDomainProjection:
+    # the reference's unbounded CGD "should always be sanity checked"
+    # (ref EWMA.scala:45-52); the batched LM default instead projects into
+    # the model domain so no public path yields a divergent smoother
+    def test_lm_fit_projected_into_domain(self):
+        rng = np.random.default_rng(0)
+        vals = jnp.asarray(rng.normal(size=(16, 128)).cumsum(axis=1))
+        model = ewma.fit(vals)
+        assert float(jnp.max(model.smoothing)) <= 1.0
+        assert float(jnp.min(model.smoothing)) >= ewma.SMOOTHING_FLOOR
+        # this panel drives some unconstrained lanes past a=1: they must be
+        # clipped to exactly 1 and flagged non-converged for refit passes
+        projected = np.asarray(model.smoothing) == 1.0
+        assert projected.any()
+        assert not np.asarray(model.diagnostics.converged)[projected].any()
+        # the resulting smoother is finite and non-divergent everywhere
+        smoothed = model.add_time_dependent_effects(vals)
+        assert bool(jnp.all(jnp.isfinite(smoothed)))
+        assert float(jnp.max(jnp.abs(smoothed))) <= \
+            float(jnp.max(jnp.abs(vals))) + 1.0
