@@ -1,0 +1,123 @@
+"""Roofline breakdown for the headline ARIMA CSS-LM fit (verdict r2 #10).
+
+Answers, with measurements rather than guesswork: at the measured headline
+rate, is the fused LM pass scan-latency-bound or MXU/throughput-bound, and
+what is the next lever?
+
+Decomposition measured on one chunk (default 131072 x 128, the bench.py
+chunk shape):
+
+- ``residual_pass``   — one primal one-step-error scan over the chunk
+- ``normal_eqs_pass`` — primal + 5 tangent scans + JJT/Jr contractions
+  (one full LM iteration's recurrence work; ratio to residual_pass shows
+  the tangent-pass share)
+- ``lm_iteration``    — marginal wall time per LM iteration, from fits at
+  max_iter=2 vs max_iter=12 (includes the solve + bookkeeping)
+- ``obs_scaling``     — normal_eqs time at n_obs 64/128/256: linear growth
+  = throughput-bound in the scan body; flat = per-step latency dominates
+- ``batch_scaling``   — normal_eqs time at 16k/64k/131k series: flat time
+  = latency-bound (vector units idle); proportional = saturated
+
+Prints one JSON line per measurement.  Run on the TPU chip; CPU runs are
+for smoke only.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _timed(fn, *args, reps=5):
+    import jax
+    out = fn(*args)
+    jax.block_until_ready(out)
+    np.asarray(jax.tree_util.tree_leaves(out)[0])       # tunnel sync
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        np.asarray(jax.tree_util.tree_leaves(out)[0])
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _synthetic_arima_panel
+    from spark_timeseries_tpu.models import arima
+    from spark_timeseries_tpu.models.arima import _one_step_errors
+
+    platform = jax.devices()[0].platform
+    n = int(os.environ.get("ROOF_N_SERIES", "131072"))
+    n_obs = int(os.environ.get("ROOF_N_OBS", "128"))
+    dtype = jnp.float32
+    panel = _synthetic_arima_panel(n, n_obs)
+
+    def emit(metric, seconds, **kw):
+        line = {"metric": metric, "value": round(seconds * 1e3, 2),
+                "unit": "ms", "platform": platform}
+        line.update(kw)
+        print(json.dumps(line), flush=True)
+
+    p = q = 2
+    k = 1 + p + q
+    x0 = jnp.tile(jnp.asarray([0.1, 0.2, 0.2, 0.1, 0.1], dtype), (n, 1))
+
+    def residual(prm, y):
+        return _one_step_errors(prm, y, p, q, 1)[1]
+
+    def residual_pass(prm, y):
+        return jax.vmap(residual)(prm, y)
+
+    def normal_eqs_pass(prm, y):
+        eye = jnp.eye(k, dtype=dtype)
+
+        def one(prm_i, y_i):
+            r, fwd = jax.linearize(lambda x: residual(x, y_i), prm_i)
+            Jr = jax.vmap(fwd)(eye)
+            return Jr @ Jr.T, Jr @ r, jnp.sum(r * r)
+        return jax.vmap(one)(prm, y)
+
+    diffed = jnp.asarray(np.diff(panel, axis=1), dtype)
+    rp = jax.jit(residual_pass)
+    ne = jax.jit(normal_eqs_pass)
+
+    t_resid = _timed(rp, x0, diffed)
+    emit(f"residual primal pass ({n}x{n_obs})", t_resid)
+    t_ne = _timed(ne, x0, diffed)
+    emit(f"normal-equations pass: primal + {k} tangents ({n}x{n_obs})",
+         t_ne, tangent_share=round(1 - t_resid / t_ne, 3))
+
+    # marginal LM iteration cost from two fixed-budget fits
+    vals = jnp.asarray(panel, dtype)
+    f2 = jax.jit(lambda v: arima.fit(2, 1, 2, v, warn=False,
+                                     max_iter=2).coefficients)
+    f12 = jax.jit(lambda v: arima.fit(2, 1, 2, v, warn=False,
+                                      max_iter=12).coefficients)
+    t2 = _timed(f2, vals, reps=3)
+    t12 = _timed(f12, vals, reps=3)
+    emit(f"marginal LM iteration ({n}x{n_obs})", (t12 - t2) / 10.0,
+         fit_2iter_ms=round(t2 * 1e3, 2), fit_12iter_ms=round(t12 * 1e3, 2))
+
+    # n_obs scaling of the normal-equations pass
+    for m in (64, 128, 256):
+        pm = _synthetic_arima_panel(n, m, seed=1)
+        dm = jnp.asarray(np.diff(pm, axis=1), dtype)
+        t = _timed(jax.jit(normal_eqs_pass), x0, dm, reps=3)
+        emit(f"normal-equations pass, n_obs={m} ({n} series)", t)
+
+    # batch scaling of the normal-equations pass
+    for b in dict.fromkeys(min(b, n) for b in (16384, 65536, n)):
+        t = _timed(ne, x0[:b], diffed[:b], reps=3)
+        emit(f"normal-equations pass, batch={b} (n_obs={n_obs})", t,
+             series_per_sec=round(b / t, 1))
+
+
+if __name__ == "__main__":
+    main()

@@ -84,22 +84,41 @@ def _split_key(line: str) -> tuple:
 
 def save_csv(panel: Panel, path: str) -> None:
     """Write ``path/data.csv`` (one ``key,v0,v1,...`` row per series) and the
-    ``path/timeIndex`` sidecar."""
+    ``path/timeIndex`` sidecar.
+
+    The numeric block is formatted row-wise by ``np.savetxt`` (``%.17g``
+    round-trips float64 exactly, including nan/inf) and the pre-escaped key
+    column is prepended per line — the per-element ``repr`` loop this
+    replaces dominated panel-scale save time."""
+    import io as _io
+
     os.makedirs(path, exist_ok=True)
     values = np.asarray(panel.values)
+    buf = _io.StringIO()
+    np.savetxt(buf, np.atleast_2d(values), delimiter=",", fmt="%.17g")
     with open(os.path.join(path, CSV_DATA_FILE), "w") as f:
-        for key, row in zip(panel.keys, values):
-            f.write(_escape_key(str(key)) + ","
-                    + ",".join(repr(float(v)) for v in row) + "\n")
+        f.writelines(
+            _escape_key(str(key)) + "," + row + "\n"
+            for key, row in zip(panel.keys, buf.getvalue().splitlines()))
     with open(os.path.join(path, CSV_INDEX_FILE), "w") as f:
         f.write(panel.index.to_string())
 
 
 def load_csv(path: str) -> Panel:
-    """Inverse of :func:`save_csv` (ref ``timeSeriesRDDFromCsv``)."""
+    """Inverse of :func:`save_csv` (ref ``timeSeriesRDDFromCsv``).
+
+    Keys are split off per line (they may be RFC-4180 quoted); the numeric
+    payload — the O(n_series × n_obs) bulk — is parsed in one vectorized
+    pandas C-engine pass instead of a per-token Python loop, so a
+    panel-scale (100k-series) round trip takes seconds, not minutes.
+    """
+    import io as _io
+
+    import pandas as pd
+
     with open(os.path.join(path, CSV_INDEX_FILE)) as f:
         index = dtindex.from_string(f.read().strip())
-    keys, rows = [], []
+    keys, rests = [], []
     with open(os.path.join(path, CSV_DATA_FILE)) as f:
         for line in f:
             line = line.rstrip("\n")
@@ -107,8 +126,13 @@ def load_csv(path: str) -> Panel:
                 continue
             key, rest = _split_key(line)
             keys.append(key)
-            rows.append([float(t) for t in rest.split(",")])
-    return Panel(index, jnp.asarray(np.asarray(rows, dtype=np.float64)), keys)
+            rests.append(rest)
+    if not keys:
+        return Panel(index, jnp.zeros((0, len(index))), keys)
+    data = pd.read_csv(_io.StringIO("\n".join(rests)), header=None,
+                       dtype=np.float64,
+                       float_precision="round_trip").to_numpy()
+    return Panel(index, jnp.asarray(data), keys)
 
 
 # ---------------------------------------------------------------------------
@@ -171,3 +195,31 @@ def yahoo_file_to_panel(path: str, key_prefix: Optional[str] = None,
         key_prefix = os.path.basename(path)
     with open(path) as f:
         return yahoo_string_to_panel(f.read(), key_prefix, zone)
+
+
+def yahoo_files_to_panel(path: str, zone: Optional[str] = None) -> Panel:
+    """Load a directory of Yahoo CSV files into one panel — the counterpart
+    of the reference's whole-directory ``yahooFiles``
+    (ref ``YahooParser.scala:40-48``, which keys each file's series by its
+    file name via ``wholeTextFiles``).
+
+    The reference yields an RDD of per-file series each on its own index;
+    one panel needs a shared time axis, so the per-file (irregular) indices
+    are unioned and every file's series are rebased onto the union with NaN
+    at instants the file doesn't cover.
+    """
+    from .time.union import union as index_union
+
+    names = sorted(n for n in os.listdir(path)
+                   if n.lower().endswith(".csv"))
+    if not names:
+        raise ValueError(f"no .csv files under {path!r}")
+    panels = [yahoo_file_to_panel(os.path.join(path, n), zone=zone)
+              for n in names]
+    if len(panels) == 1:
+        return panels[0]
+    target = index_union([p.index for p in panels], zone)
+    rebased = [p.with_index(target) for p in panels]
+    return Panel(target,
+                 jnp.concatenate([p.values for p in rebased]),
+                 [k for p in rebased for k in p.keys])

@@ -702,8 +702,8 @@ def fit_long(p: int, d: int, q: int, ts: jnp.ndarray,
 
     ``ts (n,)`` or ``(batch, n)``; returns a standard :class:`ARIMAModel`
     (scalar or per-batch coefficients) whose diagnostics aggregate the
-    per-segment fits (``converged`` = at least one weightable segment whose
-    own fit converged, ``n_iter`` = max over segments, ``fun`` = the masked
+    per-segment fits (``converged`` = a majority of the weightable segments'
+    own fits converged, ``n_iter`` = max over segments, ``fun`` = the masked
     sum of weightable segments' objectives).  ``kwargs`` pass through to
     :func:`fit` (``method``, ``max_iter``, ``include_intercept``, ...);
     ``warn`` keeps :func:`fit`'s default (warnings evaluated once, on the
@@ -771,9 +771,13 @@ def fit_long(p: int, d: int, q: int, ts: jnp.ndarray,
 
     fun = jnp.sum(jnp.where(ok, m.diagnostics.fun.reshape(batch, n_segments),
                             0.0), axis=-1)
+    # converged = a MAJORITY of weightable segments converged (any-segment
+    # gating let a 1-of-16 series read as converged, so a downstream
+    # refit_unconverged pass would skip it entirely)
+    seg_conv = ok & m.diagnostics.converged.reshape(batch, n_segments)
+    n_ok = jnp.sum(ok, axis=-1)
     diags = FitDiagnostics(
-        jnp.any(ok & m.diagnostics.converged.reshape(batch, n_segments),
-                axis=-1),
+        (n_ok > 0) & (2 * jnp.sum(seg_conv, axis=-1) > n_ok),
         jnp.max(m.diagnostics.n_iter.reshape(batch, n_segments), axis=-1),
         fun)
     if single:

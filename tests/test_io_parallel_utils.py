@@ -202,3 +202,46 @@ def test_plots(tmp_path):
     for i, f in enumerate((fig, fig2, fig3)):
         f.savefig(str(tmp_path / f"fig{i}.png"))
     assert abs(plot.calc_conf_val(0.95, 100) - 1.96 / 10) < 1e-3
+
+
+def test_yahoo_files_directory(tmp_path):
+    # two tickers with partially overlapping dates: the loader must union
+    # the calendars and NaN-fill where a file has no observation
+    # (ref YahooParser.scala:40-48 whole-directory load)
+    (tmp_path / "A.csv").write_text(
+        "Date,Open,Close\n"
+        "2014-10-23,10.0,11.0\n"
+        "2014-10-22,8.0,9.0\n")
+    (tmp_path / "B.csv").write_text(
+        "Date,Open,Close\n"
+        "2014-10-24,20.0,21.0\n"
+        "2014-10-23,18.0,19.0\n")
+    p = stio.yahoo_files_to_panel(str(tmp_path))
+    assert sorted(p.keys) == ["A.csvClose", "A.csvOpen",
+                              "B.csvClose", "B.csvOpen"]
+    assert p.n_obs == 3          # union of 22nd, 23rd, 24th
+    a_open = np.asarray(p.values)[p.keys.index("A.csvOpen")]
+    np.testing.assert_allclose(a_open[:2], [8.0, 10.0])
+    assert np.isnan(a_open[2])
+    b_open = np.asarray(p.values)[p.keys.index("B.csvOpen")]
+    assert np.isnan(b_open[0])
+    np.testing.assert_allclose(b_open[1:], [18.0, 20.0])
+
+
+def test_load_csv_handles_nan_and_scale(tmp_path):
+    # vectorized parse path: NaN round-trips, and a wide panel loads fast
+    from spark_timeseries_tpu.panel import Panel
+    from spark_timeseries_tpu.time import uniform
+    from spark_timeseries_tpu.time.frequency import DayFrequency
+
+    n_series, n_obs = 512, 64
+    rng = np.random.default_rng(0)
+    vals = rng.normal(size=(n_series, n_obs))
+    vals[3, 7] = np.nan
+    idx = uniform("2020-01-01T00:00Z", n_obs, DayFrequency(1))
+    panel = Panel(idx, jnp.asarray(vals),
+                  [f"k{i}" for i in range(n_series)])
+    stio.save_csv(panel, str(tmp_path / "p"))
+    back = stio.load_csv(str(tmp_path / "p"))
+    assert back.keys == panel.keys
+    np.testing.assert_allclose(np.asarray(back.values), vals)
