@@ -119,12 +119,26 @@ def load_csv(path: str) -> Panel:
     with open(os.path.join(path, CSV_INDEX_FILE)) as f:
         index = dtindex.from_string(f.read().strip())
     keys, rests = [], []
+    width = None
     with open(os.path.join(path, CSV_DATA_FILE)) as f:
         for line in f:
             line = line.rstrip("\n")
             if not line:
                 continue
             key, rest = _split_key(line)
+            # corruption must fail loudly, not NaN-fill: pandas would
+            # silently pad a truncated row and read an empty field as NaN
+            # (real NaNs are written as the literal token "nan")
+            w = rest.count(",") + 1
+            if width is None:
+                width = w
+            elif w != width:
+                raise ValueError(
+                    f"corrupt data.csv: series {key!r} has {w} values, "
+                    f"first series has {width}")
+            if rest.startswith(",") or rest.endswith(",") or ",," in rest:
+                raise ValueError(
+                    f"corrupt data.csv: series {key!r} has an empty field")
             keys.append(key)
             rests.append(rest)
     if not keys:

@@ -245,3 +245,20 @@ def test_load_csv_handles_nan_and_scale(tmp_path):
     back = stio.load_csv(str(tmp_path / "p"))
     assert back.keys == panel.keys
     np.testing.assert_allclose(np.asarray(back.values), vals)
+
+
+def test_load_csv_rejects_corruption(tmp_path):
+    # a truncated row or an empty field must fail loudly, not NaN-fill
+    from spark_timeseries_tpu.time import uniform
+    from spark_timeseries_tpu.time.frequency import DayFrequency
+
+    d = tmp_path / "p"
+    d.mkdir()
+    (d / "timeIndex").write_text(
+        uniform("2020-01-01T00:00Z", 3, DayFrequency(1)).to_string())
+    (d / "data.csv").write_text("a,1.0,2.0,3.0\nb,4.0,5.0\n")
+    with pytest.raises(ValueError, match="has 2 values"):
+        stio.load_csv(str(d))
+    (d / "data.csv").write_text("a,1.0,2.0,3.0\nb,4.0,,6.0\n")
+    with pytest.raises(ValueError, match="empty field"):
+        stio.load_csv(str(d))
