@@ -16,7 +16,6 @@ resist static shapes); it mirrors the reference's use of a host interpolator
 from __future__ import annotations
 
 import math
-from functools import partial
 
 import jax
 import jax.numpy as jnp
