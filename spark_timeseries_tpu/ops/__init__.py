@@ -2,6 +2,7 @@
 OLS, batched optimizers, and sequence-parallel recurrences."""
 
 from . import optimize, scan_parallel
+from .decompose import Decomposition, decompose
 from .lag import lag_matrix, lag_matrix_multi
 from .linalg import OLSResult, ols, ols_beta, r_squared, t_statistics
 from .resample import bucket_assignments, resample
@@ -36,6 +37,7 @@ from .univariate import (
 
 __all__ = [
     "optimize", "scan_parallel",
+    "Decomposition", "decompose",
     "linear_recurrence", "ewma_smooth", "ar1_filter", "garch_variance",
     "lag_matrix", "lag_matrix_multi",
     "OLSResult", "ols", "ols_beta", "r_squared", "t_statistics",

@@ -1,0 +1,96 @@
+"""Classical seasonal decomposition, batched — beyond-reference capability.
+
+The reference has no decomposition op (its seasonal tier is only the
+Holt-Winters smoother, ``HoltWinters.scala``); R users routinely pair
+``decompose()`` with the models this framework ports, so the panel-scale
+equivalent lives here.  Semantics follow R ``stats::decompose``: a centered
+moving-average trend (half-weight endpoints for even periods), seasonal
+figures as phase means of the detrended series re-centered to sum to zero
+(additive) or rescaled to mean one (multiplicative), and NaN trend/remainder
+edges where the centered window does not fit.
+
+TPU-native design: the centered filter reuses :func:`roll_mean`'s shifted-
+add accumulation (the even-period half-weight-ends filter is exactly
+``roll_mean(roll_mean(x, period), 2)``), phase means are a one-hot
+contraction over the phase index, everything is batched over leading dims
+and jit-safe (static shapes only).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from .univariate import roll_mean
+
+
+class Decomposition(NamedTuple):
+    """``trend``/``seasonal``/``remainder`` each shaped like the input;
+    ``figure (..., period)`` is the per-phase seasonal figure."""
+    trend: jnp.ndarray
+    seasonal: jnp.ndarray
+    remainder: jnp.ndarray
+    figure: jnp.ndarray
+
+
+def _centered_ma(x: jnp.ndarray, period: int) -> jnp.ndarray:
+    """Centered moving average with NaN edges, matching R ``filter(...,
+    sides=2)``: odd periods use ``period`` equal taps, even periods the
+    ``period + 1``-tap half-weight-ends filter, which factors exactly as a
+    period-mean followed by a 2-mean (one shifted-add accumulator each,
+    no window stack)."""
+    if period % 2:
+        core = roll_mean(x, period)
+    else:
+        core = roll_mean(roll_mean(x, period), 2)
+    pad = jnp.full((*x.shape[:-1], period // 2), jnp.nan, x.dtype)
+    return jnp.concatenate([pad, core, pad], axis=-1)
+
+
+def decompose(values: jnp.ndarray, period: int,
+              model: str = "additive") -> Decomposition:
+    """Decompose ``values (..., n)`` into trend + seasonal + remainder
+    (additive) or trend * seasonal * remainder (multiplicative), batched
+    over every leading dim.
+
+    Requires ``n >= 2 * period`` (same constraint as R's ``decompose``).
+    """
+    if model not in ("additive", "multiplicative"):
+        raise ValueError("model must be 'additive' or 'multiplicative'")
+    values = jnp.asarray(values)
+    # integer input would truncate the filter taps and cast the NaN edge
+    # pad into garbage; promote like the rest of the ops tier
+    values = values.astype(jnp.result_type(values.dtype, jnp.float32))
+    n = values.shape[-1]
+    if n < 2 * period:
+        raise ValueError(
+            f"series of length {n} has fewer than two periods ({period})")
+
+    trend = _centered_ma(values, period)
+    detrended = values - trend if model == "additive" else values / trend
+
+    # per-phase means over the valid (non-NaN-trend) window
+    phase = jnp.arange(n) % period                       # (n,)
+    valid = jnp.isfinite(detrended)
+    contrib = jnp.where(valid, detrended, 0.0)
+    one_hot = (phase[:, None] == jnp.arange(period)[None, :]) \
+        .astype(values.dtype)                            # (n, period)
+    sums = contrib @ one_hot                             # (..., period)
+    counts = valid.astype(values.dtype) @ one_hot
+    # a phase with no valid observations is NaN (as R's na.rm mean of an
+    # empty set), and the re-centering ignores it rather than absorbing a
+    # fabricated zero
+    figure = jnp.where(counts > 0, sums / jnp.maximum(counts, 1.0),
+                       jnp.nan)
+    if model == "additive":
+        figure = figure - jnp.nanmean(figure, axis=-1, keepdims=True)
+    else:
+        figure = figure / jnp.nanmean(figure, axis=-1, keepdims=True)
+
+    seasonal = jnp.take(figure, phase, axis=-1)
+    if model == "additive":
+        remainder = values - trend - seasonal
+    else:
+        remainder = values / (trend * seasonal)
+    return Decomposition(trend, seasonal, remainder, figure)
