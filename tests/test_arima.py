@@ -253,17 +253,26 @@ def test_auto_fit_panel():
     key = jax.random.PRNGKey(10)
     m_ar = arima.ARIMAModel(2, 0, 0, jnp.array([2.5, 0.4, 0.3]))
     m_i1 = arima.ARIMAModel(1, 1, 0, jnp.array([0.1, 0.5]))
+    i2 = jnp.cumsum(m_i1.sample(250, jax.random.fold_in(key, 3)))
     panel = jnp.stack([
         m_ar.sample(250, jax.random.fold_in(key, 0)),
         m_ar.sample(250, jax.random.fold_in(key, 1)),
         m_i1.sample(250, jax.random.fold_in(key, 2)),
+        i2,                  # doubly integrated: d=2, no-intercept tier
     ])
     res = arima.auto_fit_panel(panel, max_p=3, max_d=2, max_q=2)
-    assert res.orders.shape == (3, 3)
+    assert res.orders.shape == (4, 3)
     assert np.all(np.isfinite(res.aic))
     # the integrated series should need differencing; the AR(2) ones none
     assert res.orders[2, 1] >= 1
     assert res.orders[0, 1] == 0
+    assert res.orders[3, 1] == 2
+    # d=2 lanes get no intercept (masked in-kernel per series): slot 0 of
+    # the padded coefficients must be exactly zero and the materialized
+    # model must carry has_intercept=False
+    assert res.coefficients[3, 0] == 0.0
+    m3 = res.model_for(3)
+    assert not m3.has_intercept
     # each winner must beat the intercept-only candidate it was compared to
     m0 = res.model_for(0)
     assert m0.p + m0.q > 0
