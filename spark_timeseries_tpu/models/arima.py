@@ -277,6 +277,65 @@ def _forecast_one(params: jnp.ndarray, ts: jnp.ndarray, n_future: int,
     return results
 
 
+def _psi_half_widths(params: jnp.ndarray, ts: jnp.ndarray, h: int,
+                     p: int, d: int, q: int, icpt: int,
+                     conf: float) -> jnp.ndarray:
+    """Half-widths of symmetric ``conf`` forecast bands for horizons 1..h —
+    beyond-reference capability (the reference's forecast returns point
+    values only, ``ARIMA.scala:696-764``).
+
+    Standard psi-weight construction: the ARIMA(p,d,q) process has MA(∞)
+    weights ``ψ_j`` from the *nonstationary* AR polynomial
+    ``φ*(B) = φ(B)(1-B)^d``; the h-step forecast error variance is
+    ``σ² Σ_{j<h} ψ_j²`` with σ² estimated from the one-step CSS residuals
+    (so a d>0 model's bands correctly widen without bound).  All static
+    shapes; the ψ recursion is a ``lax.scan`` with a (p+d) ring carry.
+    """
+    import math
+
+    from jax.scipy.special import erfinv
+
+    c, phi, theta = _split_params(params, p, q, icpt)
+    # σ² from the CSS residual convention (drop the t < max(p, q) burn-in,
+    # no artificial c-padding — same sample _log_likelihood_css_arma uses).
+    # This is a second O(n) scan on top of forecast()'s own; acceptable
+    # because forecasting is off the hot fit path.
+    diffed = differences_of_order_d(ts, d)[d:]
+    _, err = _one_step_errors(params, diffed, p, q, icpt)
+    sigma2 = jnp.mean(err * err)
+
+    # φ*(B) = φ(B)(1-B)^d as 1 - Σ a_j B^j, j = 1..p+d
+    binom = jnp.asarray([math.comb(d, k) * (-1.0) ** k
+                         for k in range(d + 1)], ts.dtype)
+    ar_star = jnp.convolve(
+        jnp.concatenate([jnp.ones((1,), ts.dtype), -phi]), binom)
+    a = -ar_star[1:]                                   # (p+d,)
+
+    th = jnp.zeros((h,), ts.dtype)
+    k = min(q, h - 1)
+    if k:
+        th = th.at[1:1 + k].set(theta[:k])
+
+    m = p + d
+    if m:
+        buf0 = jnp.zeros((m,), ts.dtype).at[0].set(1.0)
+
+        def step(buf, th_j):
+            # ψ_j = θ_j + Σ_i a_i ψ_{j-i}; buf is newest-first ψ_{j-1..j-m}
+            psi_j = th_j + a @ buf
+            return jnp.concatenate([psi_j[None], buf[:-1]]), psi_j
+
+        _, rest = lax.scan(step, buf0, th[1:], unroll=scan_unroll())
+    else:
+        rest = th[1:]
+    psis = jnp.concatenate([jnp.ones((1,), ts.dtype), rest])
+
+    var_h = sigma2 * jnp.cumsum(psis * psis)
+    z = jnp.sqrt(jnp.asarray(2.0, ts.dtype)) \
+        * erfinv(jnp.asarray(conf, ts.dtype))
+    return z * jnp.sqrt(var_h)
+
+
 def _batched(fn_one, params: jnp.ndarray, ts: jnp.ndarray, *args):
     """vmap ``fn_one(params_1d, ts_1d, *args)`` over an optional shared
     leading batch dim of ``params`` / ``ts``."""
@@ -486,6 +545,36 @@ class ARIMAModel(NamedTuple):
             lambda prm, y: _forecast_one(
                 prm, y, n_future, self.p, self.d, self.q, self._icpt),
             jnp.asarray(self.coefficients), ts)
+
+    def forecast_interval(self, ts: jnp.ndarray, n_future: int,
+                          conf: float = 0.95):
+        """Point forecast plus symmetric ``conf`` prediction bands.
+
+        Returns ``(forecast, lower, upper)``: ``forecast`` is exactly
+        :meth:`forecast`'s output (historicals + future); ``lower``/
+        ``upper`` cover only the ``n_future`` future steps, widening with
+        horizon via the psi-weight error variance (beyond reference —
+        ``ARIMA.scala``'s forecast has no uncertainty output).
+
+        Bands are bounded only where the fitted AR part is stationary: a
+        lane with explosive AR coefficients (typically one whose fit
+        reports ``converged=False`` — check ``diagnostics`` /
+        ``is_stationary()`` and re-fit via ``models.refit_unconverged``)
+        has genuinely unbounded forecast variance, so its bands grow at
+        the explosive rate and overflow to ``inf``/NaN at longer horizons
+        rather than flattening to a fabricated width.
+        """
+        if n_future < 1:
+            raise ValueError("forecast_interval needs n_future >= 1")
+        ts = jnp.asarray(ts)
+        point = self.forecast(ts, n_future)
+        half = _batched(
+            lambda prm, y: _psi_half_widths(
+                prm, y, n_future, self.p, self.d, self.q, self._icpt,
+                conf),
+            jnp.asarray(self.coefficients), ts)
+        future = point[..., ts.shape[-1]:]
+        return point, future - half, future + half
 
     # -- diagnostics --------------------------------------------------------
 

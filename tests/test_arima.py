@@ -291,3 +291,74 @@ def test_short_series_errors_are_clear():
     m = arima.ARIMAModel(2, 1, 2, jnp.ones(6))
     with pytest.raises(ValueError, match="trailing"):
         m.forecast(jnp.ones(3), 4)
+
+
+def test_forecast_interval_closed_forms():
+    """Psi-weight bands against textbook closed forms: random walk grows
+    as sqrt(h), AR(1) as sqrt(sum phi^2j), MA(1) is flat beyond h=2."""
+    rng = np.random.default_rng(5)
+    y = jnp.asarray(rng.normal(size=400))
+
+    # ARIMA(0,1,0), no intercept: var_h = h * sigma2
+    rw = arima.ARIMAModel(0, 1, 0, jnp.zeros(0), has_intercept=False)
+    _, lo, hi = rw.forecast_interval(jnp.cumsum(y), 9)
+    half = np.asarray(hi - lo) / 2
+    np.testing.assert_allclose(half / half[0],
+                               np.sqrt(np.arange(1, 10)), rtol=1e-6)
+
+    # AR(1): psi_j = phi^j
+    phi = 0.6
+    ar = arima.ARIMAModel(1, 0, 0, jnp.array([0.0, phi]))
+    _, lo, hi = ar.forecast_interval(y, 6)
+    half = np.asarray(hi - lo) / 2
+    expect = np.sqrt(np.cumsum(phi ** (2 * np.arange(6))))
+    np.testing.assert_allclose(half / half[0], expect, rtol=1e-6)
+
+    # MA(1): var_1 = sigma2, var_h = sigma2 (1 + theta^2) for h >= 2
+    th = 0.5
+    ma = arima.ARIMAModel(0, 0, 1, jnp.array([0.0, th]))
+    _, lo, hi = ma.forecast_interval(y, 5)
+    half = np.asarray(hi - lo) / 2
+    np.testing.assert_allclose(half[1:] / half[0],
+                               np.full(4, np.sqrt(1 + th * th)), rtol=1e-6)
+
+    # conf=0.95 z-multiplier sanity: half_1 = 1.9600 * sigma, where the
+    # c=0 model's sigma is the root mean SQUARE (residuals y - 0)
+    model = arima.ARIMAModel(0, 0, 0, jnp.array([0.0]))
+    _, lo, hi = model.forecast_interval(y, 1)
+    sigma = float(jnp.sqrt(jnp.mean(y * y)))
+    np.testing.assert_allclose(float(hi[0] - lo[0]) / 2, 1.95996 * sigma,
+                               rtol=1e-4)
+
+
+def test_forecast_interval_batched():
+    key = jax.random.PRNGKey(3)
+    model = arima.ARIMAModel(1, 0, 1, jnp.array([2.0, 0.5, 0.3]))
+    panel = model.sample(300, key, shape=(4,))
+    fitted = arima.fit(1, 0, 1, panel, warn=False)
+    fc, lo, hi = fitted.forecast_interval(panel, 7)
+    assert fc.shape == (4, 307) and lo.shape == (4, 7) and hi.shape == (4, 7)
+    assert bool(jnp.all(hi > lo))
+    # bands widen monotonically for a stationary AR/MA mix
+    w = np.asarray(hi - lo)
+    assert np.all(np.diff(w, axis=1) >= -1e-6)
+    # point forecast sits inside its own band
+    future = np.asarray(fc)[:, 300:]
+    assert np.all(future > np.asarray(lo)) and np.all(future < np.asarray(hi))
+
+
+def test_forecast_interval_nonstationary_lane_grows_unbounded():
+    # an explosive AR lane has unbounded forecast variance: its bands must
+    # grow at the explosive rate (overflowing to inf at longer horizons),
+    # never flatten to a fabricated width; the stationary lane beside it
+    # keeps bounded, decelerating growth (per-lane isolation under vmap)
+    m = arima.ARIMAModel(1, 0, 0, jnp.array([[0.0, 0.5], [0.0, 1.6]]))
+    y = jnp.asarray(np.random.default_rng(0).normal(size=(2, 120)))
+    _, lo, hi = m.forecast_interval(y, 8)
+    w = np.asarray(hi - lo)
+    assert np.isfinite(w[0]).all()
+    assert w[0, -1] / w[0, 0] < 1.0 / np.sqrt(1 - 0.5 ** 2) + 1e-6
+    assert w[1, -1] / w[1, 0] > 1.6 ** 6          # explosive growth rate
+    # and far enough out the explosive lane's f64 variance overflows to inf
+    _, lo2, hi2 = m.forecast_interval(y, 800)
+    assert not np.isfinite(np.asarray(hi2 - lo2)[1]).all()
