@@ -296,13 +296,15 @@ def _psi_half_widths(params: jnp.ndarray, ts: jnp.ndarray, h: int,
     from jax.scipy.special import erfinv
 
     c, phi, theta = _split_params(params, p, q, icpt)
-    # σ² from the CSS residual convention (drop the t < max(p, q) burn-in,
-    # no artificial c-padding — same sample _log_likelihood_css_arma uses).
-    # This is a second O(n) scan on top of forecast()'s own; acceptable
-    # because forecasting is off the hot fit path.
+    # σ² from the CSS residual convention: the t < max(p, q) burn-in is
+    # dropped from the sum but the divisor is the FULL differenced length,
+    # exactly σ² = css/n as _log_likelihood_css_arma (and the reference,
+    # ARIMA.scala:430-445) computes it.  This is a second O(n) scan on top
+    # of forecast()'s own; acceptable because forecasting is off the hot
+    # fit path.
     diffed = differences_of_order_d(ts, d)[d:]
     _, err = _one_step_errors(params, diffed, p, q, icpt)
-    sigma2 = jnp.mean(err * err)
+    sigma2 = jnp.sum(err * err) / diffed.shape[-1]
 
     # φ*(B) = φ(B)(1-B)^d as 1 - Σ a_j B^j, j = 1..p+d
     binom = jnp.asarray([math.comb(d, k) * (-1.0) ** k

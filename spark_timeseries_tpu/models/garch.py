@@ -90,6 +90,44 @@ class GARCHModel(NamedTuple):
         lls = -0.5 * jnp.log(hh) - 0.5 * x * x / hh
         return jnp.sum(lls, axis=-1) - 0.5 * jnp.log(2.0 * jnp.pi) * (n - 1)
 
+    def forecast_variance(self, ts: jnp.ndarray,
+                          n_future: int) -> jnp.ndarray:
+        """k-step-ahead conditional variance forecasts for k = 1..n_future
+        — beyond reference (``GARCH.scala`` has no forecast surface).
+
+        Textbook GARCH(1,1) term structure: with persistence ``κ = α+β``
+        and unconditional variance ``σ² = ω/(1-κ)``,
+        ``E[h_{t+k} | t] = σ² + κ^{k-1}(h_{t+1} - σ²)`` where ``h_{t+1} =
+        ω + α x_t² + β h_t`` comes from the filtered variance path (the
+        same associative scan as the likelihood).  Forecasts revert
+        geometrically to σ²; an IGARCH lane (κ = 1, RiskMetrics-style)
+        takes its limit form ``h_{t+1} + k·ω`` (linear growth), and an
+        explosive lane (κ > 1) diverges at its own rate rather than being
+        clipped.  ``ts (..., n)`` → ``(..., n_future)``.
+        """
+        if n_future < 1:
+            raise ValueError("forecast_variance needs n_future >= 1")
+        ts = jnp.asarray(ts)
+        from ..ops.scan_parallel import garch_variance
+        w, a, b = self._params
+        kappa = a + b
+        # the stationary fixed point does not exist at κ = 1 (IGARCH /
+        # RiskMetrics): seed the filtered path with the sample variance
+        # there, and replace the geometric-reversion form (inf - inf =
+        # NaN) with its κ→1 limit, linear growth h_{t+1} + k·ω
+        unit = jnp.isclose(kappa, 1.0)
+        seed = jnp.where(unit, jnp.mean(ts * ts, axis=-1),
+                         w / jnp.where(unit, jnp.ones_like(kappa),
+                                       1.0 - kappa))
+        h = garch_variance(ts, w, a, b, h0=seed)
+        h_next = w + a * ts[..., -1] ** 2 + b * h[..., -1]
+        k = jnp.arange(n_future)
+        sigma2 = w / jnp.where(unit, jnp.ones_like(kappa), 1.0 - kappa)
+        geo = sigma2[..., None] \
+            + kappa[..., None] ** k * (h_next - sigma2)[..., None]
+        lin = h_next[..., None] + w[..., None] * k
+        return jnp.where(unit[..., None], lin, geo)
+
     def gradient(self, ts: jnp.ndarray) -> jnp.ndarray:
         """d log-likelihood / d(omega, alpha, beta) via autodiff through the
         scan — replaces the reference's hand recursion (``GARCH.scala:96-115``)

@@ -83,11 +83,13 @@ def ar1_filter(x: jnp.ndarray, c, phi, axis: int = -1) -> jnp.ndarray:
 
 
 def garch_variance(errors: jnp.ndarray, omega, alpha, beta,
-                   axis: int = -1) -> jnp.ndarray:
+                   axis: int = -1, h0=None) -> jnp.ndarray:
     """Conditional-variance path ``h_t = omega + alpha*e²_{t-1} + beta*h_{t-1}``
     with ``h_0 = omega / (1 - alpha - beta)`` (the GARCH recurrence,
     ``models.garch.GARCHModel``), by associative scan.  Returns ``h`` aligned
-    with ``errors`` (``h[0]`` is the stationary seed)."""
+    with ``errors`` (``h[0]`` is the seed).  Pass ``h0`` to override the
+    stationary seed — e.g. the sample variance for an IGARCH lane
+    (α+β = 1), where the stationary value does not exist."""
     e = jnp.asarray(errors)
     omega = jnp.asarray(omega)
     alpha = jnp.asarray(alpha)
@@ -105,7 +107,12 @@ def garch_variance(errors: jnp.ndarray, omega, alpha, beta,
         axis=axis)
     a = jnp.broadcast_to(beta, e.shape)
     b = omega + alpha * e2_prev
-    h0 = omega / (1.0 - alpha - beta)
+    if h0 is None:
+        h0 = omega / (1.0 - alpha - beta)
+    else:
+        h0 = jnp.asarray(h0, e.dtype)
+        if h0.ndim and axis in (-1, e.ndim - 1):
+            h0 = h0[..., None]
     idx = [slice(None)] * e.ndim
     idx[axis] = slice(0, 1)
     a = a.at[tuple(idx)].set(0.0)

@@ -303,3 +303,48 @@ def test_egarch_fit_matches_independent_scalar_mle():
     np.testing.assert_allclose(got, oracle.x, atol=0.03)
     ll_ours = float(model.log_likelihood(jnp.asarray(ts)))
     assert abs(-oracle.fun - ll_ours) < 0.5
+
+
+def test_forecast_variance_term_structure():
+    """Closed form vs the iterated recursion, geometric reversion to the
+    unconditional variance, and batched-lane isolation."""
+    m = garch.GARCHModel(jnp.asarray(0.05), jnp.asarray(0.1),
+                         jnp.asarray(0.85))
+    x = m.sample(500, jax.random.PRNGKey(4))
+    fv = np.asarray(m.forecast_variance(x, 20))
+    assert fv.shape == (20,)
+
+    # iterated one-step recursion E[h_{k+1}] = w + (a+b) E[h_k]
+    from spark_timeseries_tpu.ops.scan_parallel import garch_variance
+    h = np.asarray(garch_variance(x, *(np.float64(v) for v in
+                                       (0.05, 0.1, 0.85))))
+    hk = 0.05 + 0.1 * float(x[-1]) ** 2 + 0.85 * h[-1]
+    for k in range(20):
+        np.testing.assert_allclose(fv[k], hk, rtol=1e-10)
+        hk = 0.05 + (0.1 + 0.85) * hk
+    # long-horizon limit is the unconditional variance
+    far = np.asarray(m.forecast_variance(x, 2000))[-1]
+    np.testing.assert_allclose(far, 0.05 / (1 - 0.95), rtol=1e-6)
+
+    # batched: two lanes with different persistence evolve independently
+    mb = garch.GARCHModel(jnp.asarray([0.05, 0.02]),
+                          jnp.asarray([0.1, 0.05]),
+                          jnp.asarray([0.85, 0.9]))
+    xb = mb.sample(300, jax.random.PRNGKey(5), shape=(2,))
+    fvb = np.asarray(mb.forecast_variance(xb, 10))
+    assert fvb.shape == (2, 10)
+    np.testing.assert_allclose(
+        fvb[0], np.asarray(garch.GARCHModel(
+            jnp.asarray(0.05), jnp.asarray(0.1), jnp.asarray(0.85)
+        ).forecast_variance(xb[0], 10)), rtol=1e-10)
+
+
+def test_forecast_variance_igarch_linear_limit():
+    # kappa = 1 exactly (RiskMetrics): E[h_{t+k}] = h_{t+1} + (k-1) omega,
+    # not the NaN the fixed-point form would produce
+    m = garch.GARCHModel(jnp.asarray(0.1), jnp.asarray(0.05),
+                         jnp.asarray(0.95))
+    x = jnp.asarray(np.random.default_rng(2).normal(size=200))
+    fv = np.asarray(m.forecast_variance(x, 10))
+    assert np.isfinite(fv).all()
+    np.testing.assert_allclose(np.diff(fv), 0.1, rtol=1e-10)
