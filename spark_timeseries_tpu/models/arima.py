@@ -42,7 +42,8 @@ from ..ops.univariate import (differences_of_order_d,
                               inverse_differences_of_order_d)
 from ..stats import KPSS_CONSTANT_CRITICAL_VALUES, kpsstest
 from . import autoregression
-from .base import FitDiagnostics, diagnostics_from, scan_unroll
+from .base import (FitDiagnostics, diagnostics_from, normal_quantile,
+                   scan_unroll)
 
 
 # ---------------------------------------------------------------------------
@@ -293,8 +294,6 @@ def _psi_half_widths(params: jnp.ndarray, ts: jnp.ndarray, h: int,
     """
     import math
 
-    from jax.scipy.special import erfinv
-
     c, phi, theta = _split_params(params, p, q, icpt)
     # σ² from the CSS residual convention: the t < max(p, q) burn-in is
     # dropped from the sum but the divisor is the FULL differenced length,
@@ -333,9 +332,7 @@ def _psi_half_widths(params: jnp.ndarray, ts: jnp.ndarray, h: int,
     psis = jnp.concatenate([jnp.ones((1,), ts.dtype), rest])
 
     var_h = sigma2 * jnp.cumsum(psis * psis)
-    z = jnp.sqrt(jnp.asarray(2.0, ts.dtype)) \
-        * erfinv(jnp.asarray(conf, ts.dtype))
-    return z * jnp.sqrt(var_h)
+    return normal_quantile(conf, ts.dtype) * jnp.sqrt(var_h)
 
 
 def _batched(fn_one, params: jnp.ndarray, ts: jnp.ndarray, *args):

@@ -21,6 +21,14 @@ from typing import Any, NamedTuple, Optional
 import jax.numpy as jnp
 
 
+def normal_quantile(conf, dtype) -> jnp.ndarray:
+    """Two-sided standard-normal quantile: ``z`` with ``P(|Z| < z) = conf``
+    (1.95996 at 0.95) — shared by every model's ``forecast_interval``."""
+    from jax.scipy.special import erfinv
+    return jnp.sqrt(jnp.asarray(2.0, dtype)) \
+        * erfinv(jnp.asarray(conf, dtype))
+
+
 def scan_unroll() -> int:
     """Unroll factor for the model tier's time-axis ``lax.scan``s.
 

@@ -24,7 +24,8 @@ from jax import lax
 
 from ..ops.lag import lag_matrix
 from ..ops.optimize import minimize_box
-from .base import FitDiagnostics, diagnostics_from, scan_unroll
+from .base import (FitDiagnostics, diagnostics_from, normal_quantile,
+                   scan_unroll)
 
 
 def _kernel(period: int) -> np.ndarray:
@@ -169,6 +170,54 @@ class HoltWintersModel(NamedTuple):
         season = seasons[..., season_idx]
         base = level[..., None] + h * trend[..., None]
         return base + season if self.additive else base * season
+
+    def forecast_interval(self, ts: jnp.ndarray, n_future: int,
+                          conf: float = 0.95):
+        """Additive-model prediction bands — beyond reference
+        (``HoltWinters.scala:147-168`` forecasts points only).
+
+        Class-1 state-space variance (Hyndman, Koehler, Ord & Snyder
+        2008, ch. 6): ``var_h = σ²(1 + Σ_{j<h} c_j²)`` with
+        ``c_j = α(1 + jβ) + γ·1{j ≡ 0 mod period}`` and σ² from the
+        one-step fitted residuals.  Returns ``(point, lower, upper)``,
+        each ``(..., n_future)``.  The multiplicative model has no
+        closed-form bands (simulate from the fitted components instead);
+        it raises ``NotImplementedError``.
+        """
+        if not self.additive:
+            raise NotImplementedError(
+                "closed-form prediction bands exist only for the additive "
+                "model; simulate for multiplicative")
+        if n_future < 1:
+            raise ValueError("forecast_interval needs n_future >= 1")
+        ts = jnp.asarray(ts)
+        # one scan serves both the residual variance (fitted values) and
+        # the point forecast (final carry) — forecast() would re-run it
+        fitted, (level, trend, seasons) = self._run(ts)
+        h = jnp.arange(1, n_future + 1, dtype=ts.dtype)
+        season_idx = jnp.arange(n_future) % self.period
+        point = level[..., None] + h * trend[..., None] \
+            + seasons[..., season_idx]
+        err = ts[..., self.period:] - fitted[..., self.period:]
+        sigma2 = jnp.mean(err * err, axis=-1)
+
+        a = jnp.asarray(self.alpha, ts.dtype)
+        b = jnp.asarray(self.beta, ts.dtype)
+        g = jnp.asarray(self.gamma, ts.dtype)
+        j = jnp.arange(1, n_future, dtype=ts.dtype)
+        season_hit = (jnp.arange(1, n_future) % self.period == 0) \
+            .astype(ts.dtype)
+        cj = a[..., None] * (1.0 + j * b[..., None]) \
+            + g[..., None] * season_hit
+        # params and series may carry different batch shapes (scalar model
+        # over a panel, or per-lane model on one series): align on the
+        # residual variance's batch shape before the concatenate
+        cj2 = jnp.broadcast_to(cj * cj, (*sigma2.shape, n_future - 1))
+        var_h = sigma2[..., None] * jnp.concatenate(
+            [jnp.ones((*sigma2.shape, 1), ts.dtype),
+             1.0 + jnp.cumsum(cj2, axis=-1)], axis=-1)
+        half = normal_quantile(conf, ts.dtype) * jnp.sqrt(var_h)
+        return point, point - half, point + half
 
 
 def fit(ts: jnp.ndarray, period: int, model_type: str = "additive",
