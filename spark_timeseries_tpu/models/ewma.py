@@ -21,7 +21,8 @@ from jax import lax
 
 from ..ops.optimize import (minimize_bfgs, minimize_box,
                             minimize_least_squares)
-from .base import FitDiagnostics, diagnostics_from, scan_unroll
+from .base import (FitDiagnostics, diagnostics_from, normal_quantile,
+                   scan_unroll)
 
 # floor for the smoothing parameter when *inverting* the recurrence: the
 # box method's lower bound (EWMA.scala's unbounded CGD shares the hazard —
@@ -69,6 +70,38 @@ class EWMAModel(NamedTuple):
         smoothed = self.add_time_dependent_effects(ts)
         err = ts[..., 1:] - smoothed[..., :-1]
         return jnp.sum(err * err, axis=-1)
+
+    def forecast(self, ts: jnp.ndarray, n_future: int) -> jnp.ndarray:
+        """Flat forecast at the final smoothed level — simple exponential
+        smoothing has no trend or season, so every horizon repeats S_n
+        (beyond reference: ``EWMA.scala`` exposes no forecast surface).
+        ``ts (..., n)`` → ``(..., n_future)``."""
+        if n_future < 1:
+            raise ValueError("forecast needs n_future >= 1")
+        ts = jnp.asarray(ts)
+        level = self.add_time_dependent_effects(ts)[..., -1]
+        return jnp.broadcast_to(level[..., None],
+                                (*level.shape, n_future))
+
+    def forecast_interval(self, ts: jnp.ndarray, n_future: int,
+                          conf: float = 0.95):
+        """Prediction bands for the flat forecast: the SES forecast-error
+        variance is ``var_h = σ²(1 + (h-1)α²)`` (the class-1 state-space
+        result with β = γ = 0), σ² from the one-step residuals.  Returns
+        ``(point, lower, upper)``, each ``(..., n_future)``."""
+        if n_future < 1:
+            raise ValueError("forecast_interval needs n_future >= 1")
+        ts = jnp.asarray(ts)
+        a = jnp.asarray(self.smoothing, ts.dtype)
+        smoothed = self.add_time_dependent_effects(ts)
+        point = jnp.broadcast_to(
+            smoothed[..., -1:], (*smoothed.shape[:-1], n_future))
+        err = ts[..., 1:] - smoothed[..., :-1]
+        sigma2 = jnp.mean(err * err, axis=-1)
+        h = jnp.arange(n_future, dtype=ts.dtype)         # h-1 for h = 1..
+        var_h = sigma2[..., None] * (1.0 + h * a[..., None] ** 2)
+        half = normal_quantile(conf, ts.dtype) * jnp.sqrt(var_h)
+        return point, point - half, point + half
 
 
 def fit(ts: jnp.ndarray, init: float = 0.94, tol: float = 1e-9,

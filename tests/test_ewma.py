@@ -115,3 +115,33 @@ class TestDomainProjection:
         assert bool(jnp.all(jnp.isfinite(smoothed)))
         assert float(jnp.max(jnp.abs(smoothed))) <= \
             float(jnp.max(jnp.abs(vals))) + 1.0
+
+
+class TestForecast:
+    def test_flat_forecast_and_band_formula(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=128).cumsum() + 20.0)
+        m = ewma.fit(x)
+        pt = m.forecast(x, 6)
+        level = float(m.add_time_dependent_effects(x)[-1])
+        np.testing.assert_allclose(np.asarray(pt), level, rtol=1e-7)
+
+        point, lo, hi = m.forecast_interval(x, 6)
+        np.testing.assert_allclose(np.asarray(point), np.asarray(pt))
+        smoothed = np.asarray(m.add_time_dependent_effects(x))
+        err = np.asarray(x)[1:] - smoothed[:-1]
+        sigma2 = np.mean(err * err)
+        a = float(m.smoothing)
+        expect = 1.959964 * np.sqrt(
+            sigma2 * (1 + np.arange(6) * a * a))
+        np.testing.assert_allclose(np.asarray(hi - lo) / 2, expect,
+                                   rtol=1e-5)
+
+    def test_batched(self):
+        rng = np.random.default_rng(1)
+        panel = jnp.asarray(rng.normal(size=(3, 96)).cumsum(axis=1))
+        m = ewma.fit(panel)
+        point, lo, hi = m.forecast_interval(panel, 4)
+        assert point.shape == lo.shape == hi.shape == (3, 4)
+        w = np.asarray(hi - lo)
+        assert np.isfinite(w).all() and (np.diff(w, axis=1) >= 0).all()
