@@ -47,7 +47,7 @@ from jax import lax
 
 from ..ops.univariate import differences_of_order_d
 from . import autoregression_x
-from .base import FitDiagnostics, diagnostics_from
+from .base import FitDiagnostics, diagnostics_from, normal_quantile
 from .arima import (LM_MAX_ITER, _add_effects_one, _batched,
                     _difference_rows, _log_likelihood_css_arma,
                     _one_step_errors, _remove_effects_one,
@@ -206,6 +206,51 @@ class ARIMAXModel(NamedTuple):
         t_idx = jnp.arange(d, n)
         preds = level[t_idx - 1] + pred_diff[t_idx - d]
         return jnp.concatenate([ts[:d], preds])
+
+    def _sigma2_one(self, params: jnp.ndarray, ts: jnp.ndarray,
+                    xreg: jnp.ndarray) -> jnp.ndarray:
+        """One-step error variance of the xreg-adjusted ARMA, CSS
+        convention (burn-in dropped from the sum, full differenced length
+        as divisor — same as the ARIMA bands)."""
+        p, q = self.p, self.q
+        dy = differences_of_order_d(ts, self.d)[self.d:]
+        dx = self.difference_xreg(xreg)
+        adjusted = dy - self._xreg_terms(dx) @ params[1 + p + q:]
+        _, err = _one_step_errors(params[:1 + p + q], adjusted, p, q, 1)
+        return jnp.sum(err * err) / adjusted.shape[-1]
+
+    def forecast_interval(self, ts: jnp.ndarray, xreg: jnp.ndarray,
+                          conf: float = 0.95):
+        """Bands on the one-step-ahead window predictions — beyond
+        reference (``ARIMAX.scala`` has no uncertainty output).
+
+        Every position of :meth:`forecast`'s output is a 1-step forecast
+        conditional on the observed history and exogenous row, so the
+        error variance is the constant one-step σ² of the xreg-adjusted
+        ARMA; bands are ``± z·σ`` around each prediction.  The first ``d``
+        positions of :meth:`forecast`'s output are raw pass-through
+        observations, not forecasts — their bands are NaN rather than a
+        fabricated interval around the observation itself.  Returns
+        ``(pred, lower, upper)``, each shaped like :meth:`forecast`'s
+        output.
+        """
+        ts = jnp.asarray(ts)
+        xreg = jnp.asarray(xreg)
+        pred = self.forecast(ts, xreg)
+        coefs = jnp.asarray(self.coefficients)
+        p_b, t_b, x_b = coefs.ndim > 1, ts.ndim > 1, xreg.ndim > 2
+        if not (p_b or t_b or x_b):
+            sigma2 = self._sigma2_one(coefs, ts, xreg)
+        else:
+            sigma2 = jax.vmap(
+                self._sigma2_one,
+                in_axes=(0 if p_b else None, 0 if t_b else None,
+                         0 if x_b else None))(coefs, ts, xreg)
+        half = normal_quantile(conf, ts.dtype) \
+            * jnp.sqrt(sigma2)[..., None]
+        half = jnp.where(jnp.arange(pred.shape[-1]) < self.d,
+                         jnp.nan, half)
+        return pred, pred - half, pred + half
 
 
 def fit(p: int, d: int, q: int, ts: jnp.ndarray, xreg: jnp.ndarray,

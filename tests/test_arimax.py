@@ -123,3 +123,36 @@ def test_xreg_row_mismatch_is_clear():
     X = jnp.asarray(np.random.default_rng(0).normal(size=(30, 2)))
     with pytest.raises(ValueError, match="series length"):
         arimax.fit(1, 0, 1, y, X, xreg_max_lag=1)
+
+
+def test_forecast_interval_constant_one_step_band():
+    rng = np.random.default_rng(0)
+    n, k = 200, 2
+    xreg = rng.normal(size=(n, k))
+    y = 1.0 + xreg @ np.array([0.5, -0.3]) \
+        + rng.normal(size=n).cumsum() * 0.1
+    m = arimax.fit(1, 0, 1, jnp.asarray(y), jnp.asarray(xreg), 1)
+    pred, lo, hi = m.forecast_interval(jnp.asarray(y), jnp.asarray(xreg))
+    assert pred.shape == lo.shape == hi.shape
+    w = np.asarray(hi - lo)
+    # every position is a 1-step forecast: the band width is constant
+    np.testing.assert_allclose(w, w.flat[0], rtol=1e-6)
+    assert np.isfinite(w).all() and (w > 0).all()
+    np.testing.assert_allclose(np.asarray(pred),
+                               np.asarray(m.forecast(jnp.asarray(y),
+                                                     jnp.asarray(xreg))))
+
+
+def test_forecast_interval_d1_passthrough_positions_are_nan():
+    rng = np.random.default_rng(1)
+    n, k = 180, 1
+    xreg = rng.normal(size=(n, k))
+    y = np.cumsum(0.5 + xreg[:, 0] * 0.3 + rng.normal(size=n) * 0.2)
+    m = arimax.fit(1, 1, 0, jnp.asarray(y), jnp.asarray(xreg), 1)
+    pred, lo, hi = m.forecast_interval(jnp.asarray(y), jnp.asarray(xreg))
+    # first d outputs are pass-through observations, not forecasts
+    assert np.isnan(np.asarray(lo)[:1]).all()
+    assert np.isnan(np.asarray(hi)[:1]).all()
+    w = np.asarray(hi - lo)[1:]
+    assert np.isfinite(w).all()
+    np.testing.assert_allclose(w, w[0], rtol=1e-6)
