@@ -21,10 +21,19 @@ import jax.numpy as jnp
 
 from ..ops.linalg import ols
 from ..stats import dwtest
-from .base import FitDiagnostics
+from .base import FitDiagnostics, normal_quantile
 
 DW_MARGIN = 0.05
 RHO_DIFF_THRESHOLD = 0.001
+
+
+def _broadcast_design(y: jnp.ndarray, X) -> jnp.ndarray:
+    """A shared unbatched ``(n, k)`` design broadcasts over ``y``'s batch —
+    one rule for the fit and the forecast surfaces."""
+    X = jnp.asarray(X)
+    if y.ndim > 1 and X.ndim == 2:
+        X = jnp.broadcast_to(X, (*y.shape[:-1], *X.shape))
+    return X
 
 
 def _is_autocorrelated(residuals: jnp.ndarray) -> jnp.ndarray:
@@ -50,6 +59,65 @@ class RegressionARIMAModel(NamedTuple):
     def remove_time_dependent_effects(self, ts):
         raise NotImplementedError(
             "unsupported in the reference too (RegressionARIMA.scala:193-198)")
+
+    def _residuals(self, ts: jnp.ndarray, X: jnp.ndarray) -> jnp.ndarray:
+        beta = jnp.asarray(self.regression_coeff)
+        return ts - (jnp.einsum("...nk,...k->...n", X, beta[..., 1:])
+                     + beta[..., :1])
+
+    def _point_from_resid(self, resid: jnp.ndarray,
+                          Xf: jnp.ndarray) -> jnp.ndarray:
+        """``x_{n+h}'β + ρ^h e_n`` with the ρ powers as a cumulative
+        product — float ``**`` lowers to exp/log on TPU and NaNs for the
+        negative ρ a Cochrane-Orcutt fit can legitimately produce."""
+        beta = jnp.asarray(self.regression_coeff)
+        rho = jnp.asarray(self.arima_coeff)
+        H = Xf.shape[-2]
+        decay = jnp.cumprod(
+            jnp.broadcast_to(rho[..., None], (*rho.shape, H)), axis=-1)
+        reg_part = jnp.einsum("...hk,...k->...h", Xf, beta[..., 1:]) \
+            + beta[..., :1]
+        return reg_part + decay * resid[..., -1][..., None]
+
+    def forecast(self, ts: jnp.ndarray, regressors,
+                 future_regressors) -> jnp.ndarray:
+        """GLS point forecasts under the fitted AR(1) error — beyond
+        reference (``RegressionARIMA.scala`` has no forecast surface).
+
+        ``y_{n+h} = x_{n+h}'β + ρ^h e_n``: the regression part is
+        deterministic given the supplied future design rows, and the error
+        forecast decays from the last in-sample residual at the fitted ρ.
+        ``future_regressors (..., H, k)`` → ``(..., H)``; a shared
+        unbatched design broadcasts over the batch like in the fit.
+        """
+        ts = jnp.asarray(ts)
+        X = _broadcast_design(ts, regressors)
+        Xf = _broadcast_design(ts, future_regressors)
+        return self._point_from_resid(self._residuals(ts, X), Xf)
+
+    def forecast_interval(self, ts: jnp.ndarray, regressors,
+                          future_regressors, conf: float = 0.95):
+        """Prediction bands for :meth:`forecast`: the AR(1)-error forecast
+        variance is ``σ_u² Σ_{j<h} ρ^{2j}`` with the innovation variance
+        ``σ_u²`` estimated from ``u_t = e_t - ρ e_{t-1}`` (regression
+        coefficients treated as known, the standard Cochrane-Orcutt
+        asymptotics).  Returns ``(point, lower, upper)``, each
+        ``(..., H)``.
+        """
+        ts = jnp.asarray(ts)
+        X = _broadcast_design(ts, regressors)
+        Xf = _broadcast_design(ts, future_regressors)
+        rho = jnp.asarray(self.arima_coeff)
+        resid = self._residuals(ts, X)          # one residual pass serves
+        point = self._point_from_resid(resid, Xf)      # point and bands
+        u = resid[..., 1:] - rho[..., None] * resid[..., :-1]
+        sigma_u2 = jnp.mean(u * u, axis=-1)
+        j = jnp.arange(point.shape[-1], dtype=ts.dtype)
+        # (ρ²)^j keeps the pow base non-negative (TPU-safe for ρ < 0)
+        var_h = sigma_u2[..., None] \
+            * jnp.cumsum((rho * rho)[..., None] ** j, axis=-1)
+        half = normal_quantile(conf, ts.dtype) * jnp.sqrt(var_h)
+        return point, point - half, point + half
 
 
 def fit(ts: jnp.ndarray, regressors: jnp.ndarray, method: str,
@@ -86,8 +154,7 @@ def fit_cochrane_orcutt(ts: jnp.ndarray, regressors: jnp.ndarray,
         raise ValueError(
             f"regressors have {X.shape[-2]} rows which is not equal to time "
             f"series length {y.shape[-1]}")
-    if y.ndim > 1 and X.ndim == 2:
-        X = jnp.broadcast_to(X, (*y.shape[:-1], *X.shape))
+    X = _broadcast_design(y, X)
 
     # Step 1: OLS y = a + B·X + e
     res = ols(X, y, add_intercept=True)
