@@ -42,6 +42,43 @@ def ezplot(series, style: str = "-"):
     return fig
 
 
+def forecast_plot(data, model, n_future: int, conf: float = 0.95):
+    """History, point forecast, and shaded prediction bands for one series
+    — beyond reference (``EasyPlot`` has no forecast view).
+
+    ``model`` is any fitted model exposing
+    ``forecast_interval(ts, n_future, conf)`` (ARIMA, Holt-Winters
+    additive, EWMA).  ARIMA's full-length output (historical one-step fits
+    + future) is split automatically; the bands always cover exactly the
+    ``n_future`` future steps.
+    """
+    import jax.numpy as jnp
+
+    arr = np.asarray(data)
+    if arr.ndim != 1:
+        raise ValueError("forecast_plot draws one series; slice the panel")
+    point, lo, hi = model.forecast_interval(jnp.asarray(arr), n_future,
+                                            conf)
+    point, lo, hi = (np.asarray(v) for v in (point, lo, hi))
+    if point.ndim != 1:
+        raise ValueError(
+            "forecast_plot draws one series, but the model is panel-fitted "
+            "(batched parameters); select one lane's model first")
+    future = point[..., -n_future:] \
+        if point.shape[-1] != n_future else point
+
+    fig, ax = _figure()
+    n = arr.shape[-1]
+    t_fut = n - 1 + np.arange(n_future + 1)
+    ax.plot(np.arange(n), arr, color="C0", label="observed")
+    # prepend the last observation so the forecast connects visually
+    ax.plot(t_fut, np.r_[arr[-1], future], color="C1", label="forecast")
+    ax.fill_between(t_fut[1:], lo, hi, color="C1", alpha=0.25,
+                    label=f"{int(round(conf * 100))}% band")
+    ax.legend()
+    return fig
+
+
 def _draw_corr(ax, corrs: np.ndarray, conf_val: float) -> None:
     """Vertical correlation bars + horizontal confidence lines
     (ref ``EasyPlot.scala:104-119``)."""

@@ -262,3 +262,37 @@ def test_load_csv_rejects_corruption(tmp_path):
     (d / "data.csv").write_text("a,1.0,2.0,3.0\nb,4.0,,6.0\n")
     with pytest.raises(ValueError, match="empty field"):
         stio.load_csv(str(d))
+
+
+def test_forecast_plot(tmp_path):
+    from spark_timeseries_tpu.models import arima, ewma, holt_winters
+
+    rng = np.random.default_rng(4)
+    data = rng.normal(size=200).cumsum() + 50.0
+    m_arima = arima.fit(1, 1, 0, jnp.asarray(data), warn=False)
+    fig = plot.forecast_plot(data, m_arima, 20)
+    fig.savefig(str(tmp_path / "fc_arima.png"))
+
+    m_ewma = ewma.fit(jnp.asarray(data), method="box")
+    fig2 = plot.forecast_plot(data, m_ewma, 10)
+    fig2.savefig(str(tmp_path / "fc_ewma.png"))
+
+    t = np.arange(120.)
+    seasonal = 100 + 0.3 * t + 8 * np.sin(2 * np.pi * t / 12) \
+        + rng.normal(size=120)
+    m_hw = holt_winters.fit(jnp.asarray(seasonal), 12, "additive",
+                            max_iter=200)
+    fig3 = plot.forecast_plot(seasonal, m_hw, 24)
+    fig3.savefig(str(tmp_path / "fc_hw.png"))
+
+    with pytest.raises(ValueError, match="one series"):
+        plot.forecast_plot(np.ones((2, 50)), m_arima, 5)
+
+
+def test_forecast_plot_rejects_batched_model():
+    from spark_timeseries_tpu.models import arima
+    rng = np.random.default_rng(5)
+    panel = jnp.asarray(rng.normal(size=(3, 120)).cumsum(axis=1))
+    m = arima.fit(1, 1, 0, panel, warn=False)     # batched parameters
+    with pytest.raises(ValueError, match="panel-fitted"):
+        plot.forecast_plot(np.asarray(panel[0]), m, 5)
