@@ -271,7 +271,8 @@ def main():
                                              holt_winters,
                                              regression_arima)
 
-    dtype = jnp.float32 if jax.devices()[0].platform != "cpu" else jnp.float64
+    platform = jax.devices()[0].platform
+    dtype = jnp.float32 if platform != "cpu" else jnp.float64
     if dtype == jnp.float64:
         jax.config.update("jax_enable_x64", True)
     rng = np.random.default_rng(0)
@@ -434,7 +435,8 @@ def main():
         print(json.dumps({
             "metric": "fit_long vs direct coefficient max-abs-diff "
                       f"({n}x{n_obs}, asserted < 0.05)",
-            "value": round(agree, 4), "unit": "coefficient delta"}))
+            "value": round(agree, 4), "unit": "coefficient delta",
+            "platform": platform}))
     else:
         print(json.dumps({
             "metric": "ultra-long ARIMA fit_long", "value": None,
@@ -467,7 +469,8 @@ def main():
     print(json.dumps({
         "metric": f"CSV save+load round trip series/sec ({n}x{n_obs}, "
                   "bit-exact)",
-        "value": round(n / dt, 1), "unit": "series/sec"}))
+        "value": round(n / dt, 1), "unit": "series/sec",
+        "platform": platform}))
 
     for name, n, n_obs, rate, baseline in results:
         unit = "obs/sec" if "obs/sec" in name else "series/sec"
@@ -476,6 +479,7 @@ def main():
             "metric": f"{label} {unit}/chip ({n}x{n_obs})",
             "value": round(rate, 1),
             "unit": unit,
+            "platform": platform,
         }
         if baseline is not None:
             base_rate, sample = baseline
