@@ -72,8 +72,13 @@ def main():
     def residual(prm, y):
         return _one_step_errors(prm, y, p, q, 1)[1]
 
+    # every pass reduces its outputs to one scalar ON DEVICE: the tunneled
+    # D2H link moves ~10 MB/s, so returning the raw (S, n) residuals or the
+    # (S, k, k) grams would time the transfer, not the compute (the first
+    # TPU capture showed the strictly-smaller normal-equations pass
+    # "faster" than the residual pass for exactly this reason)
     def residual_pass(prm, y):
-        return jax.vmap(residual)(prm, y)
+        return jnp.sum(jax.vmap(residual)(prm, y) ** 2)
 
     def normal_eqs_pass(prm, y):
         eye = jnp.eye(k, dtype=dtype)
@@ -82,7 +87,8 @@ def main():
             r, fwd = jax.linearize(lambda x: residual(x, y_i), prm_i)
             Jr = jax.vmap(fwd)(eye)
             return Jr @ Jr.T, Jr @ r, jnp.sum(r * r)
-        return jax.vmap(one)(prm, y)
+        JJt, Jr_, sse = jax.vmap(one)(prm, y)
+        return jnp.sum(JJt) + jnp.sum(Jr_) + jnp.sum(sse)
 
     diffed = jnp.asarray(np.diff(panel, axis=1), dtype)
     rp = jax.jit(residual_pass)
@@ -94,12 +100,25 @@ def main():
     emit(f"normal-equations pass: primal + {k} tangents ({n}x{n_obs})",
          t_ne, tangent_share=round(1 - t_resid / t_ne, 3))
 
+    # the production pass: hand-fused carry accumulation (design.md §9)
+    from spark_timeseries_tpu.models.arima import _arma_normal_eqs
+    @jax.jit
+    def fused_scalar(prm, y):
+        jtj, jtr, sse = jax.vmap(
+            lambda prm_i, y_i: _arma_normal_eqs(prm_i, y_i, p, q, 1))(
+                prm, y)
+        return jnp.sum(jtj) + jnp.sum(jtr) + jnp.sum(sse)
+
+    t_fused = _timed(fused_scalar, x0, diffed)
+    emit(f"fused-carry normal-equations pass ({n}x{n_obs})", t_fused,
+         vs_linearize=round(t_ne / t_fused, 2))
+
     # marginal LM iteration cost from two fixed-budget fits
     vals = jnp.asarray(panel, dtype)
-    f2 = jax.jit(lambda v: arima.fit(2, 1, 2, v, warn=False,
-                                     max_iter=2).coefficients)
-    f12 = jax.jit(lambda v: arima.fit(2, 1, 2, v, warn=False,
-                                      max_iter=12).coefficients)
+    f2 = jax.jit(lambda v: jnp.sum(arima.fit(2, 1, 2, v, warn=False,
+                                             max_iter=2).coefficients))
+    f12 = jax.jit(lambda v: jnp.sum(arima.fit(2, 1, 2, v, warn=False,
+                                              max_iter=12).coefficients))
     t2 = _timed(f2, vals, reps=3)
     t12 = _timed(f12, vals, reps=3)
     emit(f"marginal LM iteration ({n}x{n_obs})", (t12 - t2) / 10.0,

@@ -41,8 +41,23 @@ def scan_unroll() -> int:
     wider body spills).  On CPU (the test mesh) runtime is FLOP-bound and
     larger scan bodies only inflate compile time, so the factor stays 1.
     Evaluated lazily at trace time — importing the package must not
-    initialize a JAX backend."""
+    initialize a JAX backend.  ``STS_SCAN_UNROLL`` overrides the default
+    (tuning knob; re-jit after changing it — traces cache the value)."""
+    import os
+
     import jax
+    env = os.environ.get("STS_SCAN_UNROLL")
+    if env:
+        try:
+            val = int(env)
+        except ValueError as e:
+            raise ValueError(
+                f"STS_SCAN_UNROLL must be a positive integer, got {env!r}"
+            ) from e
+        if val < 1:
+            raise ValueError(
+                f"STS_SCAN_UNROLL must be >= 1, got {env!r}")
+        return val
     return 8 if jax.default_backend() != "cpu" else 1
 
 

@@ -76,7 +76,7 @@ class _LMState(NamedTuple):
 
 
 def _minimize_lm_one(residual_fn, x0, tol, max_iter, lam0=1e-3,
-                     lam_up=10.0, lam_down=0.1):
+                     lam_up=10.0, lam_down=0.1, normal_eqs_fn=None):
     """Single-lane Levenberg-Marquardt on a residual vector; designed to be
     vmapped (fixed-shape while_loop, per-lane damping and convergence).
 
@@ -84,11 +84,16 @@ def _minimize_lm_one(residual_fn, x0, tol, max_iter, lam0=1e-3,
     evaluated at the *trial* point, so an accepted step's next solve reuses
     them and a rejected step re-solves from the carried ones with higher
     damping — halving the recurrence work versus a separate cost evaluation.
+
+    ``normal_eqs_fn(x) -> (JᵀJ, Jᵀr, sse)`` overrides the autodiff pass for
+    residuals whose Jacobian has a cheap hand-fused form (e.g. the ARMA
+    tangent recurrence accumulated in a scan carry, which never materializes
+    the (p, m) Jacobian the linearize pass streams through HBM).
     """
     p = x0.shape[-1]
     eye = jnp.eye(p, dtype=x0.dtype)
 
-    def normal_eqs(x):
+    def autodiff_normal_eqs(x):
         # row-major Jacobian (p, m) via linearize: one primal pass, p tangent
         # passes.  Orientation matters on TPU — under vmap a (batch, m, p)
         # Jacobian pads its minor p axis to 128 lanes (~25x HBM at p=5),
@@ -96,6 +101,9 @@ def _minimize_lm_one(residual_fn, x0, tol, max_iter, lam0=1e-3,
         r, fwd = jax.linearize(residual_fn, x)
         Jr = jax.vmap(fwd)(eye)                             # (p, m)
         return Jr @ Jr.T, Jr @ r, jnp.sum(r * r)
+
+    normal_eqs = normal_eqs_fn if normal_eqs_fn is not None \
+        else autodiff_normal_eqs
 
     def body(s: _LMState):
         # Marquardt scaling: damp by lam * diag(JTJ) for scale invariance.
@@ -133,9 +141,11 @@ def _minimize_lm_one(residual_fn, x0, tol, max_iter, lam0=1e-3,
     return MinimizeResult(state.x, state.f, state.done, state.it)
 
 
-def minimize_least_squares(residual_fn: Callable, x0: jnp.ndarray, *args,
-                           tol: float | None = None,
-                           max_iter: int = 100) -> MinimizeResult:
+def minimize_least_squares(residual_fn: Callable | None, x0: jnp.ndarray,
+                           *args, tol: float | None = None,
+                           max_iter: int = 100,
+                           normal_eqs_fn: Callable | None = None
+                           ) -> MinimizeResult:
     """Batched Levenberg-Marquardt for residual objectives (minimizes
     ``sum(residual_fn(x)**2)``).
 
@@ -147,13 +157,21 @@ def minimize_least_squares(residual_fn: Callable, x0: jnp.ndarray, *args,
     ``residual_fn(params, *args) -> (m,)`` with ``params (p,)``; ``x0`` may
     carry leading batch dims, vmapped with matching ``args`` dims.  ``tol``
     defaults to a dtype-aware value (1e-10 for f64, 1e-6 for f32).
+
+    ``normal_eqs_fn(params, *args) -> (JᵀJ, Jᵀr, sse)``, when given,
+    replaces the autodiff Jacobian pass with a hand-fused one (see
+    ``_minimize_lm_one``); ``residual_fn`` is then unused and may be None.
     """
     if tol is None:
         tol = 1e-10 if x0.dtype == jnp.float64 else 1e-6
 
     def solve_one(x0_i, *args_i):
-        return _minimize_lm_one(lambda x: residual_fn(x, *args_i), x0_i,
-                                tol, max_iter)
+        ne = (lambda x: normal_eqs_fn(x, *args_i)) \
+            if normal_eqs_fn is not None else None
+        return _minimize_lm_one(
+            (lambda x: residual_fn(x, *args_i))
+            if residual_fn is not None else None,
+            x0_i, tol, max_iter, normal_eqs_fn=ne)
 
     batch_dims = x0.ndim - 1
     for _ in range(batch_dims):

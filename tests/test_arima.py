@@ -362,3 +362,40 @@ def test_forecast_interval_nonstationary_lane_grows_unbounded():
     # and far enough out the explosive lane's f64 variance overflows to inf
     _, lo2, hi2 = m.forecast_interval(y, 800)
     assert not np.isfinite(np.asarray(hi2 - lo2)[1]).all()
+
+
+def test_fused_normal_eqs_matches_autodiff():
+    # the hand-fused (JᵀJ, Jᵀr, sse) scan must agree with linearize-through-
+    # the-residual-scan to f64 rounding, masked and unmasked, across
+    # (p, q, icpt) corners including the recursion-free q=0 and p=0 shapes
+    rng = np.random.default_rng(11)
+    y = jnp.asarray(rng.normal(size=(64,)).cumsum() * 0.1)
+    for p, q, icpt in [(2, 2, 1), (1, 2, 0), (0, 2, 1), (2, 0, 1),
+                       (3, 1, 1), (0, 1, 0)]:
+        k = icpt + p + q
+        prm = jnp.asarray(rng.uniform(-0.4, 0.4, size=(k,)))
+
+        def resid(x):
+            return arima._one_step_errors(x, y, p, q, icpt)[1]
+
+        r, fwd = jax.linearize(resid, prm)
+        J = jax.vmap(fwd)(jnp.eye(k, dtype=y.dtype))
+        jtj, jtr, sse = arima._arma_normal_eqs(prm, y, p, q, icpt)
+        np.testing.assert_allclose(jtj, J @ J.T, rtol=1e-9, atol=1e-10)
+        np.testing.assert_allclose(jtr, J @ r, rtol=1e-9, atol=1e-10)
+        np.testing.assert_allclose(sse, jnp.sum(r * r), rtol=1e-12)
+
+        if p == q == 2:          # masked variant against r(x ∘ mask)
+            mask = jnp.asarray([1.0, 1.0, 0.0, 1.0, 0.0])
+
+            def resid_m(x):
+                return arima._one_step_errors(x * mask, y, p, q, icpt)[1]
+
+            rm, fwd_m = jax.linearize(resid_m, prm)
+            Jm = jax.vmap(fwd_m)(jnp.eye(k, dtype=y.dtype))
+            jtj, jtr, sse = arima._arma_normal_eqs(prm, y, p, q, icpt,
+                                                   mask=mask)
+            np.testing.assert_allclose(jtj, Jm @ Jm.T, rtol=1e-9,
+                                       atol=1e-10)
+            np.testing.assert_allclose(jtr, Jm @ rm, rtol=1e-9, atol=1e-10)
+            np.testing.assert_allclose(sse, jnp.sum(rm * rm), rtol=1e-12)
