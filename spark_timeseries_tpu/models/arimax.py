@@ -48,8 +48,8 @@ from jax import lax
 from ..ops.univariate import differences_of_order_d
 from . import autoregression_x
 from .base import FitDiagnostics, diagnostics_from, normal_quantile
-from .arima import (LM_MAX_ITER, _add_effects_one, _batched,
-                    _difference_rows, _log_likelihood_css_arma,
+from .arima import (LM_MAX_ITER, _add_effects_one, _arma_normal_eqs,
+                    _batched, _difference_rows, _log_likelihood_css_arma,
                     _one_step_errors, _remove_effects_one,
                     hannan_rissanen_init)
 from ..ops.optimize import (minimize_bfgs, minimize_box,
@@ -318,10 +318,14 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray, xreg: jnp.ndarray,
             return -_log_likelihood_css_arma(prm, y, p, q, icpt)
 
         if method == "css-lm":
-            def resid(prm, y):
-                return _one_step_errors(prm, y, p, q, icpt)[1]
-            res = minimize_least_squares(resid, init, adjusted,
-                                         max_iter=max_iter if max_iter is not None else LM_MAX_ITER)
+            # the refinement runs on the xreg-adjusted series with pure
+            # [c?, AR, MA] parameters — exactly arima's CSS residual, so
+            # the fused-carry normal equations apply unchanged
+            res = minimize_least_squares(
+                None, init, adjusted,
+                max_iter=max_iter if max_iter is not None else LM_MAX_ITER,
+                normal_eqs_fn=lambda prm, y: _arma_normal_eqs(
+                    prm, y, p, q, icpt))
         elif method == "css-cgd":
             res = minimize_bfgs(neg_ll, init, adjusted, tol=1e-7,
                                 max_iter=max_iter if max_iter is not None else 500)

@@ -6,10 +6,13 @@ model ``S_t = a * X_t + (1 - a) * S_{t-1}``, ``S_0 = X_0``; fitting minimizes
 the one-step-ahead sum of squared errors over the smoothing parameter ``a``
 starting from 0.94.
 
-TPU-native design: the recurrence is a ``lax.scan`` (auto-differentiated —
-the reference derives the SSE gradient by hand, ``EWMA.scala:102-123``), and
-the scalar Commons-Math CGD loop becomes a batched BFGS solve over the whole
-panel (one compiled program fits every series at once).
+TPU-native design: the recurrence is a ``lax.scan``, and the scalar
+Commons-Math CGD loop becomes one batched solve over the whole panel (one
+compiled program fits every series at once).  The default ``method="lm"``
+runs Levenberg-Marquardt on hand-fused normal equations accumulated in the
+scan carry (``_ewma_normal_eqs``; the reference also hand-derives its
+gradient, ``EWMA.scala:102-123``); ``method="bfgs"``/``"box"`` use autodiff
+through the same scan.
 """
 
 from __future__ import annotations
@@ -104,6 +107,32 @@ class EWMAModel(NamedTuple):
         return point, point - half, point + half
 
 
+def _ewma_normal_eqs(params: jnp.ndarray, series: jnp.ndarray):
+    """Fused-carry Gauss-Newton pass for the one-step SSE residuals (same
+    trick as ``arima._arma_normal_eqs``, docs/design.md §9): with
+    ``s_t = a x_t + (1-a) s_{t-1}`` and ``e_t = x_{t+1} - s_t``, the
+    tangent obeys ``ds_t = x_t - s_{t-1} + (1-a) ds_{t-1}``, so JᵀJ, Jᵀr,
+    and sse accumulate in the scan carry and no ``(1, m)`` Jacobian is
+    materialized.  The ``t = 0`` residual ``x_1 - s_0 = x_1 - x_0`` has
+    zero tangent (``s_0 = x_0`` is data)."""
+    a = params[0]
+
+    def step(carry, inp):
+        s, ds, jtj, jtr, sse = carry
+        x_t, x_next = inp
+        ds = x_t - s + (1.0 - a) * ds
+        s = a * x_t + (1.0 - a) * s
+        e = x_next - s
+        return (s, ds, jtj + ds * ds, jtr - ds * e, sse + e * e), None
+
+    zero = jnp.zeros((), series.dtype)
+    (_, _, jtj, jtr, sse), _ = lax.scan(
+        step, (series[0], zero, zero, zero, zero),
+        (series[1:-1], series[2:]), unroll=scan_unroll())
+    e0 = series[1] - series[0]
+    return (jtj.reshape(1, 1), jtr.reshape(1), sse + e0 * e0)
+
+
 def fit(ts: jnp.ndarray, init: float = 0.94, tol: float = 1e-9,
         max_iter: int = 200, method: str = "lm") -> EWMAModel:
     """Fit EWMA by minimizing one-step SSE over the smoothing parameter
@@ -127,15 +156,12 @@ def fit(ts: jnp.ndarray, init: float = 0.94, tol: float = 1e-9,
     def objective(params, series):
         return EWMAModel(params[0]).sse(series)
 
-    def residuals(params, series):
-        smoothed = EWMAModel(params[0]).add_time_dependent_effects(series)
-        return series[1:] - smoothed[:-1]
-
     x0 = jnp.broadcast_to(jnp.asarray(init, ts.dtype)[..., None],
                           (*ts.shape[:-1], 1))
     if method == "lm":
-        res = minimize_least_squares(residuals, x0, ts, tol=tol,
-                                     max_iter=max_iter)
+        res = minimize_least_squares(None, x0, ts, tol=tol,
+                                     max_iter=max_iter,
+                                     normal_eqs_fn=_ewma_normal_eqs)
         # LM is unconstrained but the model domain is (0, 1]: a lane that
         # converges outside it (possible on near-random-walk data, where
         # the SSE is flat past a=1) would silently yield an oscillating,

@@ -145,3 +145,26 @@ class TestForecast:
         assert point.shape == lo.shape == hi.shape == (3, 4)
         w = np.asarray(hi - lo)
         assert np.isfinite(w).all() and (np.diff(w, axis=1) >= 0).all()
+
+
+def test_fused_normal_eqs_matches_autodiff():
+    # the fused-carry (JᵀJ, Jᵀr, sse) pass must agree with linearize
+    # through the smoothing recurrence at f64 rounding, inside and outside
+    # the model domain (the LM path can visit a > 1 before projection)
+    import jax
+
+    rng = np.random.default_rng(5)
+    y = jnp.asarray(rng.normal(size=(80,)).cumsum() * 0.3 + 50)
+
+    def resid(prm):
+        sm = ewma.EWMAModel(prm[0]).add_time_dependent_effects(y)
+        return y[1:] - sm[:-1]
+
+    for a0 in (0.2, 0.94, 1.3):
+        prm = jnp.asarray([a0])
+        r, fwd = jax.linearize(resid, prm)
+        J = jax.vmap(fwd)(jnp.eye(1, dtype=y.dtype))
+        jtj, jtr, sse = ewma._ewma_normal_eqs(prm, y)
+        np.testing.assert_allclose(jtj, J @ J.T, rtol=1e-10)
+        np.testing.assert_allclose(jtr, J @ r, rtol=1e-10)
+        np.testing.assert_allclose(sse, jnp.sum(r * r), rtol=1e-12)
