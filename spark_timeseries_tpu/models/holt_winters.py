@@ -220,6 +220,83 @@ class HoltWintersModel(NamedTuple):
         return point, point - half, point + half
 
 
+def _hw_sse_value_and_grad(params: jnp.ndarray, series: jnp.ndarray,
+                           period: int, model_type: str):
+    """Fused forward pass computing ``(sse, dsse/d(α,β,γ))`` in one scan.
+
+    Reverse-mode autodiff through the components recurrence stores every
+    step's (level, trend, season-ring) carry for the backward sweep; here
+    the hand tangent recurrences ride the forward carry instead (the same
+    fused-accumulator shape as ``arima._arma_normal_eqs``, docs/design.md
+    §9).  Differentiating the update equations of ``HoltWintersModel._run``
+    w.r.t. θ = (α, β, γ), with ``e_α/e_β/e_γ`` the unit vectors:
+
+        dlw  = -ds_i                (additive)  |  -(x/s_i²)·ds_i  (mult.)
+        dl'  = e_α(lw - base) + α·dlw + (1-α)·dbase
+        db'  = e_β(l' - l - b) + β(dl' - dl) + (1-β)·db
+        dsw  = -dl'                 (additive)  |  -(x/l'²)·dl'    (mult.)
+        ds'  = e_γ(sw - s_i) + γ·dsw + (1-γ)·ds_i
+        de   = -(dbase + ds_i)      (additive)  |  -(dbase·s_i + base·ds_i)
+
+    and ``g += 2·e·de``, ``sse += e²`` accumulate per step.  The initial
+    components are data-only (``_init_components``), so tangents start at
+    zero.  Single lane ``series (n,)``; vmapped by ``minimize_box``.
+    """
+    model = HoltWintersModel(model_type, period, params[0], params[1],
+                             params[2])
+    additive = model.additive
+    a, b, g = params[0], params[1], params[2]
+    dtype = series.dtype
+    e_a = jnp.asarray([1.0, 0.0, 0.0], dtype)
+    e_b = jnp.asarray([0.0, 1.0, 0.0], dtype)
+    e_g = jnp.asarray([0.0, 0.0, 1.0], dtype)
+
+    level0, trend0, season0 = model._init_components(series)
+    xs = series[period:]
+
+    def step(carry, x):
+        (level, trend, seasons, dl, db_, dseasons, sse, grad) = carry
+        s_i = seasons[0]
+        ds_i = dseasons[0]
+        base = level + trend
+        dbase = dl + db_
+        if additive:
+            dest = base + s_i
+            e = x - dest
+            de = -(dbase + ds_i)
+            lw = x - s_i
+            dlw = -ds_i
+        else:
+            dest = base * s_i
+            e = x - dest
+            de = -(dbase * s_i + base * ds_i)
+            lw = x / s_i
+            dlw = -(x / (s_i * s_i)) * ds_i
+        new_level = a * lw + (1.0 - a) * base
+        dnew_level = e_a * (lw - base) + a * dlw + (1.0 - a) * dbase
+        new_trend = b * (new_level - level) + (1.0 - b) * trend
+        dnew_trend = e_b * (new_level - level - trend) \
+            + b * (dnew_level - dl) + (1.0 - b) * db_
+        if additive:
+            sw = x - new_level
+            dsw = -dnew_level
+        else:
+            sw = x / new_level
+            dsw = -(x / (new_level * new_level)) * dnew_level
+        new_season = g * sw + (1.0 - g) * s_i
+        dnew_season = e_g * (sw - s_i) + g * dsw + (1.0 - g) * ds_i
+        seasons = jnp.concatenate([seasons[1:], new_season[None]])
+        dseasons = jnp.concatenate([dseasons[1:], dnew_season[None]])
+        return (new_level, new_trend, seasons, dnew_level, dnew_trend,
+                dseasons, sse + e * e, grad + 2.0 * e * de), None
+
+    zero3 = jnp.zeros((3,), dtype)
+    carry0 = (level0, trend0, season0, zero3, zero3,
+              jnp.zeros((period, 3), dtype), jnp.zeros((), dtype), zero3)
+    (out, _) = lax.scan(step, carry0, xs, unroll=scan_unroll())
+    return out[6], out[7]
+
+
 def fit(ts: jnp.ndarray, period: int, model_type: str = "additive",
         init=(0.3, 0.1, 0.1), tol: float = 1e-10,
         max_iter: int = 1000) -> HoltWintersModel:
@@ -235,9 +312,12 @@ def fit(ts: jnp.ndarray, period: int, model_type: str = "additive",
         return HoltWintersModel(model_type, period, params[0], params[1],
                                 params[2]).sse(series)
 
+    def value_and_grad(params, series):
+        return _hw_sse_value_and_grad(params, series, period, model_type)
+
     x0 = jnp.broadcast_to(jnp.asarray(init, ts.dtype), (*ts.shape[:-1], 3))
     res = minimize_box(objective, x0, 0.0, 1.0, ts, tol=tol,
-                       max_iter=max_iter)
+                       max_iter=max_iter, value_and_grad_fn=value_and_grad)
     ok = jnp.all(jnp.isfinite(res.x), axis=-1, keepdims=True)
     p = jnp.where(ok, res.x, x0)
     return HoltWintersModel(model_type, period, p[..., 0], p[..., 1],

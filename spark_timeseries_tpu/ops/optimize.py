@@ -279,14 +279,24 @@ class _BoxState(NamedTuple):
 
 
 def _minimize_box_one(fn, x0, lower, upper, tol=1e-10, max_iter=500,
-                      max_backtracks=40):
+                      max_backtracks=40, value_and_grad_fn=None):
     """Single-lane projected gradient with Armijo backtracking.
 
     Designed to be vmapped: under ``vmap`` the ``while_loop`` keeps stepping
     until every lane's mask is set, and finished lanes hold position — the
     convergence-mask batching strategy from SURVEY.md §7.
+
+    ``value_and_grad_fn(x) -> (f, g)`` overrides reverse-mode autodiff for
+    objectives with a cheap fused forward pass (e.g. the Holt-Winters
+    tangent recurrence, which otherwise stores every scan step's carry for
+    the backward sweep).
     """
-    value_and_grad = jax.value_and_grad(fn)
+    value_and_grad = value_and_grad_fn if value_and_grad_fn is not None \
+        else jax.value_and_grad(fn)
+    # project BEFORE the initial evaluation: an out-of-box x0 would
+    # otherwise pair the projected starting point with the unprojected
+    # point's value and gradient
+    x0 = _project(x0, lower, upper)
     f0, g0 = value_and_grad(x0)
 
     def cond(s: _BoxState):
@@ -327,26 +337,30 @@ def _minimize_box_one(fn, x0, lower, upper, tol=1e-10, max_iter=500,
         g_next = jnp.where(accepted, g_new, s.g)
         return _BoxState(x_next, f_next, g_next, s.it + 1, done)
 
-    x0 = _project(x0, lower, upper)
     final = lax.while_loop(
         cond, body, _BoxState(x0, f0, g0, jnp.asarray(0), jnp.asarray(False)))
     return MinimizeResult(final.x, final.f, final.done, final.it)
 
 
 def minimize_box(fn: Callable, x0: jnp.ndarray, lower, upper, *args,
-                 tol: float = 1e-10, max_iter: int = 500) -> MinimizeResult:
+                 tol: float = 1e-10, max_iter: int = 500,
+                 value_and_grad_fn: Callable | None = None) -> MinimizeResult:
     """Batched box-constrained minimization (the BOBYQA replacement).
 
     ``fn(params, *args) -> scalar``; ``x0 (..., p)``; ``lower``/``upper``
     broadcastable to ``(p,)``.  Leading dims of ``x0`` (and of each ``args``
-    entry) are vmapped.
+    entry) are vmapped.  ``value_and_grad_fn(params, *args) -> (f, g)``
+    optionally replaces reverse-mode autodiff (see ``_minimize_box_one``).
     """
     lower = jnp.broadcast_to(jnp.asarray(lower, x0.dtype), x0.shape[-1:])
     upper = jnp.broadcast_to(jnp.asarray(upper, x0.dtype), x0.shape[-1:])
 
     def solve_one(x0_i, *args_i):
+        vag = (lambda p: value_and_grad_fn(p, *args_i)) \
+            if value_and_grad_fn is not None else None
         return _minimize_box_one(lambda p: fn(p, *args_i), x0_i, lower, upper,
-                                 tol=tol, max_iter=max_iter)
+                                 tol=tol, max_iter=max_iter,
+                                 value_and_grad_fn=vag)
 
     batch_dims = x0.ndim - 1
     for _ in range(batch_dims):

@@ -148,3 +148,45 @@ def test_forecast_interval_mixed_batch_shapes():
     pt2, lo2, hi2 = mb.forecast_interval(panel, 5)
     assert pt2.shape == (2, 5)
     assert bool(jnp.all(jnp.isfinite(hi2 - lo2)))
+
+
+def test_fused_value_and_grad_matches_autodiff():
+    # the fused forward tangent pass used by fit() must agree with
+    # reverse-mode autodiff through the components recurrence at f64
+    # rounding, for both model types and across the [0,1]^3 box
+    import jax
+
+    rng = np.random.default_rng(7)
+    t = np.arange(96)
+    add_series = jnp.asarray(
+        80 + 0.4 * t + 8 * np.sin(2 * np.pi * t / 12)
+        + rng.normal(size=96))
+    mult_series = jnp.asarray(
+        (80 + 0.4 * t) * (1 + 0.12 * np.sin(2 * np.pi * t / 12))
+        + rng.normal(size=96) * 0.5)
+    for mt, s in (("additive", add_series),
+                  ("multiplicative", mult_series)):
+        def obj(p):
+            return hw.HoltWintersModel(
+                mt, 12, p[0], p[1], p[2]).sse(s)
+
+        for p0 in ([0.3, 0.1, 0.1], [0.7, 0.4, 0.6], [0.05, 0.9, 0.3]):
+            prm = jnp.asarray(p0)
+            f_ref, g_ref = jax.value_and_grad(obj)(prm)
+            f, g = hw._hw_sse_value_and_grad(prm, s, 12, mt)
+            np.testing.assert_allclose(f, f_ref, rtol=1e-12)
+            np.testing.assert_allclose(g, g_ref, rtol=1e-9, atol=1e-9)
+
+
+def test_out_of_box_init_projects_before_first_evaluation():
+    # minimize_box used to evaluate f0/g0 at the unprojected init, pairing
+    # the projected start point with another point's value and gradient —
+    # an out-of-box init then converged instantly to a wrong answer
+    rng = np.random.default_rng(2)
+    t = np.arange(96)
+    s = jnp.asarray(90 + 0.3 * t + 7 * np.sin(2 * np.pi * t / 12)
+                    + rng.normal(size=96))
+    good = hw.fit(s, 12, "additive", max_iter=200)
+    wild = hw.fit(s, 12, "additive", max_iter=200, init=(1.5, 0.5, 0.5))
+    np.testing.assert_allclose(float(wild.sse(s)), float(good.sse(s)),
+                               rtol=0.05)
