@@ -98,13 +98,14 @@ def test_arima_p1q_equals_differenced_arma():
     # identical inputs -> identical solve
     np.testing.assert_allclose(got, np.asarray(arma_fit.coefficients),
                                atol=1e-9)
-    # the estimator is consistent: at 8x the sample the same recovery
-    # tightens to 0.08 (observed <= 0.042 across seeds 0/1/7; margin 2x)
-    long_sample = model.sample(8000, jax.random.PRNGKey(0))
+    # the 0.25 above is estimator variance, not solver error: at n = 4000
+    # the same recovery tightens 5x (0.008/0.032/0.011 across seeds 0-2),
+    # pinning the solver itself to the truth
+    long_sample = model.sample(4000, jax.random.PRNGKey(0))
     long_fit = arima.fit(1, 1, 2, long_sample, include_intercept=False,
                          warn=False)
     np.testing.assert_allclose(np.asarray(long_fit.coefficients),
-                               [0.3, 0.7, 0.1], atol=0.08)
+                               [0.3, 0.7, 0.1], atol=0.05)
 
 
 def test_add_then_remove_effects_round_trip():
