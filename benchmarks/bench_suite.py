@@ -262,8 +262,33 @@ def _arima_baseline(row: np.ndarray) -> None:
 
 
 def main():
+    # probe the accelerator in a disposable subprocess BEFORE touching the
+    # backend in-process (shared contract, bench._resolve_platform: a
+    # wedged TPU tunnel hangs backend init indefinitely — round 2's record
+    # was voided that way, and the first round-3 CPU smoke of this suite
+    # died the same death because the axon sitecustomize overrides
+    # JAX_PLATFORMS=cpu).  On CPU the long-series knobs shrink to feasible
+    # defaults unless explicitly set; a probe-failure fallback is stamped
+    # "degraded" on every line so it can never read as a deliberate CPU
+    # capture.
+    from bench import _resolve_platform
+    platform, degraded = _resolve_platform()
+
     import jax
+
+    if platform == "cpu":
+        os.environ.setdefault("BENCH_LONG_OBS", "16384")
+        os.environ.setdefault("BENCH_ULTRA_OBS", "16384")
+
     import jax.numpy as jnp
+
+    def emit(obj):
+        # probe-failure fallback is visible on every line (review r3:
+        # a wedged-TPU run must never read as a deliberate CPU capture)
+        if degraded:
+            from bench import DEGRADED_NOTE
+            obj["degraded"] = DEGRADED_NOTE
+        print(json.dumps(obj), flush=True)
 
     from bench import _synthetic_arima_panel
     from spark_timeseries_tpu import stats
@@ -271,7 +296,6 @@ def main():
                                              holt_winters,
                                              regression_arima)
 
-    platform = jax.devices()[0].platform
     dtype = jnp.float32 if platform != "cpu" else jnp.float64
     if dtype == jnp.float64:
         jax.config.update("jax_enable_x64", True)
@@ -432,16 +456,16 @@ def main():
                 f"max coefficient delta {agree:.4f} >= 0.05")
         results.append(("ultra-long ARIMA fit_long (obs/sec)", n, n_obs,
                         n * n_obs / dt_seg, (n * n_obs / dt_direct, 1)))
-        print(json.dumps({
+        emit({
             "metric": "fit_long vs direct coefficient max-abs-diff "
                       f"({n}x{n_obs}, asserted < 0.05)",
             "value": round(agree, 4), "unit": "coefficient delta",
-            "platform": platform}))
+            "platform": platform})
     else:
-        print(json.dumps({
+        emit({
             "metric": "ultra-long ARIMA fit_long", "value": None,
             "unit": "obs/sec",
-            "note": f"skipped: BENCH_ULTRA_OBS={n_obs} too short to segment"}))
+            "note": f"skipped: BENCH_ULTRA_OBS={n_obs} too short to segment"})
 
     # 9. panel-scale CSV persistence round trip (the reference's
     # saveAsCsv/timeSeriesRDDFromCsv contract at 100k series): vectorized
@@ -466,11 +490,11 @@ def main():
     if not np.array_equal(np.asarray(back.values, np.float64),
                           np.asarray(csv_panel.values), equal_nan=True):
         failures.append("CSV round trip was not bit-exact")
-    print(json.dumps({
+    emit({
         "metric": f"CSV save+load round trip series/sec ({n}x{n_obs}, "
                   "bit-exact)",
         "value": round(n / dt, 1), "unit": "series/sec",
-        "platform": platform}))
+        "platform": platform})
 
     for name, n, n_obs, rate, baseline in results:
         unit = "obs/sec" if "obs/sec" in name else "series/sec"
@@ -493,7 +517,7 @@ def main():
                 "sample": sample,
                 "rate": round(base_rate, 3),
             }
-        print(json.dumps(line))
+        emit(line)
 
     if failures:
         raise AssertionError("; ".join(failures))

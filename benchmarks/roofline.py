@@ -46,14 +46,22 @@ def _timed(fn, *args, reps=5):
 
 
 def main():
+    # probe before touching the backend in-process — a wedged tunnel hangs
+    # backend init (shared contract, bench._resolve_platform)
+    from bench import _resolve_platform
+    platform, degraded = _resolve_platform()
+
     import jax
+
+    if platform == "cpu":
+        os.environ.setdefault("ROOF_N_SERIES", "16384")
+
     import jax.numpy as jnp
 
     from bench import _synthetic_arima_panel
     from spark_timeseries_tpu.models import arima
     from spark_timeseries_tpu.models.arima import _one_step_errors
 
-    platform = jax.devices()[0].platform
     n = int(os.environ.get("ROOF_N_SERIES", "131072"))
     n_obs = int(os.environ.get("ROOF_N_OBS", "128"))
     dtype = jnp.float32
@@ -62,6 +70,9 @@ def main():
     def emit(metric, seconds, **kw):
         line = {"metric": metric, "value": round(seconds * 1e3, 2),
                 "unit": "ms", "platform": platform}
+        if degraded:
+            from bench import DEGRADED_NOTE
+            line["degraded"] = DEGRADED_NOTE
         line.update(kw)
         print(json.dumps(line), flush=True)
 

@@ -29,6 +29,15 @@ def normal_quantile(conf, dtype) -> jnp.ndarray:
         * erfinv(jnp.asarray(conf, dtype))
 
 
+def on_accelerator() -> bool:
+    """True when the default backend is an accelerator (not CPU).  The one
+    backend gate for passes that only win where scans are memory-bound —
+    evaluated lazily so importing the package never initializes a
+    backend."""
+    import jax
+    return jax.default_backend() != "cpu"
+
+
 def scan_unroll() -> int:
     """Unroll factor for the model tier's time-axis ``lax.scan``s.
 
@@ -44,8 +53,6 @@ def scan_unroll() -> int:
     initialize a JAX backend.  ``STS_SCAN_UNROLL`` overrides the default
     (tuning knob; re-jit after changing it — traces cache the value)."""
     import os
-
-    import jax
     env = os.environ.get("STS_SCAN_UNROLL")
     if env:
         try:
@@ -58,7 +65,7 @@ def scan_unroll() -> int:
             raise ValueError(
                 f"STS_SCAN_UNROLL must be >= 1, got {env!r}")
         return val
-    return 8 if jax.default_backend() != "cpu" else 1
+    return 8 if on_accelerator() else 1
 
 
 class FitDiagnostics(NamedTuple):
