@@ -1,0 +1,76 @@
+"""The benchmark entry points' evidence contract (round-2 verdict #1).
+
+A wedged TPU tunnel voided round 2's entire perf record: ``bench.py``
+printed nothing until the full run finished and died at backend init.
+These tests pin the repaired contract end-to-end in a real subprocess
+with the probe forced to fail fast: rc must be 0, every line must be
+parseable JSON, the fallback must be labeled degraded, and the headline
+(last line) must carry a real measured value.
+
+``benchmarks/bench_suite.py`` shares the same ``bench._resolve_platform``
+probe and per-line stamping but is excluded here on runtime grounds: its
+config sizes are fixed at bench scale (a degraded CPU run takes ~15 min
+even with the long-series knobs floored), so its contract is covered by
+the shared helper being under test plus the manual smoke recorded in
+``benchmarks/CAPTURE.md``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_degraded(script, env_extra, timeout):
+    env = dict(os.environ)
+    env.update({
+        # force the probe to fail instantly: the fallback path itself is
+        # the thing under test (works whether or not a TPU is reachable)
+        "BENCH_PROBE_TRIES": "1",
+        "BENCH_PROBE_TIMEOUT": "0.01",
+        "BENCH_PROBE_BACKOFF": "0",
+    })
+    env.update(env_extra)
+    out = subprocess.run([sys.executable, "-u", script],
+                         capture_output=True, text=True, cwd=REPO,
+                         timeout=timeout, env=env)
+    return out
+
+
+@pytest.mark.timeout(900)
+def test_bench_degrades_to_labeled_cpu_record():
+    out = _run_degraded(
+        os.path.join(REPO, "bench.py"),
+        {"BENCH_N_SERIES": "256", "BENCH_N_OBS": "48", "BENCH_REFIT": "0"},
+        timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [json.loads(ln) for ln in out.stdout.splitlines()
+             if ln.startswith("{")]
+    assert lines, "no JSON evidence emitted"
+    headline = lines[-1]
+    assert headline["platform"] == "cpu"
+    assert "degraded" in headline, "fallback run must be labeled"
+    assert headline["value"] and headline["value"] > 0
+    assert headline["unit"] == "series/sec"
+    # every streamed line — not just the headline — is labeled, so a
+    # partial record surviving a mid-curve crash can't read as a
+    # deliberate CPU capture
+    assert all(d.get("platform") == "cpu" and d.get("degraded")
+               for d in lines)
+
+
+@pytest.mark.timeout(900)
+def test_roofline_degrades_to_labeled_cpu_record():
+    out = _run_degraded(
+        os.path.join(REPO, "benchmarks", "roofline.py"),
+        {"ROOF_N_SERIES": "256", "ROOF_N_OBS": "48"},
+        timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [json.loads(ln) for ln in out.stdout.splitlines()
+             if ln.startswith("{")]
+    assert lines, "no JSON evidence emitted"
+    assert all(d["platform"] == "cpu" and "degraded" in d for d in lines)
