@@ -90,7 +90,15 @@ def _resolve_platform():
     probe FAILED (wedged tunnel) — a deliberate CPU run is not degraded.
     Every benchmark entry point (bench.py, benchmarks/bench_suite.py,
     benchmarks/roofline.py) shares this so a wedged-TPU record can never
-    masquerade as an intentional CPU capture."""
+    masquerade as an intentional CPU capture.  ``BENCH_FORCE_CPU=1``
+    skips the probe for an *intentional* CPU capture (no degraded
+    marker) — without it a CPU baseline taken while the tunnel is down
+    would be indistinguishable from a fallback."""
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu", False
+
     platform = _probe_backend()
     degraded = platform is None
 

@@ -33,6 +33,9 @@ def _run_degraded(script, env_extra, timeout):
         "BENCH_PROBE_TRIES": "1",
         "BENCH_PROBE_TIMEOUT": "0.01",
         "BENCH_PROBE_BACKOFF": "0",
+        # an exported deliberate-CPU flag would skip the probe entirely
+        # and bypass the contract under test
+        "BENCH_FORCE_CPU": "",
     })
     env.update(env_extra)
     out = subprocess.run([sys.executable, "-u", script],
