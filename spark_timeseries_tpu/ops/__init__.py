@@ -2,6 +2,7 @@
 OLS, batched optimizers, and sequence-parallel recurrences."""
 
 from . import optimize, scan_parallel
+from .anomaly import AnomalyResult, detect_anomalies
 from .decompose import Decomposition, decompose
 from .lag import lag_matrix, lag_matrix_multi
 from .linalg import OLSResult, ols, ols_beta, r_squared, t_statistics

@@ -1,0 +1,96 @@
+"""Residual-based anomaly detection over fitted panels, batched.
+
+Beyond the reference's inventory (no anomaly surface exists anywhere in
+``/root/reference``): the capability follows ARIMA_PLUS's model-based
+recipe (PAPERS.md, "Large-scale ... In-Database Time Series Forecasting
+and Anomaly Detection") — fit any model family, score each observation
+by its one-step prediction residual against a per-series noise scale,
+and flag points outside the confidence band.
+
+Composes with every model in the package: anything exposing fitted
+one-step values works (``arima_model.forecast(ts, 1)[..., :n]``,
+``holt_winters_model.add_time_dependent_effects``, the EWMA smooth, a
+``decompose`` trend+season reconstruction, ...).  All math is
+elementwise/batched — no scans, shards over the series axis like any
+panel op.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from ..models.base import normal_quantile
+
+
+class AnomalyResult(NamedTuple):
+    """``is_anomaly``/``score`` have the input's shape; ``sigma``/
+    ``center`` drop the time axis.  ``score`` is the absolute centered
+    residual in sigma units, zeroed inside the burn-in window — so
+    ``score > threshold_z`` ⇔ flagged holds everywhere (warm-up
+    artifacts can't re-enter through a consumer re-thresholding the
+    scores)."""
+    is_anomaly: jnp.ndarray
+    score: jnp.ndarray
+    sigma: jnp.ndarray
+    center: jnp.ndarray
+    threshold_z: jnp.ndarray
+
+
+def detect_anomalies(values: jnp.ndarray, fitted: jnp.ndarray,
+                     conf: float = 0.99, robust: bool = True,
+                     burn_in: int = 0) -> AnomalyResult:
+    """Flag observations whose residual ``values - fitted`` falls outside
+    the two-sided ``conf`` band of the per-series noise distribution.
+
+    ``robust=True`` (default) estimates the noise scale by the median
+    absolute deviation (scaled by 1.4826 to be sigma-consistent under
+    Gaussian noise) so the anomalies being hunted do not inflate the
+    threshold that hunts them; ``robust=False`` uses the plain standard
+    deviation (ARIMA_PLUS-style prediction-interval semantics, matching
+    the ``forecast_interval`` sigmas elsewhere in the package).
+
+    ``burn_in`` masks the first observations from BOTH the scale estimate
+    and the flags — model warm-up positions (a seasonal model's first
+    ``period``, an ARIMA's first ``d + max(p, q)``) are fit artifacts,
+    not anomalies.
+
+    ``values``/``fitted`` are ``(..., n)``; returns :class:`AnomalyResult`.
+    """
+    # promote integer panels (counts are a common anomaly-detection
+    # input): erfinv of an int-cast conf would give threshold 0 and a
+    # float fitted view would truncate toward zero
+    dtype = jnp.result_type(jnp.asarray(values).dtype, jnp.float32)
+    values = jnp.asarray(values, dtype)
+    fitted = jnp.asarray(fitted, dtype)
+    if fitted.shape != values.shape:
+        raise ValueError(
+            f"fitted must match values' shape {values.shape}; got "
+            f"{fitted.shape} — pass the one-step fitted view, not a "
+            f"future forecast")
+    n = values.shape[-1]
+    if not 0 <= burn_in < n:
+        raise ValueError(f"burn_in must be in [0, {n}); got {burn_in}")
+
+    resid = values - fitted
+    t_ok = jnp.arange(n) >= burn_in
+    masked = jnp.where(t_ok, resid, jnp.nan)
+
+    center = jnp.nanmedian(masked, axis=-1) if robust \
+        else jnp.nanmean(masked, axis=-1)
+    dev = masked - center[..., None]
+    if robust:
+        sigma = 1.4826 * jnp.nanmedian(jnp.abs(dev), axis=-1)
+    else:
+        sigma = jnp.sqrt(jnp.nanmean(dev * dev, axis=-1))
+
+    z = normal_quantile(conf, dtype)
+    # a constant-residual series has sigma 0: nothing is anomalous by its
+    # own (degenerate) noise model, rather than everything
+    safe = jnp.where(sigma > 0, sigma, jnp.inf)
+    score = jnp.where(t_ok,
+                      jnp.abs(resid - center[..., None]) / safe[..., None],
+                      jnp.zeros((), dtype))
+    return AnomalyResult(score > z, score, sigma, center,
+                         jnp.broadcast_to(z, sigma.shape))
