@@ -81,7 +81,14 @@ def detect_anomalies(values: jnp.ndarray, fitted: jnp.ndarray,
         else jnp.nanmean(masked, axis=-1)
     dev = masked - center[..., None]
     if robust:
-        sigma = 1.4826 * jnp.nanmedian(jnp.abs(dev), axis=-1)
+        mad = 1.4826 * jnp.nanmedian(jnp.abs(dev), axis=-1)
+        # the MAD collapses to 0 whenever >= 50% of residuals tie at the
+        # median (sparse/quantized panels — e.g. mostly-zero counts),
+        # which would silently suppress every flag including gross
+        # spikes; fall back to the std estimate for exactly those lanes
+        # (a truly constant-residual lane still gets sigma 0 from it)
+        std = jnp.sqrt(jnp.nanmean(dev * dev, axis=-1))
+        sigma = jnp.where(mad > 0, mad, std)
     else:
         sigma = jnp.sqrt(jnp.nanmean(dev * dev, axis=-1))
 

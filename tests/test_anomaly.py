@@ -146,3 +146,14 @@ def test_score_is_zero_inside_burn_in():
     assert np.asarray(res.score)[:, :4].max() == 0.0
     flags = np.asarray(res.score) > np.asarray(res.threshold_z)[:, None]
     assert (flags == np.asarray(res.is_anomaly)).all()
+
+
+def test_sparse_count_panel_does_not_mask_spikes():
+    # >=50% of residuals tying at the median would zero the MAD and
+    # silently suppress every flag; the std fallback must catch the spike
+    y = np.zeros((2, 100))
+    y[:, 10:30] = np.random.default_rng(17).poisson(1.0, size=(2, 20))
+    y[:, 50] = 80.0
+    res = ops.detect_anomalies(y, np.zeros_like(y), conf=0.999)
+    assert np.asarray(res.is_anomaly)[:, 50].all()
+    assert (np.asarray(res.sigma) > 0).all()

@@ -400,3 +400,34 @@ def test_fused_normal_eqs_matches_autodiff():
                                        atol=1e-10)
             np.testing.assert_allclose(jtr, Jm @ rm, rtol=1e-9, atol=1e-10)
             np.testing.assert_allclose(sse, jnp.sum(rm * rm), rtol=1e-12)
+
+
+def test_auto_fit_panel_refinement_never_worsens_selection():
+    # two-stage auto (screen grid at SCREEN_MAX_ITER, refine each winner):
+    # the refinement must keep the screened order selection and only
+    # improve (or tie) the winner's AIC; max_iter <= screen budget must
+    # degrade gracefully to screen-only
+    mixed = np.concatenate([
+        np.array(arima.ARIMAModel(1, 0, 0, jnp.array([1.0, 0.6])).sample(
+            256, jax.random.PRNGKey(1), shape=(4,))),
+        np.array(arima.ARIMAModel(0, 1, 1, jnp.array([0.5, 0.4])).sample(
+            256, jax.random.PRNGKey(2), shape=(4,))),
+    ])
+    two = arima.auto_fit_panel(mixed, max_p=2, max_d=2, max_q=2)
+    screen = arima.auto_fit_panel(mixed, max_p=2, max_d=2, max_q=2,
+                                  max_iter=arima.SCREEN_MAX_ITER)
+    np.testing.assert_array_equal(two.orders, screen.orders)
+    assert (two.aic <= screen.aic + 1e-6).all()
+
+
+def test_auto_fit_panel_screen_budget_is_overridable():
+    # near-unit-root-ish selection can need the grid fully fitted; the
+    # escape hatch must restore a full-budget screen (and still agree
+    # with the default two-stage result on easy panels)
+    panel = np.array(arima.ARIMAModel(1, 0, 0, jnp.array([1.0, 0.6]))
+                     .sample(256, jax.random.PRNGKey(4), shape=(4,)))
+    default = arima.auto_fit_panel(panel, max_p=1, max_d=1, max_q=1)
+    full = arima.auto_fit_panel(panel, max_p=1, max_d=1, max_q=1,
+                                max_iter=60, screen_max_iter=60)
+    np.testing.assert_array_equal(default.orders, full.orders)
+    assert np.isfinite(full.aic).all()
