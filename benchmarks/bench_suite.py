@@ -287,7 +287,9 @@ def main():
         # a wedged-TPU run must never read as a deliberate CPU capture)
         if degraded:
             from bench import DEGRADED_NOTE
-            obj["degraded"] = DEGRADED_NOTE
+            # setdefault per the shared contract: a site that already set a
+            # more specific degraded message keeps its own
+            obj.setdefault("degraded", DEGRADED_NOTE)
         print(json.dumps(obj), flush=True)
 
     from bench import _synthetic_arima_panel

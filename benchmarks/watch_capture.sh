@@ -1,0 +1,32 @@
+#!/bin/bash
+# Probe the tunneled TPU in a disposable subprocess; the moment it is healthy,
+# run the full capture runbook (CAPTURE.md) streaming into $OUT so a mid-run
+# wedge cannot void lines already taken. Exits 0 after one full capture.
+#
+# Usage: benchmarks/watch_capture.sh [outdir]
+OUT=${1:-/tmp/r04}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.." || exit 1
+while true; do
+  if timeout 90 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()[0]
+assert d.platform != 'cpu', d
+x = (jnp.ones((256, 256)) @ jnp.ones((256, 256))).sum()
+assert float(x) == 256.0 * 256 * 256
+" >/dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) probe OK - capturing" >> "$OUT/log"
+    python -u bench.py                  > "$OUT/bench_tpu.jsonl"    2> "$OUT/bench_tpu.err"
+    rc=$?
+    echo "$(date -u +%FT%TZ) bench.py done rc=$rc" >> "$OUT/log"
+    python -u benchmarks/bench_suite.py > "$OUT/suite_tpu.jsonl"    2> "$OUT/suite_tpu.err"
+    rc=$?
+    echo "$(date -u +%FT%TZ) bench_suite.py done rc=$rc" >> "$OUT/log"
+    python -u benchmarks/roofline.py    > "$OUT/roofline_tpu.jsonl" 2> "$OUT/roofline_tpu.err"
+    rc=$?
+    echo "$(date -u +%FT%TZ) roofline.py done rc=$rc - capture complete" >> "$OUT/log"
+    exit 0
+  fi
+  echo "$(date -u +%FT%TZ) probe failed; retry in 240s" >> "$OUT/log"
+  sleep 240
+done

@@ -1109,7 +1109,9 @@ def _auto_fit_panel_kernel(values: jnp.ndarray, masks_base: jnp.ndarray,
     residuals: its Jacobian column is zero, so the normal-equation step for
     that slot is ``0 / 1e-12 = 0``.
 
-    Returns ``(orders (S, 3), coefs (S, k), aic (S,), d_ok (S,))``.
+    Returns ``(orders (S, 3), coefs (S, k), aic (S,), d_ok (S,),
+    screen_capped (S,))`` — the last flags winners whose screen stage hit
+    the reduced iteration cap (selection-risk telemetry).
     """
     dtype = values.dtype
     S, n = values.shape
@@ -1197,6 +1199,9 @@ def _auto_fit_panel_kernel(values: jnp.ndarray, masks_base: jnp.ndarray,
     sel = jnp.arange(S)
     chosen_aic = aic[best, sel]
     failed = ~jnp.isfinite(chosen_aic)
+    # selection-risk telemetry: winners whose screen stage hit the reduced
+    # iteration cap — their AIC ordering could differ from a full-budget grid
+    screen_capped = (~res.converged)[best, sel] & ~failed
     coefs = jnp.where(failed[:, None], 0.0, params[best, sel])
     orders = jnp.stack([jnp.where(failed, 0, pq_arr[best, 0]),
                         d_per.astype(pq_arr.dtype),
@@ -1226,7 +1231,7 @@ def _auto_fit_panel_kernel(values: jnp.ndarray, masks_base: jnp.ndarray,
         keep &= jnp.isfinite(aic_r)
         coefs = jnp.where(keep[:, None], refined, coefs)
         chosen_aic = jnp.where(keep, aic_r, chosen_aic)
-    return orders, coefs, chosen_aic, d_ok
+    return orders, coefs, chosen_aic, d_ok, screen_capped
 
 
 def auto_fit_panel(values: jnp.ndarray, max_p: int = 5, max_d: int = 2,
@@ -1274,9 +1279,21 @@ def auto_fit_panel(values: jnp.ndarray, max_p: int = 5, max_d: int = 2,
     crit = KPSS_CONSTANT_CRITICAL_VALUES[KPSS_SIGNIFICANCE]
     kernel = jax.jit(_auto_fit_panel_kernel,
                      static_argnums=(4, 5, 6, 7, 8))
-    orders, coefs, aic, d_ok = kernel(
+    orders, coefs, aic, d_ok, screen_capped = kernel(
         values, jnp.asarray(masks), jnp.asarray(pq, dtype=np.int32),
         float(crit), max_p, max_q, max_d, max_iter, screen_iter)
+
+    # advisor r3: the reduced screen budget can change order selection on
+    # slow-converging panels; surface it when it plausibly did
+    if screen_iter < max_iter:
+        capped_frac = float(np.mean(np.asarray(screen_capped)))
+        if capped_frac > 0.5:
+            warnings.warn(
+                f"auto_fit_panel: {capped_frac:.0%} of winning lanes hit the "
+                f"screen-stage iteration cap ({screen_iter}); order selection "
+                f"may differ from a full-budget grid — pass "
+                f"screen_max_iter=max_iter to restore one",
+                stacklevel=2)
 
     d_ok = np.asarray(d_ok)
     if not d_ok.all():

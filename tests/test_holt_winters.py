@@ -100,7 +100,10 @@ def test_forecast_interval_additive_formula():
     fitted = np.asarray(m.add_time_dependent_effects(y))
     err = np.asarray(y)[period:] - fitted[period:]
     sigma2 = np.mean(err * err)
-    cj = np.array([a * (1 + j * b) + (g if j % period == 0 else 0.0)
+    # seasonal coefficient is γ(1-α): the R-style recurrence updates the
+    # season ring by γ(1-α)e per one-step error (ETS map γ_ets = γ(1-α))
+    cj = np.array([a * (1 + j * b) + (g * (1 - a) if j % period == 0
+                                      else 0.0)
                    for j in range(1, h)])
     var = sigma2 * np.r_[1.0, 1.0 + np.cumsum(cj * cj)]
     half = 1.959964 * np.sqrt(var)
@@ -109,10 +112,56 @@ def test_forecast_interval_additive_formula():
     w = np.asarray(hi - lo)
     assert (np.diff(w) > 0).all()
 
-    with pytest.raises(NotImplementedError):
-        hw.HoltWintersModel(
-            "multiplicative", period, jnp.asarray(a), jnp.asarray(b),
-            jnp.asarray(g)).forecast_interval(y, 3)
+
+def _simulate_forward(model_type, a, b_r, g, l0, b0, seas0, sigma, h,
+                      n_paths, seed=0):
+    """Monte-Carlo the components recurrence forward from given states with
+    Gaussian one-step noise; returns per-horizon variance of the paths."""
+    rng = np.random.default_rng(seed)
+    level = np.full(n_paths, l0)
+    trend = np.full(n_paths, b0)
+    ring = np.tile(seas0, (n_paths, 1)).astype(float)
+    out = np.empty((n_paths, h))
+    for i in range(h):
+        s = ring[:, 0]
+        base = level + trend
+        yhat = base + s if model_type == "additive" else base * s
+        y = yhat + rng.normal(scale=sigma, size=n_paths)
+        out[:, i] = y
+        lw = (y - s) if model_type == "additive" else (y / s)
+        nl = a * lw + (1 - a) * base
+        nt = b_r * (nl - level) + (1 - b_r) * trend
+        sw = (y - nl) if model_type == "additive" else (y / nl)
+        ring = np.concatenate([ring[:, 1:], (g * sw + (1 - g) * s)[:, None]],
+                              axis=1)
+        level, trend = nl, nt
+    return out.var(axis=0)
+
+
+@pytest.mark.parametrize("model_type", ["additive", "multiplicative"])
+def test_forecast_interval_matches_simulation(model_type):
+    """Band variance matches a seeded Monte-Carlo of the recurrence itself
+    (the ground truth the linearization approximates) at every horizon."""
+    a, b_r, g, period, h = 0.4, 0.2, 0.3, 4, 12
+    m = hw.HoltWintersModel(model_type, period, jnp.asarray(a),
+                            jnp.asarray(b_r), jnp.asarray(g))
+    t = np.arange(48, dtype=np.float64)
+    if model_type == "additive":
+        y = 50 + 0.5 * t + 3 * np.sin(2 * np.pi * t / period)
+    else:
+        y = (50 + 0.5 * t) * (1 + 0.06 * np.sin(2 * np.pi * t / period))
+    y = jnp.asarray(y + np.random.default_rng(3).normal(scale=1.0, size=48))
+
+    point, lo, hi = m.forecast_interval(y, h)
+    var_formula = (np.asarray(hi - lo) / (2 * 1.959964)) ** 2
+
+    fitted, level, trend, seasons = m.get_holt_winters_components(y)
+    err = np.asarray(y)[period:] - np.asarray(fitted)[period:]
+    sigma = float(np.sqrt(np.mean(err * err)))
+    var_sim = _simulate_forward(
+        model_type, a, b_r, g, float(level), float(trend),
+        np.asarray(seasons), sigma, h, n_paths=200_000)
+    np.testing.assert_allclose(var_formula, var_sim, rtol=0.03)
 
 
 def test_forecast_interval_batched_lanes():
@@ -176,6 +225,35 @@ def test_fused_value_and_grad_matches_autodiff():
             f, g = hw._hw_sse_value_and_grad(prm, s, 12, mt)
             np.testing.assert_allclose(f, f_ref, rtol=1e-12)
             np.testing.assert_allclose(g, g_ref, rtol=1e-9, atol=1e-9)
+
+
+def test_fit_fused_path_forced_on_cpu(monkeypatch):
+    # STS_HW_FUSED=1 drives fit() end-to-end through the fused
+    # value-and-grad pass on the CPU backend (advisor r3: the accelerator
+    # gate otherwise leaves that path unit-tested only); results must agree
+    # with the default reverse-mode path at optimizer tolerance
+    rng = np.random.default_rng(5)
+    t = np.arange(72.)
+    for mt, y in (
+        ("additive", 50 + 0.3 * t + 4 * np.sin(2 * np.pi * t / 6)
+         + rng.normal(scale=0.5, size=72)),
+        ("multiplicative", (50 + 0.3 * t)
+         * (1 + 0.08 * np.sin(2 * np.pi * t / 6))
+         + rng.normal(scale=0.3, size=72)),
+    ):
+        y = jnp.asarray(y)
+        base = hw.fit(y, 6, mt, max_iter=300)
+        monkeypatch.setenv("STS_HW_FUSED", "1")
+        fused = hw.fit(y, 6, mt, max_iter=300)
+        monkeypatch.delenv("STS_HW_FUSED")
+        for attr in ("alpha", "beta", "gamma"):
+            np.testing.assert_allclose(
+                np.asarray(getattr(fused, attr)),
+                np.asarray(getattr(base, attr)), atol=2e-5)
+
+    monkeypatch.setenv("STS_HW_FUSED", "yes")
+    with pytest.raises(ValueError, match="STS_HW_FUSED"):
+        hw.fit(y, 6, "additive")
 
 
 def test_out_of_box_init_projects_before_first_evaluation():
