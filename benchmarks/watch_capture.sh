@@ -5,7 +5,8 @@
 #
 # Usage: benchmarks/watch_capture.sh [outdir]
 OUT=${1:-/tmp/r04}
-mkdir -p "$OUT"
+mkdir -p "$OUT" || exit 1
+OUT=$(cd "$OUT" && pwd) || exit 1    # absolute, survives the cd below
 cd "$(dirname "$0")/.." || exit 1
 while true; do
   if timeout 90 python -c "

@@ -36,6 +36,8 @@ from jax import lax
 
 from ..ops.lag import lag_matvec, lag_stack
 from ..ops.linalg import ols_gram, spd_solve
+from ..ops.ragged import (apply_short_quarantine, ragged_view, short_lanes,
+                          step_weights)
 from ..ops.optimize import (minimize_bfgs, minimize_box,
                             minimize_least_squares)
 from ..ops.univariate import (differences_of_order_d,
@@ -112,7 +114,8 @@ def _one_step_errors(params: jnp.ndarray, y: jnp.ndarray,
 
 def _arma_normal_eqs(params: jnp.ndarray, y: jnp.ndarray,
                      p: int, q: int, icpt: int,
-                     mask: Optional[jnp.ndarray] = None):
+                     mask: Optional[jnp.ndarray] = None,
+                     n_valid: Optional[jnp.ndarray] = None):
     """Hand-fused Gauss-Newton normal equations for the CSS residuals:
     one scan computes ``(JᵀJ, Jᵀr, sse)`` with the accumulators in the
     carry, never materializing the ``(k, m)`` Jacobian.
@@ -132,6 +135,12 @@ def _arma_normal_eqs(params: jnp.ndarray, y: jnp.ndarray,
     ``mask`` (k,) reproduces the masked-residual objective
     ``r(x ∘ mask)``: the recurrence runs at the masked point and the
     chain-rule factor lands as an outer-product scale at the end.
+
+    ``n_valid`` (scalar) restricts the lane to its left-aligned valid
+    window (``ops.ragged``): steps at absolute index ≥ ``n_valid`` get
+    weight 0 on the residual and its tangent, so the accumulators — and
+    the weighted values the rings carry — equal those of the trimmed
+    series exactly (the zero-padded tail never contributes).
     """
     dtype = y.dtype
     k = icpt + p + q
@@ -151,7 +160,10 @@ def _arma_normal_eqs(params: jnp.ndarray, y: jnp.ndarray,
 
     def step(carry, inp):
         e_ring, y_ring, T_ring, jtj, jtr, sse = carry
-        b_t, yy = inp
+        if n_valid is None:
+            b_t, yy = inp
+        else:
+            b_t, yy, w = inp
         e = yy - b_t - (theta @ e_ring if q else jnp.zeros((), dtype))
         u_parts = []
         if icpt:
@@ -159,6 +171,9 @@ def _arma_normal_eqs(params: jnp.ndarray, y: jnp.ndarray,
         u_parts += [y_ring, e_ring]
         u = jnp.concatenate(u_parts)
         T = -u - (theta @ T_ring if q else jnp.zeros((k,), dtype))
+        if n_valid is not None:
+            e = w * e
+            T = w * T
         jtj = jtj + jnp.outer(T, T)
         jtr = jtr + T * e
         sse = sse + e * e
@@ -169,10 +184,16 @@ def _arma_normal_eqs(params: jnp.ndarray, y: jnp.ndarray,
             y_ring = jnp.concatenate([yy[None], y_ring[:-1]])
         return (e_ring, y_ring, T_ring, jtj, jtr, sse), None
 
+    if n_valid is None:
+        xs = (base, y_t)
+    else:
+        ws = step_weights(y_t.shape[-1], n_valid, offset=max_lag,
+                          dtype=dtype)
+        xs = (base, y_t, ws)
     carry0 = (jnp.zeros((q,), dtype), y_ring0,
               jnp.zeros((q, k), dtype), jnp.zeros((k, k), dtype),
               jnp.zeros((k,), dtype), jnp.zeros((), dtype))
-    (_, _, _, jtj, jtr, sse), _ = lax.scan(step, carry0, (base, y_t),
+    (_, _, _, jtj, jtr, sse), _ = lax.scan(step, carry0, xs,
                                            unroll=scan_unroll())
     if mask is not None:
         jtj = jtj * jnp.outer(mask, mask)
@@ -181,7 +202,9 @@ def _arma_normal_eqs(params: jnp.ndarray, y: jnp.ndarray,
 
 
 def _log_likelihood_css_arma(params: jnp.ndarray, diffed: jnp.ndarray,
-                             p: int, q: int, icpt: int) -> jnp.ndarray:
+                             p: int, q: int, icpt: int,
+                             n_valid: Optional[jnp.ndarray] = None
+                             ) -> jnp.ndarray:
     """CSS log likelihood of an ARMA(p, q) on an already-differenced series
     (ref ``ARIMA.scala:430-445``): residuals for t < max(p, q) are dropped,
     ``sigma² = css / n``.
@@ -191,12 +214,23 @@ def _log_likelihood_css_arma(params: jnp.ndarray, diffed: jnp.ndarray,
     ``-n / 2`` is Scala *integer* division (``ARIMA.scala:444``), so for
     odd-length series its likelihood (and ``approxAIC``) is off by
     ``0.5·log(2π·sigma²)``; model-selection thresholds tuned against
-    reference AIC values can differ by that amount."""
-    n = diffed.shape[-1]
+    reference AIC values can differ by that amount.
+
+    ``n_valid`` (scalar): valid-window length of a left-aligned ragged
+    lane (``ops.ragged``) — residuals past it get weight 0 and the
+    divisor becomes ``n_valid``, matching the trimmed series."""
     _, err = _one_step_errors(params, diffed, p, q, icpt)
-    css = jnp.sum(err * err)
-    sigma2 = css / n
-    return (-n / 2.0) * jnp.log(2.0 * jnp.pi * sigma2) - css / (2.0 * sigma2)
+    if n_valid is None:
+        n_eff = diffed.shape[-1]
+        css = jnp.sum(err * err)
+    else:
+        w = step_weights(err.shape[-1], n_valid, offset=max(p, q),
+                         dtype=diffed.dtype)
+        n_eff = jnp.asarray(n_valid, diffed.dtype)
+        css = jnp.sum(w * err * err)
+    sigma2 = css / n_eff
+    return (-n_eff / 2.0) * jnp.log(2.0 * jnp.pi * sigma2) \
+        - css / (2.0 * sigma2)
 
 
 def _remove_effects_one(params: jnp.ndarray, ts: jnp.ndarray,
@@ -683,16 +717,22 @@ class ARIMAModel(NamedTuple):
 # ---------------------------------------------------------------------------
 
 def hannan_rissanen_init(p: int, q: int, y: jnp.ndarray,
-                         include_intercept: bool) -> jnp.ndarray:
+                         include_intercept: bool,
+                         n_valid: Optional[jnp.ndarray] = None
+                         ) -> jnp.ndarray:
     """Hannan-Rissanen initial ARMA estimates (ref ``ARIMA.scala:216-242``):
     fit AR(m) with ``m = max(p, q) + 1``, estimate errors, then OLS of the
     series on [AR lag terms ‖ MA error-lag terms].  Fully batched: ``y`` may
-    be ``(..., n)``."""
+    be ``(..., n)``.
+
+    ``n_valid (...,)`` restricts each lane to its left-aligned valid window
+    (``ops.ragged``): both OLS stages weight out rows whose target index
+    falls past it, matching the init of the trimmed series."""
     y = jnp.asarray(y)
     m = max(p, q) + 1
     mx = max(p, q)
 
-    ar = autoregression.fit(y, m)
+    ar = autoregression.fit(y, m, n_valid=n_valid)
     est = lag_matvec(y, jnp.atleast_1d(ar.coefficients), m) \
         + jnp.asarray(ar.c)[..., None]
     y_trunc = y[..., m:]
@@ -703,7 +743,12 @@ def hannan_rissanen_init(p: int, q: int, y: jnp.ndarray,
                           _lag_stack_or_empty(errors, q)[..., -n_rows:]],
                          axis=-2)
     target = y_trunc[..., mx:]
-    res = ols_gram(Xs, target, add_intercept=include_intercept)
+    w = None
+    if n_valid is not None:
+        w = step_weights(n_rows, jnp.asarray(n_valid)[..., None],
+                         offset=m + mx, dtype=y.dtype)
+    res = ols_gram(Xs, target, add_intercept=include_intercept,
+                   row_weights=w)
     return res.beta
 
 
@@ -744,21 +789,43 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
     a solver knob: check ``is_stationary()``/``is_invertible()``, and
     prefer ``models.refit_unconverged`` or a lower-order ``auto_fit``
     for such lanes.
+
+    NaN-padded panels (leading/trailing padding per lane, the
+    ``from_observations`` + ``union`` ingestion shape) fit directly: each
+    lane's contiguous valid window is detected, left-aligned, and the CSS
+    objective weighted to it — per-lane results equal independent fits of
+    the trimmed series (``ops.ragged``; pinned by ``tests/test_ragged.py``).
+    Lanes too short for the order get NaN coefficients and
+    ``diagnostics.converged == False``.  Interior gaps still raise —
+    impute those with ``fill`` first.
     """
     ts = jnp.asarray(ts)
+    ts, obs_len = ragged_view(ts)
     icpt = 1 if include_intercept else 0
     diffed = differences_of_order_d(ts, d)[..., d:]
+    nv = None if obs_len is None else jnp.maximum(obs_len - d, 0)
+
+    def _short_lanes(min_n):
+        """Lanes whose valid window can't support the order (ragged only);
+        min_n counts post-differencing observations."""
+        if nv is None:
+            return None
+        return short_lanes(nv, min_n,
+                           f"ARIMA({p},{d},{q}) fit (post-differencing)")
 
     if p > 0 and q == 0 and user_init_params is None:
         # AR fast path (ref ARIMA.scala:90-96); OLS is direct, so the
         # diagnostics mark every finite lane converged in 0 iterations
-        ar = autoregression.fit(diffed, p, no_intercept=not include_intercept)
+        short = _short_lanes(2 * p + icpt + 1)
+        ar = autoregression.fit(diffed, p, no_intercept=not include_intercept,
+                                n_valid=nv)
         parts = ([jnp.asarray(ar.c)[..., None]] if include_intercept else []) \
             + [jnp.atleast_1d(ar.coefficients)]
         coefs = jnp.concatenate(parts, axis=-1)
         lane_ok = jnp.all(jnp.isfinite(coefs), axis=-1)
+        fun = -_ll_batched(coefs, diffed, nv, p, q, icpt)
+        coefs, lane_ok = apply_short_quarantine(coefs, lane_ok, short)
         model = ARIMAModel(p, d, q, coefs, include_intercept)
-        fun = -model.log_likelihood_css_arma(diffed)
         model = model._replace(diagnostics=FitDiagnostics(
             lane_ok, jnp.zeros(lane_ok.shape, jnp.int32), fun))
         _warn_stationarity_invertibility(model, warn)
@@ -768,7 +835,8 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
     if dim == 0:
         model = ARIMAModel(p, d, q, jnp.zeros((*ts.shape[:-1], 0), ts.dtype),
                            include_intercept)
-        fun = -model.log_likelihood_css_arma(diffed)
+        fun = -_ll_batched(jnp.asarray(model.coefficients), diffed, nv,
+                           p, q, icpt)
         return model._replace(diagnostics=FitDiagnostics(
             jnp.isfinite(fun), jnp.zeros(fun.shape, jnp.int32), fun))
 
@@ -788,25 +856,36 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
                 f"Hannan-Rissanen initialization needs >= {min_n} "
                 f"observations after order-{d} differencing, got "
                 f"{diffed.shape[-1]}; pass user_init_params to skip it")
-        init = hannan_rissanen_init(p, q, diffed, include_intercept)
+        short = _short_lanes(min_n)
+        init = hannan_rissanen_init(p, q, diffed, include_intercept,
+                                    n_valid=nv)
+        if short is not None:
+            # a too-short lane's HR gram may be singular-but-finite; pin
+            # its init to a neutral zero vector so LM stays finite there
+            init = jnp.where(short[..., None] if init.ndim > short.ndim
+                             else short, jnp.zeros((), init.dtype), init)
     else:
+        short = _short_lanes(max_lag + 1)
         init = jnp.broadcast_to(jnp.asarray(user_init_params, ts.dtype),
                                 (*ts.shape[:-1], dim))
 
-    def neg_ll(prm, y):
-        return -_log_likelihood_css_arma(prm, y, p, q, icpt)
+    extra = () if nv is None else (nv,)
+
+    def neg_ll(prm, y, *v):
+        return -_log_likelihood_css_arma(prm, y, p, q, icpt,
+                                         n_valid=v[0] if v else None)
 
     if method == "css-lm":
         res = minimize_least_squares(
-            None, init, diffed,
+            None, init, diffed, *extra,
             max_iter=max_iter if max_iter is not None else LM_MAX_ITER,
-            normal_eqs_fn=lambda prm, y: _arma_normal_eqs(
-                prm, y, p, q, icpt))
+            normal_eqs_fn=lambda prm, y, *v: _arma_normal_eqs(
+                prm, y, p, q, icpt, n_valid=v[0] if v else None))
     elif method == "css-cgd":
-        res = minimize_bfgs(neg_ll, init, diffed, tol=1e-7,
+        res = minimize_bfgs(neg_ll, init, diffed, *extra, tol=1e-7,
                             max_iter=max_iter if max_iter is not None else 500)
     elif method == "css-bobyqa":
-        res = minimize_box(neg_ll, init, -jnp.inf, jnp.inf, diffed,
+        res = minimize_box(neg_ll, init, -jnp.inf, jnp.inf, diffed, *extra,
                            tol=1e-10, max_iter=max_iter if max_iter is not None else 500)
     else:
         raise ValueError(f"unknown method {method!r}")
@@ -816,10 +895,28 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
     # partially-NaN result never yields a mixed coefficient vector
     lane_ok = jnp.all(jnp.isfinite(res.x), axis=-1, keepdims=True)
     params = jnp.where(lane_ok, res.x, init)
+    conv = diagnostics_from(res, lane_ok)
+    params, conv_mask = apply_short_quarantine(params, conv.converged, short)
     model = ARIMAModel(p, d, q, params, include_intercept,
-                       diagnostics=diagnostics_from(res, lane_ok))
+                       diagnostics=conv._replace(converged=conv_mask))
     _warn_stationarity_invertibility(model, warn)
     return model
+
+
+def _ll_batched(coefs: jnp.ndarray, diffed: jnp.ndarray,
+                nv: Optional[jnp.ndarray], p: int, q: int,
+                icpt: int) -> jnp.ndarray:
+    """CSS log likelihood batched over lanes, valid-window aware."""
+    if nv is None:
+        return _batched(
+            lambda prm, y: _log_likelihood_css_arma(prm, y, p, q, icpt),
+            coefs, diffed)
+    fn = lambda prm, y, v: _log_likelihood_css_arma(prm, y, p, q, icpt,
+                                                    n_valid=v)
+    if diffed.ndim > 1:
+        return jax.vmap(fn)(jnp.broadcast_to(
+            coefs, (*diffed.shape[:-1], coefs.shape[-1])), diffed, nv)
+    return fn(coefs, diffed, nv)
 
 
 def _warn_stationarity_invertibility(model: ARIMAModel, warn: bool) -> None:

@@ -72,14 +72,25 @@ class ARModel(NamedTuple):
         return self.add_time_dependent_effects(noise)
 
 
-def fit(ts: jnp.ndarray, max_lag: int = 1, no_intercept: bool = False) -> ARModel:
+def fit(ts: jnp.ndarray, max_lag: int = 1, no_intercept: bool = False,
+        n_valid: jnp.ndarray | None = None) -> ARModel:
     """Fit AR(max_lag) by OLS on the lag matrix
     (ref ``Autoregression.scala:38-53``).  ``ts (..., n)``; all leading
-    dims are batched through one QR solve."""
+    dims are batched through one QR solve.
+
+    ``n_valid (...,)`` restricts each lane to its left-aligned valid window
+    (see :func:`~spark_timeseries_tpu.ops.ragged.ragged_view`): OLS rows
+    whose target index falls at or past ``n_valid`` get weight 0, which is
+    exactly the OLS of the trimmed series."""
     ts = jnp.asarray(ts)
     y = ts[..., max_lag:]
     X = lag_stack(ts, max_lag)
-    res = ols_gram(X, y, add_intercept=not no_intercept)
+    w = None
+    if n_valid is not None:
+        from ..ops.ragged import step_weights
+        w = step_weights(y.shape[-1], jnp.asarray(n_valid)[..., None],
+                         offset=max_lag, dtype=ts.dtype)
+    res = ols_gram(X, y, add_intercept=not no_intercept, row_weights=w)
     if no_intercept:
         c = jnp.zeros(ts.shape[:-1], ts.dtype)
         return ARModel(c, res.beta)

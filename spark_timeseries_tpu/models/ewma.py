@@ -24,6 +24,8 @@ from jax import lax
 
 from ..ops.optimize import (minimize_bfgs, minimize_box,
                             minimize_least_squares)
+from ..ops.ragged import (apply_short_quarantine, ragged_view, short_lanes,
+                          step_weights)
 from .base import (FitDiagnostics, diagnostics_from, normal_quantile,
                    scan_unroll)
 
@@ -107,29 +109,54 @@ class EWMAModel(NamedTuple):
         return point, point - half, point + half
 
 
-def _ewma_normal_eqs(params: jnp.ndarray, series: jnp.ndarray):
+def _ewma_normal_eqs(params: jnp.ndarray, series: jnp.ndarray,
+                     n_valid=None):
     """Fused-carry Gauss-Newton pass for the one-step SSE residuals (same
     trick as ``arima._arma_normal_eqs``, docs/design.md §9): with
     ``s_t = a x_t + (1-a) s_{t-1}`` and ``e_t = x_{t+1} - s_t``, the
     tangent obeys ``ds_t = x_t - s_{t-1} + (1-a) ds_{t-1}``, so JᵀJ, Jᵀr,
     and sse accumulate in the scan carry and no ``(1, m)`` Jacobian is
     materialized.  The ``t = 0`` residual ``x_1 - s_0 = x_1 - x_0`` has
-    zero tangent (``s_0 = x_0`` is data)."""
+    zero tangent (``s_0 = x_0`` is data).
+
+    ``n_valid`` (scalar): valid-window length of a left-aligned ragged
+    lane (``ops.ragged``) — residuals whose target index falls past it
+    get weight 0, matching the trimmed series exactly."""
     a = params[0]
 
-    def step(carry, inp):
-        s, ds, jtj, jtr, sse = carry
-        x_t, x_next = inp
-        ds = x_t - s + (1.0 - a) * ds
-        s = a * x_t + (1.0 - a) * s
-        e = x_next - s
-        return (s, ds, jtj + ds * ds, jtr - ds * e, sse + e * e), None
+    if n_valid is None:
+        def step(carry, inp):
+            s, ds, jtj, jtr, sse = carry
+            x_t, x_next = inp
+            ds = x_t - s + (1.0 - a) * ds
+            s = a * x_t + (1.0 - a) * s
+            e = x_next - s
+            return (s, ds, jtj + ds * ds, jtr - ds * e, sse + e * e), None
+
+        xs = (series[1:-1], series[2:])
+    else:
+        def step(carry, inp):
+            s, ds, jtj, jtr, sse = carry
+            x_t, x_next, w = inp
+            ds = x_t - s + (1.0 - a) * ds
+            s = a * x_t + (1.0 - a) * s
+            e = w * (x_next - s)
+            dsw = w * ds
+            return (s, ds, jtj + dsw * dsw, jtr - dsw * e,
+                    sse + e * e), None
+
+        # residual e_t targets x_{t+1} at absolute index i+2 for step i
+        ws = step_weights(series.shape[-1] - 2, n_valid, offset=2,
+                          dtype=series.dtype)
+        xs = (series[1:-1], series[2:], ws)
 
     zero = jnp.zeros((), series.dtype)
     (_, _, jtj, jtr, sse), _ = lax.scan(
-        step, (series[0], zero, zero, zero, zero),
-        (series[1:-1], series[2:]), unroll=scan_unroll())
+        step, (series[0], zero, zero, zero, zero), xs,
+        unroll=scan_unroll())
     e0 = series[1] - series[0]
+    if n_valid is not None:
+        e0 = jnp.where(n_valid >= 2, e0, jnp.zeros((), series.dtype))
     return (jtj.reshape(1, 1), jtr.reshape(1), sse + e0 * e0)
 
 
@@ -150,18 +177,34 @@ def fit(ts: jnp.ndarray, init: float = 0.94, tol: float = 1e-9,
     ``smoothing`` is correspondingly scalar or ``(n_series,)``.  ``init``
     may be a per-lane ``(n_series,)`` array (e.g. a ``refit_unconverged``
     warm start from a previous fit's ``smoothing``).
+
+    NaN-padded panels (leading/trailing padding per lane) fit directly:
+    valid windows are left-aligned and the SSE weighted to them, matching
+    independent fits of the trimmed series (``ops.ragged``).  Lanes with
+    fewer than 3 valid observations get NaN smoothing and
+    ``diagnostics.converged == False``; interior gaps raise.
     """
     ts = jnp.asarray(ts)
+    ts, obs_len = ragged_view(ts)
+    extra = () if obs_len is None else (obs_len,)
 
-    def objective(params, series):
-        return EWMAModel(params[0]).sse(series)
+    def objective(params, series, *v):
+        model = EWMAModel(params[0])
+        if not v:
+            return model.sse(series)
+        # weighted SSE: residual e_t targets index t+1; live iff < n_valid
+        smoothed = model.add_time_dependent_effects(series)
+        err = series[1:] - smoothed[:-1]
+        w = step_weights(err.shape[-1], v[0], offset=1, dtype=series.dtype)
+        return jnp.sum(w * err * err)
 
     x0 = jnp.broadcast_to(jnp.asarray(init, ts.dtype)[..., None],
                           (*ts.shape[:-1], 1))
     if method == "lm":
-        res = minimize_least_squares(None, x0, ts, tol=tol,
-                                     max_iter=max_iter,
-                                     normal_eqs_fn=_ewma_normal_eqs)
+        res = minimize_least_squares(
+            None, x0, ts, *extra, tol=tol, max_iter=max_iter,
+            normal_eqs_fn=lambda prm, y, *v: _ewma_normal_eqs(
+                prm, y, n_valid=v[0] if v else None))
         # LM is unconstrained but the model domain is (0, 1]: a lane that
         # converges outside it (possible on near-random-walk data, where
         # the SSE is flat past a=1) would silently yield an oscillating,
@@ -173,18 +216,24 @@ def fit(ts: jnp.ndarray, init: float = 0.94, tol: float = 1e-9,
         res = res._replace(x=jnp.clip(res.x, SMOOTHING_FLOOR, 1.0),
                            converged=res.converged & in_domain)
     elif method == "box":
-        res = minimize_box(objective, x0, 1e-4, 1.0, ts,
+        res = minimize_box(objective, x0, 1e-4, 1.0, ts, *extra,
                            tol=tol, max_iter=max_iter)
     elif method == "bfgs":
-        res = minimize_bfgs(objective, x0, ts, tol=tol, max_iter=max_iter)
+        res = minimize_bfgs(objective, x0, ts, *extra, tol=tol,
+                            max_iter=max_iter)
     else:
         raise ValueError(f"unknown method {method!r}")
     # per-lane quarantine: a diverged lane falls back to the initial guess
     # instead of emitting NaN smoothing (same policy as the ARIMA/GARCH fits)
     lane_ok = jnp.all(jnp.isfinite(res.x), axis=-1, keepdims=True)
     params = jnp.where(lane_ok, res.x, x0)
-    return EWMAModel(params[..., 0],
-                     diagnostics=diagnostics_from(res, lane_ok))
+    conv = diagnostics_from(res, lane_ok)
+    if obs_len is not None:
+        short = short_lanes(obs_len, 3, "EWMA one-step SSE")
+        params, conv_mask = apply_short_quarantine(params, conv.converged,
+                                                   short)
+        conv = conv._replace(converged=conv_mask)
+    return EWMAModel(params[..., 0], diagnostics=conv)
 
 
 def fit_panel(panel) -> EWMAModel:

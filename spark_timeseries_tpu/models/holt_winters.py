@@ -24,6 +24,8 @@ from jax import lax
 
 from ..ops.lag import lag_matrix
 from ..ops.optimize import minimize_box
+from ..ops.ragged import (apply_short_quarantine, ragged_view, short_lanes,
+                          step_weights)
 from .base import (FitDiagnostics, diagnostics_from, normal_quantile,
                    on_accelerator,
                    scan_unroll)
@@ -248,7 +250,8 @@ class HoltWintersModel(NamedTuple):
 
 
 def _hw_sse_value_and_grad(params: jnp.ndarray, series: jnp.ndarray,
-                           period: int, model_type: str):
+                           period: int, model_type: str,
+                           n_valid=None):
     """Fused forward pass computing ``(sse, dsse/d(α,β,γ))`` in one scan.
 
     Reverse-mode autodiff through the components recurrence stores every
@@ -268,6 +271,10 @@ def _hw_sse_value_and_grad(params: jnp.ndarray, series: jnp.ndarray,
     and ``g += 2·e·de``, ``sse += e²`` accumulate per step.  The initial
     components are data-only (``_init_components``), so tangents start at
     zero.  Single lane ``series (n,)``; vmapped by ``minimize_box``.
+
+    ``n_valid`` (scalar): valid-window length of a left-aligned ragged
+    lane (``ops.ragged``) — steps at absolute index ≥ ``n_valid`` get
+    weight 0 in both accumulators, matching the trimmed series.
     """
     model = HoltWintersModel(model_type, period, params[0], params[1],
                              params[2])
@@ -279,9 +286,18 @@ def _hw_sse_value_and_grad(params: jnp.ndarray, series: jnp.ndarray,
     e_g = jnp.asarray([0.0, 0.0, 1.0], dtype)
 
     level0, trend0, season0 = model._init_components(series)
-    xs = series[period:]
+    if n_valid is None:
+        xs = series[period:]
+    else:
+        ws = step_weights(series.shape[-1] - period, n_valid,
+                          offset=period, dtype=dtype)
+        xs = (series[period:], ws)
 
-    def step(carry, x):
+    def step(carry, inp):
+        if n_valid is None:
+            x = inp
+        else:
+            x, w = inp
         (level, trend, seasons, dl, db_, dseasons, sse, grad) = carry
         s_i = seasons[0]
         ds_i = dseasons[0]
@@ -314,6 +330,9 @@ def _hw_sse_value_and_grad(params: jnp.ndarray, series: jnp.ndarray,
         dnew_season = e_g * (sw - s_i) + g * dsw + (1.0 - g) * ds_i
         seasons = jnp.concatenate([seasons[1:], new_season[None]])
         dseasons = jnp.concatenate([dseasons[1:], dnew_season[None]])
+        if n_valid is not None:
+            e = w * e
+            de = w * de
         return (new_level, new_trend, seasons, dnew_level, dnew_trend,
                 dseasons, sse + e * e, grad + 2.0 * e * de), None
 
@@ -332,15 +351,31 @@ def fit(ts: jnp.ndarray, period: int, model_type: str = "additive",
     bounded BOBYQA → batched projected gradient).
 
     ``ts (..., n)``; leading dims fit in one batched solve.
+
+    NaN-padded panels (leading/trailing padding per lane) fit directly:
+    valid windows are left-aligned and the SSE weighted to them, matching
+    independent fits of the trimmed series (``ops.ragged``).  Lanes with
+    fewer than ``2 * period + 1`` valid observations get NaN parameters
+    and ``diagnostics.converged == False``; interior gaps raise.
     """
     ts = jnp.asarray(ts)
+    ts, obs_len = ragged_view(ts)
+    extra = () if obs_len is None else (obs_len,)
 
-    def objective(params, series):
-        return HoltWintersModel(model_type, period, params[0], params[1],
-                                params[2]).sse(series)
+    def objective(params, series, *v):
+        model = HoltWintersModel(model_type, period, params[0], params[1],
+                                 params[2])
+        if not v:
+            return model.sse(series)
+        fitted, _ = model._run(series)
+        err = series[period:] - fitted[period:]
+        w = step_weights(err.shape[-1], v[0], offset=period,
+                         dtype=series.dtype)
+        return jnp.sum(w * err * err)
 
-    def value_and_grad(params, series):
-        return _hw_sse_value_and_grad(params, series, period, model_type)
+    def value_and_grad(params, series, *v):
+        return _hw_sse_value_and_grad(params, series, period, model_type,
+                                      n_valid=v[0] if v else None)
 
     # the fused forward pass trades ~4x primal FLOPs for zero backward
     # storage: a win on TPU (memory-bound scans) and a measured 2.5x LOSS
@@ -356,12 +391,18 @@ def fit(ts: jnp.ndarray, period: int, model_type: str = "additive",
     vag = value_and_grad if fused else None
 
     x0 = jnp.broadcast_to(jnp.asarray(init, ts.dtype), (*ts.shape[:-1], 3))
-    res = minimize_box(objective, x0, 0.0, 1.0, ts, tol=tol,
+    res = minimize_box(objective, x0, 0.0, 1.0, ts, *extra, tol=tol,
                        max_iter=max_iter, value_and_grad_fn=vag)
     ok = jnp.all(jnp.isfinite(res.x), axis=-1, keepdims=True)
     p = jnp.where(ok, res.x, x0)
+    conv = diagnostics_from(res, ok)
+    if obs_len is not None:
+        short = short_lanes(obs_len, 2 * period + 1,
+                            "Holt-Winters fit (two init periods + 1)")
+        p, conv_mask = apply_short_quarantine(p, conv.converged, short)
+        conv = conv._replace(converged=conv_mask)
     return HoltWintersModel(model_type, period, p[..., 0], p[..., 1],
-                            p[..., 2], diagnostics=diagnostics_from(res, ok))
+                            p[..., 2], diagnostics=conv)
 
 
 def fit_panel(panel, period: int, model_type: str = "additive",

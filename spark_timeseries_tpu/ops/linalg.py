@@ -142,7 +142,8 @@ def ols(X: jnp.ndarray, y: jnp.ndarray, add_intercept: bool = False) -> OLSResul
 
 
 def ols_gram(Xs: jnp.ndarray, y: jnp.ndarray,
-             add_intercept: bool = False) -> OLSResult:
+             add_intercept: bool = False,
+             row_weights: jnp.ndarray | None = None) -> OLSResult:
     """Least squares from a *stacked* design ``Xs (..., p, n)`` (features on
     the second-minor axis — see :func:`~spark_timeseries_tpu.ops.lag.lag_stack`)
     via the normal equations ``(Xs Xsᵀ) β = Xs y``.
@@ -153,18 +154,33 @@ def ols_gram(Xs: jnp.ndarray, y: jnp.ndarray,
     at small ``p``.  QR on the row-major design (:func:`ols`) remains the
     general path; gram solves lose ~half the mantissa on conditioning, which
     the well-conditioned lag designs (p ≤ ~12) tolerate in both f32 and f64.
+
+    ``row_weights (..., n)`` of 0/1 restricts the solve to the weighted
+    rows — exactly OLS on the subset (ragged-panel fits: rows whose lag
+    window leaves a lane's valid window get weight 0).  Residual/fitted
+    outputs keep full length; ``sigma2``'s denominator counts live rows.
     """
     if add_intercept:
         ones = jnp.ones((*Xs.shape[:-2], 1, Xs.shape[-1]), Xs.dtype)
         Xs = jnp.concatenate([ones, Xs], axis=-2)
     n, p = Xs.shape[-1], Xs.shape[-2]
-    N = jnp.einsum("...pn,...qn->...pq", Xs, Xs)
-    b = jnp.einsum("...pn,...n->...p", Xs, y)
+    if row_weights is None:
+        Xw, yw = Xs, y
+        dof = jnp.asarray(max(n - p, 1), Xs.dtype)
+    else:
+        w = jnp.asarray(row_weights, Xs.dtype)
+        Xw = Xs * w[..., None, :]
+        yw = y * w
+        dof = jnp.maximum(jnp.sum(w, axis=-1) - p, 1.0)
+    N = jnp.einsum("...pn,...qn->...pq", Xw, Xs)
+    b = jnp.einsum("...pn,...n->...p", Xw, y)
     xtx_inv = spd_inverse(N)    # gram matrices are SPD: unrolled Cholesky
     beta = jnp.einsum("...pq,...q->...p", xtx_inv, b)
     fitted = jnp.einsum("...pn,...p->...n", Xs, beta)
-    resid = y - fitted
-    dof = max(n - p, 1)
+    if row_weights is None:
+        resid = y - fitted
+    else:
+        resid = (y - fitted) * w       # dead rows carry garbage y: zero them
     sigma2 = jnp.sum(resid * resid, axis=-1) / dof
     return OLSResult(beta, resid, fitted, sigma2, xtx_inv)
 

@@ -1,0 +1,142 @@
+"""Ragged / NaN-padded panel support: valid-window views for batched fits.
+
+The reference's ingestion shape — ``timeSeriesRDDFromObservations`` followed
+by index ``union`` (ref ``/root/reference/src/main/scala/com/cloudera/sparkts/TimeSeriesRDD.scala:694-745``)
+— produces rectangular panels whose lanes are NaN-padded where a series
+starts later or ends earlier than the union calendar.  The reference fills
+(imputes) before fitting; here the CSS/SSE fits accept such panels directly
+(SURVEY.md §7 hard part #5: mask semantics everywhere).
+
+TPU-native design: instead of threading a per-observation boolean mask
+through every recurrence (a second operand in every scan step), each lane's
+contiguous valid window is **left-aligned by one gather** and reduced to a
+single per-lane length.  Kernels then derive step weights from an
+``iota < length`` comparison — one broadcast compare, no mask arrays in HBM
+— and a fit on the padded panel is arithmetically identical to fitting each
+trimmed series alone (pinned by ``tests/test_ragged.py``).
+
+Interior gaps (NaNs strictly inside a lane's first..last finite window) are
+*not* maskable this way — a lag recurrence reading a missing observation has
+no exact conditional-CSS answer short of a Kalman filter — so they raise,
+directing the caller to ``fill`` (the reference's own requirement for any
+NaN, ``TimeSeriesRDD.scala:172-189``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _windows(values: jnp.ndarray):
+    """Per-lane ``(start, length, n_observed)`` of the observed (non-NaN)
+    window.  NaN alone marks padding: an ``inf`` is bad *data* and must
+    flow into the objective to quarantine its lane loudly, not be trimmed
+    silently."""
+    n = values.shape[-1]
+    obs = ~jnp.isnan(values)
+    any_valid = jnp.any(obs, axis=-1)
+    start = jnp.argmax(obs, axis=-1)
+    last = n - 1 - jnp.argmax(obs[..., ::-1], axis=-1)
+    length = jnp.where(any_valid, last - start + 1, 0)
+    return start, length, jnp.sum(obs, axis=-1)
+
+
+@jax.jit
+def _left_align(values: jnp.ndarray):
+    start, length, n_obs = _windows(values)
+    n = values.shape[-1]
+    idx = jnp.minimum(start[..., None] + jnp.arange(n), n - 1)
+    rolled = jnp.take_along_axis(values, idx, axis=-1)
+    tail = jnp.arange(n) >= length[..., None]
+    rolled = jnp.where(tail, jnp.zeros((), values.dtype), rolled)
+    return rolled, length, n_obs
+
+
+def ragged_view(values: jnp.ndarray
+                ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """``(aligned, lengths)`` view of a possibly NaN-padded panel.
+
+    Fully-observed input returns ``(values, None)`` untouched (one scalar
+    device reduction decides; no transfer, no relayout).  Otherwise every
+    lane's contiguous observed window is shifted to position 0, the
+    garbage tail is zeroed (so downstream recurrences stay NaN-free), and
+    ``lengths (...,)`` gives each lane's valid-observation count — an
+    all-NaN lane gets length 0.  Raises if any lane has NaN strictly inside
+    its observed window (impute those with ``fill`` first; only *interior*
+    gaps need it now).
+
+    ``values (..., n)``: a single series or any batch of lanes.  Under an
+    enclosing ``jit`` trace the padding check is impossible (it is a
+    data-dependent branch), so tracers pass through as fully observed —
+    ragged panels must enter ``fit`` outside ``jit`` (the fits jit their
+    own kernels; benchmark wrappers that jit whole fits feed dense
+    panels).
+    """
+    values = jnp.asarray(values)
+    if values.dtype.kind != "f" or isinstance(values, jax.core.Tracer):
+        return values, None
+    if not bool(jnp.any(jnp.isnan(values))):
+        return values, None
+    aligned, length, n_obs = _left_align(values)
+    holes = jnp.sum(n_obs != length)
+    if int(holes):
+        raise ValueError(
+            f"{int(holes)} lane(s) have NaN strictly inside their observed "
+            f"window; valid-window fits need contiguous observations — "
+            f"impute interior gaps first (e.g. Panel.fill / ops.fill_ts), "
+            f"leading/trailing padding needs no fill")
+    return aligned, length
+
+
+def step_weights(n_steps: int, n_valid: jnp.ndarray, offset: int = 0,
+                 dtype=None) -> jnp.ndarray:
+    """``(n_steps,)`` 0/1 weights: step ``i`` (absolute index
+    ``offset + i`` in the lane) is live iff ``offset + i < n_valid``.
+    The one primitive masked kernels need — computed from iota at trace
+    time, never stored.  Batched ``n_valid`` must arrive pre-expanded
+    (``n_valid[..., None]``) so the compare broadcasts to ``(..., n_steps)``."""
+    w = (offset + jnp.arange(n_steps)) < n_valid
+    return w if dtype is None else w.astype(dtype)
+
+
+def short_lanes(obs_len: jnp.ndarray, min_n: int,
+                what: str) -> Optional[jnp.ndarray]:
+    """Flag lanes whose valid window is under ``min_n`` observations.
+
+    The shared short-lane policy for every ragged fit: raises if *every*
+    lane is short, warns (and returns the boolean mask) if some are —
+    callers then NaN those lanes' parameters via
+    :func:`apply_short_quarantine` instead of poisoning the batch.
+    Returns ``None`` when nothing is short.  ``what`` names the
+    requirement in the message (e.g. ``"ARIMA(2,0,2) Hannan-Rissanen
+    initialization"``).
+    """
+    import warnings
+
+    import numpy as np
+    short = np.asarray(obs_len) < min_n
+    if short.all():
+        raise ValueError(
+            f"every lane's valid window is shorter than the {min_n} "
+            f"observations the {what} needs")
+    if not short.any():
+        return None
+    warnings.warn(
+        f"{int(short.sum())} lane(s) have valid windows shorter than the "
+        f"{min_n} observations the {what} needs; their parameters are NaN "
+        f"and diagnostics.converged is False", stacklevel=3)
+    return jnp.asarray(short)
+
+
+def apply_short_quarantine(params: jnp.ndarray, converged: jnp.ndarray,
+                           short: Optional[jnp.ndarray]):
+    """NaN out short lanes' parameters and demote them to non-converged
+    (``short`` from :func:`short_lanes`; ``None`` passes through)."""
+    if short is None:
+        return params, converged
+    s = short[..., None] if params.ndim > short.ndim else short
+    return (jnp.where(s, jnp.nan, params),
+            converged & ~jnp.reshape(short, converged.shape))
