@@ -258,6 +258,7 @@ def main():
             n_target = min(n_target, CPU_FALLBACK_N)
         jax.config.update("jax_enable_x64", True)
         dtype = jnp.float64
+    np_dtype = np.float32 if dtype == jnp.float32 else np.float64
     chunk = min(int(os.environ.get("BENCH_CHUNK", str(CHUNK))), n_target)
 
     panel = _synthetic_arima_panel(n_target, n_obs)
@@ -341,8 +342,6 @@ def main():
             h2d_mbps = None
             if on_tpu:
                 if c not in h2d_by_chunk:
-                    np_dtype = np.float32 if dtype == jnp.float32 \
-                        else np.float64
                     h2d_by_chunk[c] = round(
                         _measure_h2d(panel[:c], np_dtype), 2)
                 h2d_mbps = h2d_by_chunk[c]
@@ -385,7 +384,6 @@ def main():
             from spark_timeseries_tpu.models.arima import LM_MAX_ITER
 
             demo_n = min(chunk, n_target)
-            np_dtype = np.float32 if dtype == jnp.float32 else np.float64
             fit_model = jax.jit(lambda v: arima.fit(2, 1, 2, v, warn=False))
             model = fit_model(jnp.asarray(panel[:demo_n], dtype))
             before = float(np.asarray(model.diagnostics.converged).mean())
@@ -509,8 +507,8 @@ def main():
     h2d_mbps = curve_h2d.get(str(best_n))
     overlap_pct = None
     if on_tpu and h2d_mbps and device_resident:
-        itemsize = 4 if dtype == jnp.float32 else 8
-        t_h2d = best_n * n_obs * itemsize / (h2d_mbps * 2**20)
+        t_h2d = best_n * n_obs * np.dtype(np_dtype).itemsize \
+            / (h2d_mbps * 2**20)
         t_pipe = best_n / curve[str(best_n)]
         t_dr = best_n / device_resident
         if t_h2d > 0:

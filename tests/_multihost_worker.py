@@ -66,4 +66,20 @@ sm = parallel.collect(fitted)
 assert sm.shape == (8,)
 assert np.all(np.isfinite(sm))
 
+# sharded-vs-unsharded equivalence ACROSS PROCESS BOUNDARIES (round-4
+# verdict item 6): the globally-sharded fit must equal the same fit run
+# unsharded in this process, to f64 tolerance — distribution must not
+# change per-lane math
+ref_sm = np.asarray(ewma.fit(jnp.asarray(panel_np), max_iter=20).smoothing)
+np.testing.assert_allclose(sm, ref_sm, rtol=1e-10, atol=1e-12)
+
+from spark_timeseries_tpu.models import arima  # noqa: E402
+
+coef_sharded = parallel.collect(jax.jit(
+    lambda v: arima.fit(1, 0, 1, v, warn=False).coefficients,
+    in_shardings=parallel.series_sharding(mesh))(panel))
+coef_ref = np.asarray(
+    arima.fit(1, 0, 1, jnp.asarray(panel_np), warn=False).coefficients)
+np.testing.assert_allclose(coef_sharded, coef_ref, rtol=1e-10, atol=1e-12)
+
 print(f"MULTIHOST_OK {pid}", flush=True)

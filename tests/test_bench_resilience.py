@@ -47,7 +47,7 @@ def _run_degraded(script, env_extra, timeout):
 def test_bench_degrades_to_labeled_cpu_record():
     out = _run_degraded(
         os.path.join(REPO, "bench.py"),
-        {"BENCH_N_SERIES": "256", "BENCH_N_OBS": "48", "BENCH_REFIT": "0"},
+        {"BENCH_N_SERIES": "256", "BENCH_N_OBS": "48"},
         timeout=600)
     assert out.returncode == 0, out.stderr[-2000:]
     lines = [json.loads(ln) for ln in out.stdout.splitlines()
@@ -58,6 +58,14 @@ def test_bench_degrades_to_labeled_cpu_record():
     assert "degraded" in headline, "fallback run must be labeled"
     assert headline["value"] and headline["value"] > 0
     assert headline["unit"] == "series/sec"
+    # the remediation chain runs in degraded fallbacks too, and its
+    # failures must not hide behind the try/except's error field
+    demo = headline.get("refit_demo")
+    assert demo and "error" not in demo, demo
+    assert demo["converged_pct_after"] >= demo["converged_pct_before"]
+    # fabricated transfer numbers must not appear on CPU runs
+    assert headline.get("h2d_mbps") is None
+    assert "h2d_mbps" not in lines[0]
     # every streamed line — not just the headline — is labeled, so a
     # partial record surviving a mid-curve crash can't read as a
     # deliberate CPU capture

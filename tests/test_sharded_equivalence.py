@@ -1,0 +1,79 @@
+"""Sharded-vs-unsharded fit equivalence (round-4 verdict item 6).
+
+"Runs under a mesh" is upgraded to "correct under a mesh": the same panel
+fitted on one device and sharded over the full 8-device mesh must produce
+the same parameters to f64 tolerance.  This is the SPMD analogue of the
+reference delegating distribution semantics to Spark and testing `local`
+mode (ref LocalSparkContext.scala:23-61) — per-lane math must not depend
+on which shard a lane lives in.  The 2-process multihost variant lives in
+``tests/_multihost_worker.py``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu import parallel
+from spark_timeseries_tpu.models import arima, ewma, holt_winters as hw
+
+
+@pytest.fixture(scope="module")
+def mesh(cpu_devices):
+    return parallel.make_mesh(8, 1)
+
+
+def _sharded_fit(fn, panel_np, mesh):
+    sharded = parallel.shard_panel_values(jnp.asarray(panel_np), mesh)
+    out = jax.jit(fn, in_shardings=parallel.series_sharding(mesh))(sharded)
+    return parallel.collect(out)
+
+
+def test_arima_sharded_equals_unsharded(mesh):
+    rng = np.random.default_rng(0)
+    e = rng.normal(size=(16, 120))
+    y = np.zeros_like(e)
+    for t in range(1, 120):
+        y[:, t] = 3.0 + 0.5 * y[:, t - 1] + e[:, t] + 0.3 * e[:, t - 1]
+
+    plain = np.asarray(
+        arima.fit(1, 0, 1, jnp.asarray(y), warn=False).coefficients)
+    sharded = _sharded_fit(
+        lambda v: arima.fit(1, 0, 1, v, warn=False).coefficients, y, mesh)
+    np.testing.assert_allclose(sharded, plain, rtol=1e-10, atol=1e-12)
+
+
+def test_ewma_sharded_equals_unsharded(mesh):
+    rng = np.random.default_rng(1)
+    y = 50.0 + 0.3 * np.cumsum(rng.normal(size=(16, 96)), axis=1) \
+        + rng.normal(size=(16, 96))
+
+    plain = np.asarray(ewma.fit(jnp.asarray(y)).smoothing)
+    sharded = _sharded_fit(lambda v: ewma.fit(v).smoothing, y, mesh)
+    np.testing.assert_allclose(sharded, plain, rtol=1e-10, atol=1e-12)
+
+
+def test_holt_winters_sharded_equals_unsharded(mesh):
+    rng = np.random.default_rng(2)
+    t = np.arange(72.)
+    y = 60 + 0.4 * t + 5 * np.sin(2 * np.pi * t / 6) \
+        + rng.normal(scale=0.5, size=(8, 72))
+
+    plain = np.asarray(
+        hw.fit(jnp.asarray(y), 6, "additive", max_iter=150).alpha)
+    sharded = _sharded_fit(
+        lambda v: hw.fit(v, 6, "additive", max_iter=150).alpha, y, mesh)
+    np.testing.assert_allclose(sharded, plain, rtol=1e-10, atol=1e-12)
+
+
+def test_ewma_sharded_on_series_and_time_mesh(cpu_devices):
+    # sequence-parallel layout: the time axis sharded too (4x2 mesh); the
+    # scan's per-lane math must still match the single-device fit
+    mesh = parallel.make_mesh(4, 2)
+    rng = np.random.default_rng(3)
+    y = 40.0 + 0.2 * np.cumsum(rng.normal(size=(8, 64)), axis=1) \
+        + rng.normal(size=(8, 64))
+
+    plain = np.asarray(ewma.fit(jnp.asarray(y)).smoothing)
+    sharded = _sharded_fit(lambda v: ewma.fit(v).smoothing, y, mesh)
+    np.testing.assert_allclose(sharded, plain, rtol=1e-10, atol=1e-12)
