@@ -1,0 +1,142 @@
+"""A/B: Pallas fused normal-equations kernel vs the XLA fused-carry path.
+
+Round-4 verdict item 2: settle whether a Pallas kernel that keeps the LM
+accumulators in VMEM for the whole time axis beats XLA's scan codegen at
+the fused shape (the round-1 experiment predates the fused-carry kernel,
+so its negative result no longer answers this).  Measures, at the bench
+chunk (131072 x 128 f32, ARIMA(2,1,2), override via ``AB_N_SERIES`` /
+``AB_N_OBS``):
+
+- one fused NE pass, XLA vs Pallas (chained R times inside one jit with a
+  tiny data dependence so iterations serialize; scalar-reduced outputs —
+  the tunnel's ~150 ms RTT and slow D2H never touch the timing);
+- one in-loop LM iteration, XLA vs Pallas (differenced fits:
+  ``(fit(max_iter=12) - fit(max_iter=2)) / 10`` — fixed costs cancel);
+- the full fit wall time, both paths.
+
+Prints one JSON line per measurement; shares ``bench._resolve_platform``
+(probe in subprocess, labeled degraded CPU fallback, rc 0 either way).
+On CPU the Pallas kernel runs interpreted — orders of magnitude slow —
+so CPU runs shrink the shape and the lines are marked
+``"cpu_interpret": true`` (compile/behavior smoke, not a perf record).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from bench import DEGRADED_NOTE, _resolve_platform, _synthetic_arima_panel
+    platform, degraded = _resolve_platform()
+
+    def emit(obj):
+        if degraded:
+            obj.setdefault("degraded", DEGRADED_NOTE)
+        obj["platform"] = platform
+        print(json.dumps(obj), flush=True)
+
+    import jax
+    import jax.numpy as jnp
+
+    from spark_timeseries_tpu.models import arima
+    from spark_timeseries_tpu.ops import pallas_arma
+    from spark_timeseries_tpu.ops.optimize import minimize_least_squares
+    from spark_timeseries_tpu.ops.univariate import differences_of_order_d
+
+    on_tpu = platform != "cpu"
+    S = int(os.environ.get("AB_N_SERIES", "131072" if on_tpu else "1024"))
+    n_obs = int(os.environ.get("AB_N_OBS", "128"))
+    p = q = 2
+    icpt = 1
+    interpret = not on_tpu
+
+    panel = _synthetic_arima_panel(S, n_obs)
+    diffed = np.asarray(
+        differences_of_order_d(jnp.asarray(panel, jnp.float32), 1))[:, 1:]
+    y = jnp.asarray(diffed, jnp.float32)
+    init = arima.hannan_rissanen_init(p, q, y, True).astype(jnp.float32)
+    init = jnp.where(jnp.isfinite(init), init, 0.0)
+
+    def timed(fn, *args, reps=1):
+        out = fn(*args)
+        jax.tree_util.tree_map(np.asarray, out)      # warm + materialize
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+            jax.tree_util.tree_map(np.asarray, out)
+        return (time.perf_counter() - t0) / reps
+
+    # --- one fused NE pass, chained so fixed costs amortize -----------------
+    R = 8
+
+    # every output (jtj included) feeds the data dependence: XLA's DCE
+    # would otherwise strip the unused JtJ accumulation from its side of
+    # the A/B while the Pallas kernel always computes its fused output
+    def chain_xla(prm):
+        def body(i, carry):
+            x, acc = carry
+            jtj, jtr, sse = jax.vmap(
+                lambda pp, yy: arima._arma_normal_eqs(pp, yy, p, q, icpt)
+            )(x, y)
+            return (x + 1e-30 * jtr,
+                    acc + jnp.sum(sse) + 1e-30 * jnp.sum(jtj))
+        return jax.lax.fori_loop(0, R, body, (prm, jnp.zeros((), y.dtype)))[1]
+
+    def chain_pallas(prm):
+        def body(i, carry):
+            x, acc = carry
+            jtj, jtr, sse = pallas_arma.normal_equations(
+                x, y, p, q, icpt, interpret=interpret)
+            return (x + 1e-30 * jtr,
+                    acc + jnp.sum(sse) + 1e-30 * jnp.sum(jtj))
+        return jax.lax.fori_loop(0, R, body, (prm, jnp.zeros((), y.dtype)))[1]
+
+    t_xla = timed(jax.jit(chain_xla), init) / R
+    t_pl = timed(jax.jit(chain_pallas), init) / R
+    emit({"metric": f"fused NE pass ({S}x{n_obs} f32, chained x{R})",
+          "xla_ms": round(1e3 * t_xla, 3), "pallas_ms": round(1e3 * t_pl, 3),
+          "speedup": round(t_xla / t_pl, 2), "unit": "ms/pass",
+          **({"cpu_interpret": True} if interpret else {})})
+
+    # --- one in-loop LM iteration (differenced fits) ------------------------
+    def lm_xla(iters):
+        def run(x0):
+            return minimize_least_squares(
+                None, x0, y, max_iter=iters,
+                normal_eqs_fn=lambda prm, yy: arima._arma_normal_eqs(
+                    prm, yy, p, q, icpt)).x
+        return timed(jax.jit(run), init)
+
+    def lm_pl(iters):
+        def run(x0):
+            return pallas_arma.fit_css_lm(
+                x0, y, p, q, icpt, max_iter=iters, interpret=interpret)[0]
+        return timed(jax.jit(run), init)
+
+    it_xla = (lm_xla(12) - lm_xla(2)) / 10.0
+    it_pl = (lm_pl(12) - lm_pl(2)) / 10.0
+    emit({"metric": f"LM iteration ({S}x{n_obs} f32, differenced 12-2)",
+          "xla_ms": round(1e3 * it_xla, 3), "pallas_ms": round(1e3 * it_pl, 3),
+          "speedup": round(it_xla / it_pl, 2), "unit": "ms/iteration",
+          **({"cpu_interpret": True} if interpret else {})})
+
+    # --- full fit wall time -------------------------------------------------
+    t_fit_xla = lm_xla(50)
+    t_fit_pl = lm_pl(50)
+    emit({"metric": f"full css-lm fit ({S}x{n_obs} f32, max_iter=50)",
+          "xla_s": round(t_fit_xla, 3), "pallas_s": round(t_fit_pl, 3),
+          "speedup": round(t_fit_xla / t_fit_pl, 2),
+          "xla_series_per_sec": round(S / t_fit_xla, 1),
+          "pallas_series_per_sec": round(S / t_fit_pl, 1),
+          "unit": "s/fit",
+          **({"cpu_interpret": True} if interpret else {})})
+
+
+if __name__ == "__main__":
+    main()

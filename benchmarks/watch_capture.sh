@@ -25,7 +25,10 @@ assert float(x) == 256.0 * 256 * 256
     echo "$(date -u +%FT%TZ) bench_suite.py done rc=$rc" >> "$OUT/log"
     python -u benchmarks/roofline.py    > "$OUT/roofline_tpu.jsonl" 2> "$OUT/roofline_tpu.err"
     rc=$?
-    echo "$(date -u +%FT%TZ) roofline.py done rc=$rc - capture complete" >> "$OUT/log"
+    echo "$(date -u +%FT%TZ) roofline.py done rc=$rc" >> "$OUT/log"
+    python -u benchmarks/pallas_ab.py   > "$OUT/pallas_ab_tpu.jsonl" 2> "$OUT/pallas_ab_tpu.err"
+    rc=$?
+    echo "$(date -u +%FT%TZ) pallas_ab.py done rc=$rc - capture complete" >> "$OUT/log"
     exit 0
   fi
   echo "$(date -u +%FT%TZ) probe failed; retry in 240s" >> "$OUT/log"

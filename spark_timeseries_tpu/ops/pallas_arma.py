@@ -1,0 +1,308 @@
+"""Pallas TPU kernel for the fused ARMA normal equations — the hot op.
+
+Every Levenberg-Marquardt iteration of the headline ARIMA fit needs, per
+lane: one-step CSS residuals, the Gauss-Newton accumulators ``JᵀJ``/``Jᵀr``
+and the cost (ref hot loop being replaced:
+``/root/reference/src/main/scala/com/cloudera/sparkts/models/ARIMA.scala:581-618``
++ the analytic derivative recurrence ``:465-534``).  The XLA path
+(``arima._arma_normal_eqs``) carries those accumulators through a
+``lax.scan`` whose carry (~37 floats/lane at ARIMA(2,1,2)) streams through
+HBM every unrolled step group; this kernel instead keeps the ENTIRE carry
+in VMEM for the whole time axis:
+
+- lanes are blocked ``(ROWS, 128)`` (sublane x lane tiles; series on the
+  128-lane minor axis), the full time axis of a block resident in VMEM —
+  at the bench shape (131072 x 128 f32) a 64-row block is 4 MB of series
+  data + ~1.2 MB of carry, far under the ~16 MB VMEM budget;
+- time advances in a ``fori_loop`` over static-size chunks whose inner
+  steps are Python-unrolled, so every ``y`` read inside a chunk is a
+  STATIC index into a VMEM values array (the round-1 kernel's per-step
+  dynamic sublane reads were its loss mode, ``docs/experiments/
+  arma_pallas.py``);
+- the 5x5 ``JᵀJ`` packs as its 15-element upper triangle, accumulated —
+  like ``Jᵀr`` and the cost — as plain VPU registers/VMEM values.
+
+HBM traffic per pass drops to one read of the series block plus 21 output
+tiles per block: the XLA fused-carry pass is latency-bound on its carry
+round trips, this one is VPU-compute-bound.
+
+Numerics: float32 (the production TPU dtype).  The kernel is pinned to
+``arima._arma_normal_eqs`` (itself pinned to autodiff at f64) by
+``tests/test_pallas_arma.py`` in interpreter mode on CPU and compiled on
+TPU.  Use :func:`use_pallas` to gate call sites by backend.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .linalg import spd_solve
+
+LANES = 128
+MAX_ROWS = 64          # sublane rows per block: 64x128 lanes = 8 VPU tiles
+TIME_CHUNK = 16        # static-unrolled steps per fori_loop iteration
+
+
+def use_pallas() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def _block_rows(n_series: int) -> int:
+    rows = -(-n_series // LANES)
+    return max(8, min(MAX_ROWS, ((rows + 7) // 8) * 8))
+
+
+def _triu_pairs(k: int):
+    return [(a, b) for a in range(k) for b in range(a, k)]
+
+
+def _ne_kernel(p: int, q: int, icpt: int, n_obs: int,
+               params_ref, y_ref, out_ref):
+    """One lane block.  ``params (k, ROWS, 128)``, ``y (n_obs, ROWS, 128)``
+    VMEM-resident; ``out (n_out, ROWS, 128)`` with
+    ``n_out = 1 + len(triu) + k`` laid out ``[sse, jtj_triu..., jtr...]``.
+
+    The recurrence per step (matching ``arima._arma_normal_eqs``):
+
+        e_t = y_t - c - Σ_j φ_j y_{t-j-1} - Σ_m θ_m e_ring[m]
+        T_t = -u_t - Σ_m θ_m T_ring[m],  u = (1?, y lags newest-first,
+                                              e_ring)
+        sse += e², jtj += T Tᵀ (triu), jtr += T e
+
+    starting at t = max(p, q) with zero rings — identical conditioning.
+    """
+    k = icpt + p + q
+    max_lag = max(p, q)
+    pairs = _triu_pairs(k)
+    n_steps = n_obs - max_lag
+    n_chunks = n_steps // TIME_CHUNK
+    tail = n_steps - n_chunks * TIME_CHUNK
+
+    zero = y_ref[0] * 0.0
+    c = params_ref[0] if icpt else zero
+    phi = [params_ref[icpt + j] for j in range(p)]
+    theta = [params_ref[icpt + p + m] for m in range(q)]
+
+    def steps(y_chunk, y_lag_chunks, carry, count):
+        """``count`` static steps; every index below is static.
+        ``y_chunk[i]`` is y_t for step i; ``y_lag_chunks[j][i]`` is
+        y_{t-j-1}."""
+        e_ring, T_ring, sse, jtj, jtr = carry
+        for i in range(count):
+            y_t = y_chunk[i]
+            yhat = c
+            for j in range(p):
+                yhat = yhat + phi[j] * y_lag_chunks[j][i]
+            for m in range(q):
+                yhat = yhat + theta[m] * e_ring[m]
+            e = y_t - yhat
+            T = []
+            for x in range(k):
+                if x < icpt:
+                    u = zero + 1.0
+                elif x < icpt + p:
+                    u = y_lag_chunks[x - icpt][i]
+                else:
+                    u = e_ring[x - icpt - p]
+                s = u
+                for m in range(q):
+                    s = s + theta[m] * T_ring[m][x]
+                T.append(-s)
+            sse = sse + e * e
+            jtj = [jtj[idx] + T[a] * T[b]
+                   for idx, (a, b) in enumerate(pairs)]
+            jtr = [jtr[x] + T[x] * e for x in range(k)]
+            if q:
+                e_ring = [e] + e_ring[:-1]
+                T_ring = [T] + T_ring[:-1]
+        return e_ring, T_ring, sse, jtj, jtr
+
+    def flatten(carry):
+        e_ring, T_ring, sse, jtj, jtr = carry
+        return tuple(e_ring) + tuple(x for row in T_ring for x in row) \
+            + (sse,) + tuple(jtj) + tuple(jtr)
+
+    def unflatten(flat):
+        e_ring = list(flat[:q])
+        off = q
+        T_ring = [list(flat[off + m * k: off + (m + 1) * k])
+                  for m in range(q)]
+        off += q * k
+        sse = flat[off]
+        jtj = list(flat[off + 1: off + 1 + len(pairs)])
+        jtr = list(flat[off + 1 + len(pairs):])
+        return e_ring, T_ring, sse, jtj, jtr
+
+    def chunk_body(ci, flat):
+        base = pl.multiple_of(max_lag + ci * TIME_CHUNK, 1)
+        y_c = y_ref[pl.ds(base, TIME_CHUNK)]
+        lag_c = [y_ref[pl.ds(base - (j + 1), TIME_CHUNK)] for j in range(p)]
+        carry = steps([y_c[i] for i in range(TIME_CHUNK)],
+                      [[lc[i] for i in range(TIME_CHUNK)] for lc in lag_c],
+                      unflatten(flat), TIME_CHUNK)
+        return flatten(carry)
+
+    carry0 = ([zero] * q, [[zero] * k for _ in range(q)], zero,
+              [zero] * len(pairs), [zero] * k)
+    flat = jax.lax.fori_loop(0, n_chunks, chunk_body, flatten(carry0)) \
+        if n_chunks else flatten(carry0)
+    if tail:
+        base = max_lag + n_chunks * TIME_CHUNK
+        y_c = [y_ref[base + i] for i in range(tail)]
+        lag_c = [[y_ref[base + i - (j + 1)] for i in range(tail)]
+                 for j in range(p)]
+        carry = steps(y_c, lag_c, unflatten(flat), tail)
+    else:
+        carry = unflatten(flat)
+    _, _, sse, jtj, jtr = carry
+    out_ref[0] = sse
+    for idx in range(len(pairs)):
+        out_ref[1 + idx] = jtj[idx]
+    for x in range(k):
+        out_ref[1 + len(pairs) + x] = jtr[x]
+
+
+@functools.lru_cache(maxsize=None)
+def _build_call(p: int, q: int, icpt: int, n_obs: int, n_blocks: int,
+                rows: int, interpret: bool):
+    k = icpt + p + q
+    n_out = 1 + len(_triu_pairs(k)) + k
+    kernel = functools.partial(_ne_kernel, p, q, icpt, n_obs)
+    return pl.pallas_call(
+        kernel,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((k, 1, rows, LANES), lambda i: (0, i, 0, 0)),
+            pl.BlockSpec((n_obs, 1, rows, LANES), lambda i: (0, i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((n_out, 1, rows, LANES),
+                               lambda i: (0, i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_out, n_blocks, rows, LANES), jnp.float32),
+        interpret=interpret,
+    )
+
+
+def _blocked(x: jnp.ndarray, n_series: int, rows: int):
+    """(n_series, m) -> (m, n_blocks, rows, 128) with zero padding; series
+    land on the minor lane axis (one transpose, amortized across the LM
+    iterations by transposing once up front in the driver)."""
+    block = rows * LANES
+    pad = (-n_series) % block
+    n_blocks = (n_series + pad) // block
+    x = jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
+    x = jnp.moveaxis(x, 0, -1)
+    return x.reshape(*x.shape[:-1], n_blocks, rows, LANES), n_blocks
+
+
+def normal_equations(params: jnp.ndarray, y: jnp.ndarray,
+                     p: int, q: int, icpt: int,
+                     interpret: bool | None = None):
+    """Batched fused ``(JᵀJ (S, k, k), Jᵀr (S, k), sse (S,))`` for the ARMA
+    CSS residuals — drop-in numerics for ``arima._arma_normal_eqs`` over a
+    whole panel.  ``params (S, k)``, ``y (S, n)``, float32."""
+    if interpret is None:
+        interpret = not use_pallas()
+    k = icpt + p + q
+    S, n_obs = y.shape
+    rows = _block_rows(S)
+    y_b, n_blocks = _blocked(y.astype(jnp.float32), S, rows)
+    out = _ne_from_blocked(params, y_b, S, rows, n_blocks, p, q, icpt,
+                           n_obs, interpret)
+    return out
+
+
+def _ne_from_blocked(params, y_b, S, rows, n_blocks, p, q, icpt, n_obs,
+                     interpret):
+    k = icpt + p + q
+    params_b, _ = _blocked(params.astype(jnp.float32), S, rows)
+    call = _build_call(p, q, icpt, n_obs, n_blocks, rows, interpret)
+    out = call(params_b, y_b)                     # (n_out, nb, rows, 128)
+    out = out.reshape(out.shape[0], -1)[:, :S].T  # (S, n_out)
+    pairs = _triu_pairs(k)
+    sse = out[:, 0]
+    tri = out[:, 1:1 + len(pairs)]
+    rows_idx = [a for a, _ in pairs]
+    cols_idx = [b for _, b in pairs]
+    jtj = jnp.zeros((S, k, k), jnp.float32)
+    jtj = jtj.at[:, jnp.asarray(rows_idx), jnp.asarray(cols_idx)].set(tri)
+    jtj = jtj.at[:, jnp.asarray(cols_idx), jnp.asarray(rows_idx)].set(tri)
+    jtr = out[:, 1 + len(pairs):]
+    return jtj, jtr, sse
+
+
+def fit_css_lm(x0: jnp.ndarray, y: jnp.ndarray, p: int, q: int, icpt: int,
+               tol: float = 1e-6, max_iter: int = 50,
+               interpret: bool | None = None):
+    """Panel-batched Levenberg-Marquardt on the CSS residuals with the
+    normal equations built by the Pallas kernel.
+
+    The state machine mirrors ``ops.optimize._minimize_lm_one`` exactly
+    (Marquardt-scaled damping, trial-point normal equations reused on
+    accept, per-lane convergence/pinned exits) but batches lanes in plain
+    array ops instead of ``vmap`` — one kernel dispatch per iteration for
+    the whole panel, with the small SPD solves on the unrolled Cholesky
+    path.  Returns ``(x, fun, converged, n_iter)`` with per-lane shapes.
+    """
+    if interpret is None:
+        interpret = not use_pallas()
+    x0 = x0.astype(jnp.float32)
+    S, k = x0.shape
+    n_obs = y.shape[-1]
+    rows = _block_rows(S)
+    y_b, n_blocks = _blocked(y.astype(jnp.float32), S, rows)
+    eye = jnp.eye(k, dtype=jnp.float32)
+
+    def ne(x):
+        return _ne_from_blocked(x, y_b, S, rows, n_blocks, p, q, icpt,
+                                n_obs, interpret)
+
+    def body(state):
+        x, f, jtj, jtr, lam, it_lanes, it, done = state
+        # freeze finished lanes exactly like the vmapped reference: jax's
+        # while_loop batching rule masks the carry once a lane's cond is
+        # false, so done lanes there stop moving — gate every update here
+        active = ~done
+        damp = lam[:, None] * jnp.diagonal(jtj, axis1=-2, axis2=-1) + 1e-12
+        delta = spd_solve(jtj + damp[..., None] * eye, jtr)
+        x_new = x - delta
+        jtj_new, jtr_new, f_new = ne(x_new)
+        ok = jnp.all(jnp.isfinite(jtj_new), axis=(-2, -1)) \
+            & jnp.all(jnp.isfinite(jtr_new), axis=-1)
+        improved = (f_new < f) & jnp.isfinite(f_new) & ok
+        take = improved & active
+        x = jnp.where(take[:, None], x_new, x)
+        f_keep = jnp.where(take, f_new, f)
+        jtj = jnp.where(take[:, None, None], jtj_new, jtj)
+        jtr = jnp.where(take[:, None], jtr_new, jtr)
+        # pinned-at-minimum exit tests the PRE-update lambda (the
+        # reference's s.lam), so a rejection at lam = 1e8 still updates
+        # lam and only the NEXT rejection marks the lane done
+        rel_drop = (f - f_new) <= tol * (jnp.abs(f) + tol)
+        step_small = jnp.max(jnp.abs(delta), axis=-1) <= tol * (
+            jnp.max(jnp.abs(x), axis=-1) + tol)
+        newly = (improved & (rel_drop | step_small)) \
+            | (~improved & (lam > 1e8))
+        lam = jnp.where(active,
+                        jnp.where(improved, lam * 0.1, lam * 10.0), lam)
+        return (x, f_keep, jtj, jtr, lam,
+                it_lanes + active.astype(jnp.int32), it + 1,
+                done | (newly & active))
+
+    def cond(state):
+        done, it = state[7], state[6]
+        return jnp.logical_and(~jnp.all(done), it < max_iter)
+
+    jtj0, jtr0, f0 = ne(x0)
+    lam0 = jnp.full((S,), 1e-3, jnp.float32)
+    state = jax.lax.while_loop(
+        cond, body,
+        (x0, f0, jtj0, jtr0, lam0, jnp.zeros((S,), jnp.int32),
+         jnp.asarray(0), jnp.zeros((S,), bool)))
+    x, f, _, _, _, it_lanes, _, done = state
+    return x, f, done, it_lanes

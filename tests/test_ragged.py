@@ -223,9 +223,9 @@ def test_hw_ragged_matches_trimmed(model_type):
         mi = hw.fit(jnp.asarray(clean[i, s:e]), period, model_type,
                     max_iter=300)
         for attr in ("alpha", "beta", "gamma"):
-            # batched lanes that converge early keep polishing while slower
-            # lanes finish (no freeze in the projected-gradient body), so
-            # agreement is at optimizer-plateau level, not machine eps
+            # XLA compiles the batched and single-lane solves differently
+            # (vectorization changes float rounding), so agreement is at
+            # optimizer-plateau level, not machine eps
             np.testing.assert_allclose(
                 np.asarray(getattr(m, attr))[i],
                 np.asarray(getattr(mi, attr)), rtol=2e-4, atol=2e-5)
