@@ -210,6 +210,12 @@ def normal_equations(params: jnp.ndarray, y: jnp.ndarray,
         interpret = not use_pallas()
     k = icpt + p + q
     S, n_obs = y.shape
+    if n_obs <= max(p, q):
+        # the XLA path fails loudly at trace time for this; negative step
+        # counts here would otherwise wrap to garbage static indices
+        raise ValueError(
+            f"series too short for the CSS window: need more than "
+            f"max(p, q) = {max(p, q)} observations, got {n_obs}")
     rows = _block_rows(S)
     y_b, n_blocks = _blocked(y.astype(jnp.float32), S, rows)
     out = _ne_from_blocked(params, y_b, S, rows, n_blocks, p, q, icpt,
@@ -254,6 +260,10 @@ def fit_css_lm(x0: jnp.ndarray, y: jnp.ndarray, p: int, q: int, icpt: int,
     x0 = x0.astype(jnp.float32)
     S, k = x0.shape
     n_obs = y.shape[-1]
+    if n_obs <= max(p, q):
+        raise ValueError(
+            f"series too short for the CSS window: need more than "
+            f"max(p, q) = {max(p, q)} observations, got {n_obs}")
     rows = _block_rows(S)
     y_b, n_blocks = _blocked(y.astype(jnp.float32), S, rows)
     eye = jnp.eye(k, dtype=jnp.float32)

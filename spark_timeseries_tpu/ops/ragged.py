@@ -106,10 +106,14 @@ def short_lanes(obs_len: jnp.ndarray, min_n: int,
                 what: str) -> Optional[jnp.ndarray]:
     """Flag lanes whose valid window is under ``min_n`` observations.
 
-    The shared short-lane policy for every ragged fit: raises if *every*
-    lane is short, warns (and returns the boolean mask) if some are —
-    callers then NaN those lanes' parameters via
+    The shared short-lane policy for every ragged fit: warn and return
+    the boolean mask — callers then NaN those lanes' parameters via
     :func:`apply_short_quarantine` instead of poisoning the batch.
+    Deliberately never raises, even when EVERY lane is short: batched
+    fits degrade per lane on data content (the framework's failure
+    philosophy — e.g. ``fit_long`` relies on an all-NaN panel coming
+    back quarantined, not thrown), and the warning plus all-NaN
+    parameters with ``converged == False`` carry the same information.
     Returns ``None`` when nothing is short.  ``what`` names the
     requirement in the message (e.g. ``"ARIMA(2,0,2) Hannan-Rissanen
     initialization"``).
@@ -118,14 +122,12 @@ def short_lanes(obs_len: jnp.ndarray, min_n: int,
 
     import numpy as np
     short = np.asarray(obs_len) < min_n
-    if short.all():
-        raise ValueError(
-            f"every lane's valid window is shorter than the {min_n} "
-            f"observations the {what} needs")
     if not short.any():
         return None
+    n = int(short.sum())
+    count = f"all {n} lanes" if short.all() else f"{n} lane(s)"
     warnings.warn(
-        f"{int(short.sum())} lane(s) have valid windows shorter than the "
+        f"{count} have valid windows shorter than the "
         f"{min_n} observations the {what} needs; their parameters are NaN "
         f"and diagnostics.converged is False", stacklevel=3)
     return jnp.asarray(short)
