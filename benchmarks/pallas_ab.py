@@ -11,7 +11,8 @@ chunk (131072 x 128 f32, ARIMA(2,1,2), override via ``AB_N_SERIES`` /
   tiny data dependence so iterations serialize; scalar-reduced outputs —
   the tunnel's ~150 ms RTT and slow D2H never touch the timing);
 - one in-loop LM iteration, XLA vs Pallas (differenced fits:
-  ``(fit(max_iter=12) - fit(max_iter=2)) / 10`` — fixed costs cancel);
+  ``(fit(max_iter=52) - fit(max_iter=2)) / 50`` — fixed costs cancel,
+  and the wide span keeps the delta far above the tunnel's RTT jitter);
 - the full fit wall time, both paths.
 
 Prints one JSON line per measurement; shares ``bench._resolve_platform``
@@ -63,14 +64,18 @@ def main():
     init = arima.hannan_rissanen_init(p, q, y, True).astype(jnp.float32)
     init = jnp.where(jnp.isfinite(init), init, 0.0)
 
-    def timed(fn, *args, reps=1):
+    def timed(fn, *args, reps=3):
+        """min over reps: the tunnel's RTT jitter (~±10 ms) is strictly
+        additive noise, so the minimum is the cleanest estimator."""
         out = fn(*args)
         jax.tree_util.tree_map(np.asarray, out)      # warm + materialize
-        t0 = time.perf_counter()
+        best = float("inf")
         for _ in range(reps):
+            t0 = time.perf_counter()
             out = fn(*args)
             jax.tree_util.tree_map(np.asarray, out)
-        return (time.perf_counter() - t0) / reps
+            best = min(best, time.perf_counter() - t0)
+        return best
 
     # --- one fused NE pass, chained so fixed costs amortize -----------------
     R = 8
@@ -119,9 +124,12 @@ def main():
                 x0, y, p, q, icpt, max_iter=iters, interpret=interpret)[0]
         return timed(jax.jit(run), init)
 
-    it_xla = (lm_xla(12) - lm_xla(2)) / 10.0
-    it_pl = (lm_pl(12) - lm_pl(2)) / 10.0
-    emit({"metric": f"LM iteration ({S}x{n_obs} f32, differenced 12-2)",
+    # differenced over a 50-iteration span so the delta (~100-350 ms)
+    # dwarfs the tunnel's RTT jitter — the original 12-2 span differenced
+    # two ~200 ms timings under ±10 ms jitter and could go negative
+    it_xla = (lm_xla(52) - lm_xla(2)) / 50.0
+    it_pl = (lm_pl(52) - lm_pl(2)) / 50.0
+    emit({"metric": f"LM iteration ({S}x{n_obs} f32, differenced 52-2)",
           "xla_ms": round(1e3 * it_xla, 3), "pallas_ms": round(1e3 * it_pl, 3),
           "speedup": round(it_xla / it_pl, 2), "unit": "ms/iteration",
           **({"cpu_interpret": True} if interpret else {})})

@@ -102,12 +102,20 @@ def test_fit_report_rejects_diagless():
 
 
 def test_quarantined_lane_marked_not_converged():
-    # one poisoned lane: all-NaN series diverges and is quarantined to the
-    # initial guess; its mask must read non-converged, others unaffected
+    # one poisoned lane: its SSE overflows f64 to inf, the optimizer can
+    # never accept a step, and the lane is quarantined to the (finite)
+    # initial guess; its mask must read non-converged, others unaffected.
+    # (An all-NaN lane no longer exercises quarantine: since the ragged-fit
+    # change it is classified too-short and gets NaN parameters instead —
+    # that contract is pinned by tests/test_ragged.py.)
     rng = np.random.default_rng(11)
     good = rng.normal(size=(3, 80)).cumsum(axis=1)
-    bad = np.full((1, 80), np.nan)
+    bad = np.full((1, 80), 1e200)
+    bad[0, ::2] = -1e200
     panel = jnp.asarray(np.concatenate([good, bad]))
     m = ewma.fit(panel)
     assert np.all(np.isfinite(np.asarray(m.smoothing)))   # quarantine worked
     assert not bool(np.asarray(m.diagnostics.converged)[-1])
+    good_alone = ewma.fit(jnp.asarray(good))
+    np.testing.assert_allclose(np.asarray(m.smoothing)[:3],
+                               np.asarray(good_alone.smoothing))
