@@ -26,6 +26,7 @@ TPU-native design (SURVEY.md §7):
 
 from __future__ import annotations
 
+import os
 import warnings
 from typing import NamedTuple, Optional, Sequence
 
@@ -38,7 +39,7 @@ from ..ops.lag import lag_matvec, lag_stack
 from ..ops.linalg import ols_gram, spd_solve
 from ..ops.ragged import (apply_short_quarantine, ragged_view, short_lanes,
                           step_weights)
-from ..ops.optimize import (minimize_bfgs, minimize_box,
+from ..ops.optimize import (MinimizeResult, minimize_bfgs, minimize_box,
                             minimize_least_squares)
 from ..ops.univariate import (differences_of_order_d,
                               inverse_differences_of_order_d)
@@ -752,6 +753,43 @@ def hannan_rissanen_init(p: int, q: int, y: jnp.ndarray,
     return res.beta
 
 
+def _use_pallas_lm(diffed: jnp.ndarray, nv) -> bool:
+    """Route the css-lm solve through the Pallas fused-NE kernel?
+
+    Default: on the TPU backend, for dense (non-ragged) float32 panels —
+    the production throughput shape, where the kernel's panel-batched LM
+    driver measured 1.57x over the vmapped XLA fused-carry path
+    (``benchmarks/pallas_ab_r04_tpu.jsonl``).  ``STS_PALLAS=0`` disables;
+    ``STS_PALLAS=1`` forces it anywhere (interpreter mode off-TPU — slow,
+    for tests).  Ragged panels (``nv``) and f64 parity fits stay on the
+    XLA path, which supports masks and wide dtypes.
+    """
+    # the kernel driver is (lanes, obs)-shaped and f32: ragged panels,
+    # deeper batch nests, and f64 parity fits keep the XLA path always
+    # (under force too — forcing must never silently degrade an f64 fit)
+    eligible = (nv is None and diffed.ndim <= 2
+                and diffed.dtype == jnp.float32)
+    flag = os.environ.get("STS_PALLAS")
+    if flag is not None and flag not in ("0", "1"):
+        raise ValueError(f"STS_PALLAS must be '0' or '1', got {flag!r}")
+    if flag == "0":
+        return False
+    if flag == "1":
+        return eligible
+    from ..ops.pallas_arma import use_pallas
+    # single-device data only by default: the SPMD partitioner cannot
+    # split a pallas_call over a sharded series axis, so sharded panels
+    # keep the XLA path (force STS_PALLAS=1 from inside a shard_map
+    # region, where each shard is device-local).  A concrete array tells
+    # us its placement directly; a tracer (fit under jit) cannot, so
+    # there the conservative proxy is a single-device process
+    try:
+        on_one_device = len(diffed.sharding.device_set) == 1
+    except Exception:       # noqa: BLE001 — tracers have no sharding
+        on_one_device = jax.device_count() == 1
+    return eligible and use_pallas() and on_one_device
+
+
 def fit(p: int, d: int, q: int, ts: jnp.ndarray,
         include_intercept: bool = True, method: str = "css-lm",
         user_init_params: Optional[jnp.ndarray] = None,
@@ -766,7 +804,11 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
       residuals.  Maximizing the CSS likelihood is exactly minimizing the
       residual sum of squares (the likelihood is monotone in it,
       ``ARIMA.scala:430-445``), and LM stays robust in float32 on TPU where
-      a BFGS line search underflows.
+      a BFGS line search underflows.  On the TPU backend, dense float32
+      panels route through the Pallas fused-NE kernel
+      (``ops.pallas_arma.fit_css_lm``, measured 1.57x over the XLA path);
+      ``STS_PALLAS=0`` restores the XLA path, ``STS_PALLAS=1`` forces the
+      kernel anywhere (interpreter mode off-TPU, for tests).
     - ``"css-cgd"``: batched BFGS on the autodiff gradient (the reference's
       conjugate-gradient analog).
     - ``"css-bobyqa"``: projected gradient with backtracking (the
@@ -876,11 +918,21 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
                                          n_valid=v[0] if v else None)
 
     if method == "css-lm":
-        res = minimize_least_squares(
-            None, init, diffed, *extra,
-            max_iter=max_iter if max_iter is not None else LM_MAX_ITER,
-            normal_eqs_fn=lambda prm, y, *v: _arma_normal_eqs(
-                prm, y, p, q, icpt, n_valid=v[0] if v else None))
+        mi = max_iter if max_iter is not None else LM_MAX_ITER
+        if _use_pallas_lm(diffed, nv):
+            from ..ops.pallas_arma import fit_css_lm
+            x2 = init if init.ndim == 2 else init[None]
+            y2 = diffed if diffed.ndim == 2 else diffed[None]
+            res = MinimizeResult(*fit_css_lm(x2, y2, p, q, icpt,
+                                             max_iter=mi))
+            if init.ndim != 2:
+                res = MinimizeResult(res.x[0], res.fun[0],
+                                     res.converged[0], res.n_iter[0])
+        else:
+            res = minimize_least_squares(
+                None, init, diffed, *extra, max_iter=mi,
+                normal_eqs_fn=lambda prm, y, *v: _arma_normal_eqs(
+                    prm, y, p, q, icpt, n_valid=v[0] if v else None))
     elif method == "css-cgd":
         res = minimize_bfgs(neg_ll, init, diffed, *extra, tol=1e-7,
                             max_iter=max_iter if max_iter is not None else 500)

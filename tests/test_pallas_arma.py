@@ -65,6 +65,64 @@ def test_normal_equations_odd_window_tail():
                                rtol=2e-4, atol=2e-2)
 
 
+def test_fit_routes_through_pallas_when_forced(monkeypatch):
+    # STS_PALLAS=1 must push arima.fit's css-lm solve through the kernel
+    # (interpreter mode here) end-to-end, landing near the XLA path's fit;
+    # STS_PALLAS=0 must keep f64 default numerics (bit-identical XLA path)
+    rng = np.random.default_rng(3)
+    S, n = 24, 80
+    y = _panel(rng, S, n)
+
+    monkeypatch.setenv("STS_PALLAS", "0")
+    m_xla = arima.fit(1, 0, 1, jnp.asarray(y), warn=False)
+
+    # spy on the kernel driver: dtype alone can't prove routing (the XLA
+    # path on an f32 panel also returns f32), so count its invocations
+    calls = []
+    real = pallas_arma.fit_css_lm
+    monkeypatch.setattr(pallas_arma, "fit_css_lm",
+                        lambda *a, **kw: calls.append(1) or real(*a, **kw))
+    monkeypatch.setenv("STS_PALLAS", "1")
+    m_pl = arima.fit(1, 0, 1, jnp.asarray(y), warn=False)
+    assert len(calls) == 1                            # kernel actually ran
+
+    assert m_pl.coefficients.dtype == jnp.float32     # kernel dtype
+    conv = np.asarray(m_xla.diagnostics.converged) \
+        & np.asarray(m_pl.diagnostics.converged)
+    assert conv.mean() > 0.8
+    dx = np.max(np.abs(np.asarray(m_pl.coefficients, np.float64)
+                       - np.asarray(m_xla.coefficients)), axis=1)[conv]
+    assert np.median(dx) < 2e-3 and np.mean(dx < 5e-3) >= 0.9
+
+    # ragged panels must stay on the (mask-aware) XLA path even when
+    # forced — float32, so it is the nv gate (not the dtype gate) that
+    # keeps the kernel out; the spy proves it never ran
+    calls.clear()
+    y_rag = y.copy()                                  # float32
+    y_rag[0, :7] = np.nan
+    m_rag = arima.fit(1, 0, 1, jnp.asarray(y_rag), warn=False)
+    assert not calls
+    assert np.isfinite(np.asarray(m_rag.coefficients)).all()
+    assert m_rag.coefficients.dtype == jnp.float32
+
+    # sibling env flags raise on junk values; so must this one
+    monkeypatch.setenv("STS_PALLAS", "yes")
+    with pytest.raises(ValueError, match="STS_PALLAS"):
+        arima.fit(1, 0, 1, jnp.asarray(y), warn=False)
+    monkeypatch.setenv("STS_PALLAS", "1")
+
+    # an f64 dense fit must stay on the XLA path under force too — the
+    # kernel is f32 and forcing must never silently degrade precision
+    m_64 = arima.fit(1, 0, 1, jnp.asarray(y.astype(np.float64)), warn=False)
+    assert m_64.coefficients.dtype == jnp.float64
+
+    # deeper batch nests (the XLA path vmaps every leading dim) must not
+    # hit the (lanes, obs)-shaped kernel driver
+    y3 = jnp.asarray(y.reshape(2, S // 2, n))
+    m_3d = arima.fit(1, 0, 1, y3, warn=False)
+    assert np.asarray(m_3d.coefficients).shape == (2, S // 2, 3)
+
+
 def test_lm_driver_matches_xla_fit():
     rng = np.random.default_rng(2)
     S, n = 96, 128
