@@ -769,6 +769,12 @@ def _use_pallas_lm(diffed: jnp.ndarray, nv) -> bool:
     # (under force too — forcing must never silently degrade an f64 fit)
     eligible = (nv is None and diffed.ndim <= 2
                 and diffed.dtype == jnp.float32)
+    # the kernel blocks lanes in rows×128 tiles (≥1024 lanes/block):
+    # small panels would pad up to a mostly-empty block — up to
+    # block/S-fold wasted VPU work, and under the grid every candidate
+    # pays it — so the DEFAULT route needs a real panel; STS_PALLAS=1
+    # still forces small shapes (correctness tests)
+    big_enough = diffed.ndim == 2 and diffed.shape[0] >= 1024
     flag = os.environ.get("STS_PALLAS")
     if flag is not None and flag not in ("0", "1"):
         raise ValueError(f"STS_PALLAS must be '0' or '1', got {flag!r}")
@@ -787,7 +793,7 @@ def _use_pallas_lm(diffed: jnp.ndarray, nv) -> bool:
         on_one_device = len(diffed.sharding.device_set) == 1
     except Exception:       # noqa: BLE001 — tracers have no sharding
         on_one_device = jax.device_count() == 1
-    return eligible and use_pallas() and on_one_device
+    return eligible and big_enough and use_pallas() and on_one_device
 
 
 def fit(p: int, d: int, q: int, ts: jnp.ndarray,
@@ -805,10 +811,12 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
       residual sum of squares (the likelihood is monotone in it,
       ``ARIMA.scala:430-445``), and LM stays robust in float32 on TPU where
       a BFGS line search underflows.  On the TPU backend, dense float32
-      panels route through the Pallas fused-NE kernel
-      (``ops.pallas_arma.fit_css_lm``, measured 1.57x over the XLA path);
-      ``STS_PALLAS=0`` restores the XLA path, ``STS_PALLAS=1`` forces the
-      kernel anywhere (interpreter mode off-TPU, for tests).
+      panels of >= 1024 series on one device route through the Pallas
+      fused-NE kernel (``ops.pallas_arma.fit_css_lm``, measured 1.57x
+      over the XLA path; smaller panels would mostly pad the kernel's
+      1024-lane blocks, so they keep the XLA path); ``STS_PALLAS=0``
+      restores the XLA path, ``STS_PALLAS=1`` forces the kernel anywhere
+      (interpreter mode off-TPU, for tests).
     - ``"css-cgd"``: batched BFGS on the autodiff gradient (the reference's
       conjugate-gradient analog).
     - ``"css-bobyqa"``: projected gradient with backtracking (the
@@ -1237,7 +1245,8 @@ class PanelARIMAFit(NamedTuple):
 def _auto_fit_panel_kernel(values: jnp.ndarray, masks_base: jnp.ndarray,
                            pq_arr: jnp.ndarray, crit: float,
                            max_p: int, max_q: int, max_d: int,
-                           max_iter: int, screen_iter: int) -> tuple:
+                           max_iter: int, screen_iter: int,
+                           use_pallas_lm: bool = False) -> tuple:
     """Fully fused panel auto-fit — ONE dispatch for the whole search:
     batched KPSS d-selection, per-series differencing (a gather from the
     size-preserving diff stack), Hannan-Rissanen init, one batched LM solve
@@ -1316,11 +1325,28 @@ def _auto_fit_panel_kernel(values: jnp.ndarray, masks_base: jnp.ndarray,
     # is batch-linear, so screen(C·S·s) + refine(S·r) beats grid(C·S·r)
     # ~1.6x at the default grid while the final coefficients get a
     # longer, warm-started polish than the old single stage gave them.
-    y_bc = jnp.broadcast_to(diffed, (C, S, n))
-    res = minimize_least_squares(
-        None, init, y_bc, masks, max_iter=screen_iter,
-        normal_eqs_fn=lambda prm, y, mask: _arma_normal_eqs(
-            prm, y, max_p, max_q, 1, mask=mask))
+    def _grid_lm(x0, y, mask, iters):
+        """One masked-LM dispatch for the grid: Pallas driver when the
+        (statically decided) gate allows — a (C, S, k) x0 flattens
+        candidate-major over the one shared panel, and the kernel
+        re-reads panel blocks per candidate rather than materializing C
+        copies — XLA fused-carry otherwise."""
+        if use_pallas_lm:
+            from ..ops.pallas_arma import fit_css_lm
+            lead = x0.shape[:-1]
+            flat = fit_css_lm(x0.reshape(-1, k), y, max_p, max_q, 1,
+                              max_iter=iters, mask=mask.reshape(-1, k))
+            return MinimizeResult(flat[0].reshape(*lead, k),
+                                  flat[1].reshape(lead),
+                                  flat[2].reshape(lead),
+                                  flat[3].reshape(lead))
+        y_bc = jnp.broadcast_to(y, (*x0.shape[:-1], y.shape[-1]))
+        return minimize_least_squares(
+            None, x0, y_bc, mask, max_iter=iters,
+            normal_eqs_fn=lambda prm, yy, mm: _arma_normal_eqs(
+                prm, yy, max_p, max_q, 1, mask=mm))
+
+    res = _grid_lm(init, diffed, masks, screen_iter)
     lane_ok = jnp.all(jnp.isfinite(res.x), axis=-1, keepdims=True)
     params = jnp.where(lane_ok, res.x, init) * masks
 
@@ -1329,7 +1355,7 @@ def _auto_fit_panel_kernel(values: jnp.ndarray, masks_base: jnp.ndarray,
     # sigma² = sse/n', ll = -(n'/2)(log(2π·sse/n') + 1).  Quarantined
     # lanes (x reset to init) keep res.fun's value, but their aic is
     # non-finite or their params screen out below, same as before.
-    n_eff = y_bc.shape[-1]
+    n_eff = n
     neg_ll = 0.5 * n_eff * (jnp.log(2.0 * jnp.pi * res.fun / n_eff) + 1.0)
 
     # admissibility screen + AIC argmin, all on device (no host round-trip)
@@ -1362,10 +1388,7 @@ def _auto_fit_panel_kernel(values: jnp.ndarray, masks_base: jnp.ndarray,
     refine_iter = max_iter - screen_iter
     if refine_iter > 0:
         best_masks = masks[best, sel]                        # (S, k)
-        res_r = minimize_least_squares(
-            None, coefs, diffed, best_masks, max_iter=refine_iter,
-            normal_eqs_fn=lambda prm, y, mask: _arma_normal_eqs(
-                prm, y, max_p, max_q, 1, mask=mask))
+        res_r = _grid_lm(coefs, diffed, best_masks, refine_iter)
         refined = res_r.x * best_masks
         keep = jnp.all(jnp.isfinite(refined), axis=-1)
         keep &= _step_down_stationary(refined[:, 1:1 + max_p],
@@ -1426,11 +1449,18 @@ def auto_fit_panel(values: jnp.ndarray, max_p: int = 5, max_d: int = 2,
         masks[ci, 1 + max_p:1 + max_p + q] = 1.0
 
     crit = KPSS_CONSTANT_CRITICAL_VALUES[KPSS_SIGNIFICANCE]
+    # the Pallas-vs-XLA routing decision must be a STATIC jit argument:
+    # decided inside the trace it would be baked into the cached
+    # executable and STS_PALLAS toggles silently ignored on same-shape
+    # calls (jit caches key on function + avals + statics, not env).
+    # Deciding here also reads the CONCRETE panel's sharding, which the
+    # in-trace gate cannot
+    use_pl = _use_pallas_lm(values, None)
     kernel = jax.jit(_auto_fit_panel_kernel,
-                     static_argnums=(4, 5, 6, 7, 8))
+                     static_argnums=(4, 5, 6, 7, 8, 9))
     orders, coefs, aic, d_ok, screen_capped = kernel(
         values, jnp.asarray(masks), jnp.asarray(pq, dtype=np.int32),
-        float(crit), max_p, max_q, max_d, max_iter, screen_iter)
+        float(crit), max_p, max_q, max_d, max_iter, screen_iter, use_pl)
 
     # advisor r3: the reduced screen budget can change order selection on
     # slow-converging panels; surface it when it plausibly did

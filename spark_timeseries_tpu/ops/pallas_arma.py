@@ -169,16 +169,21 @@ def _ne_kernel(p: int, q: int, icpt: int, n_obs: int,
 
 @functools.lru_cache(maxsize=None)
 def _build_call(p: int, q: int, icpt: int, n_obs: int, n_blocks: int,
-                rows: int, interpret: bool):
+                rows: int, interpret: bool, y_blocks: int | None = None):
+    """``y_blocks`` < ``n_blocks`` re-reads the same panel blocks for
+    several parameter blocks (candidate-major grid lanes over one shared
+    panel): param/out block ``i`` pairs with y block ``i % y_blocks``."""
     k = icpt + p + q
     n_out = 1 + len(_triu_pairs(k)) + k
     kernel = functools.partial(_ne_kernel, p, q, icpt, n_obs)
+    y_map = (lambda i: (0, i % y_blocks, 0, 0)) if y_blocks \
+        else (lambda i: (0, i, 0, 0))
     return pl.pallas_call(
         kernel,
         grid=(n_blocks,),
         in_specs=[
             pl.BlockSpec((k, 1, rows, LANES), lambda i: (0, i, 0, 0)),
-            pl.BlockSpec((n_obs, 1, rows, LANES), lambda i: (0, i, 0, 0)),
+            pl.BlockSpec((n_obs, 1, rows, LANES), y_map),
         ],
         out_specs=pl.BlockSpec((n_out, 1, rows, LANES),
                                lambda i: (0, i, 0, 0)),
@@ -202,10 +207,17 @@ def _blocked(x: jnp.ndarray, n_series: int, rows: int):
 
 def normal_equations(params: jnp.ndarray, y: jnp.ndarray,
                      p: int, q: int, icpt: int,
+                     mask: jnp.ndarray | None = None,
                      interpret: bool | None = None):
     """Batched fused ``(JᵀJ (S, k, k), Jᵀr (S, k), sse (S,))`` for the ARMA
     CSS residuals — drop-in numerics for ``arima._arma_normal_eqs`` over a
-    whole panel.  ``params (S, k)``, ``y (S, n)``, float32."""
+    whole panel.  ``params (S, k)``, ``y (S, n)``, float32.
+
+    ``mask`` (S, k) reproduces the masked-residual objective
+    ``r(x ∘ mask)`` exactly as the XLA kernel does
+    (``arima._arma_normal_eqs``): the recurrence runs at the masked
+    point and the chain-rule factor is an outer-product scale on the
+    outputs — nothing inside the Pallas kernel changes."""
     if interpret is None:
         interpret = not use_pallas()
     k = icpt + p + q
@@ -218,16 +230,27 @@ def normal_equations(params: jnp.ndarray, y: jnp.ndarray,
             f"max(p, q) = {max(p, q)} observations, got {n_obs}")
     rows = _block_rows(S)
     y_b, n_blocks = _blocked(y.astype(jnp.float32), S, rows)
-    out = _ne_from_blocked(params, y_b, S, rows, n_blocks, p, q, icpt,
-                           n_obs, interpret)
-    return out
+    if mask is not None:
+        mask = mask.astype(jnp.float32)
+        params = params * mask
+    out = _ne_from_blocked(params, y_b, S, rows, n_blocks, p, q,
+                           icpt, n_obs, interpret)
+    return _masked_ne(*out, mask) if mask is not None else out
+
+
+def _masked_ne(jtj, jtr, sse, mask):
+    """Chain-rule factor of the masked objective ``r(x ∘ mask)`` — the
+    single source of truth matching ``arima._arma_normal_eqs``'s
+    post-scale (the recurrence itself runs at the masked point)."""
+    return (jtj * mask[:, :, None] * mask[:, None, :], jtr * mask, sse)
 
 
 def _ne_from_blocked(params, y_b, S, rows, n_blocks, p, q, icpt, n_obs,
-                     interpret):
+                     interpret, y_blocks=None):
     k = icpt + p + q
     params_b, _ = _blocked(params.astype(jnp.float32), S, rows)
-    call = _build_call(p, q, icpt, n_obs, n_blocks, rows, interpret)
+    call = _build_call(p, q, icpt, n_obs, n_blocks, rows, interpret,
+                       y_blocks)
     out = call(params_b, y_b)                     # (n_out, nb, rows, 128)
     out = out.reshape(out.shape[0], -1)[:, :S].T  # (S, n_out)
     pairs = _triu_pairs(k)
@@ -244,6 +267,7 @@ def _ne_from_blocked(params, y_b, S, rows, n_blocks, p, q, icpt, n_obs,
 
 def fit_css_lm(x0: jnp.ndarray, y: jnp.ndarray, p: int, q: int, icpt: int,
                tol: float = 1e-6, max_iter: int = 50,
+               mask: jnp.ndarray | None = None,
                interpret: bool | None = None):
     """Panel-batched Levenberg-Marquardt on the CSS residuals with the
     normal equations built by the Pallas kernel.
@@ -254,23 +278,70 @@ def fit_css_lm(x0: jnp.ndarray, y: jnp.ndarray, p: int, q: int, icpt: int,
     array ops instead of ``vmap`` — one kernel dispatch per iteration for
     the whole panel, with the small SPD solves on the unrolled Cholesky
     path.  Returns ``(x, fun, converged, n_iter)`` with per-lane shapes.
+
+    ``mask`` (S, k) freezes parameter slots per lane (the fused
+    auto-ARIMA grid's candidate masks): a frozen slot's Jacobian column
+    is zeroed, so its normal-equation step is ``0 / 1e-12 = 0`` and the
+    slot never moves — identical to the XLA grid solver's behavior.
+
+    ``x0`` may carry MORE lanes than ``y`` — ``x0 (C·S, k)``
+    candidate-major over ``y (S, n)`` (the fused grid's shape): the
+    kernel re-reads the one blocked panel for every candidate
+    (param/out block ``i`` pairs with y block ``i % y_blocks``) instead
+    of materializing ``C`` panel copies.  When the lane block size does
+    not divide ``S``, every candidate's lane run is padded up to the
+    block boundary (padded lanes start ``done`` and are sliced off the
+    results) — the panel is never tiled.
     """
     if interpret is None:
         interpret = not use_pallas()
     x0 = x0.astype(jnp.float32)
+    if mask is not None:
+        mask = mask.astype(jnp.float32)
+        x0 = x0 * mask
     S, k = x0.shape
-    n_obs = y.shape[-1]
+    S_y, n_obs = y.shape
     if n_obs <= max(p, q):
         raise ValueError(
             f"series too short for the CSS window: need more than "
             f"max(p, q) = {max(p, q)} observations, got {n_obs}")
-    rows = _block_rows(S)
-    y_b, n_blocks = _blocked(y.astype(jnp.float32), S, rows)
+    y_blocks = None
+    n_real, pad = S, 0
+    if S != S_y:
+        if S % S_y:
+            raise ValueError(
+                f"x0 lane count {S} is not a multiple of the panel's "
+                f"{S_y} series")
+        C = S // S_y
+        # block by the PANEL's lane count, not the grid's: candidate
+        # runs pad to the block boundary, so smaller blocks mean less
+        # padding waste on panels that don't align
+        rows = _block_rows(S_y)
+        block = rows * LANES
+        pad = (-S_y) % block
+        if pad:
+            # align each candidate's lane run to the block boundary so
+            # one blocked panel serves all candidates via the modulo map
+            y = jnp.pad(y, ((0, pad), (0, 0)))
+            x0 = jnp.pad(x0.reshape(C, S_y, k),
+                         ((0, 0), (0, pad), (0, 0))).reshape(-1, k)
+            if mask is not None:
+                mask = jnp.pad(mask.reshape(C, S_y, k),
+                               ((0, 0), (0, pad), (0, 0))).reshape(-1, k)
+            S = C * (S_y + pad)
+        y_b, y_blocks = _blocked(y.astype(jnp.float32), S_y + pad, rows)
+        n_blocks = S // block
+    else:
+        rows = _block_rows(S)
+        y_b, n_blocks = _blocked(y.astype(jnp.float32), S, rows)
     eye = jnp.eye(k, dtype=jnp.float32)
 
     def ne(x):
-        return _ne_from_blocked(x, y_b, S, rows, n_blocks, p, q, icpt,
-                                n_obs, interpret)
+        if mask is not None:
+            x = x * mask
+        out = _ne_from_blocked(x, y_b, S, rows, n_blocks, p, q,
+                               icpt, n_obs, interpret, y_blocks)
+        return _masked_ne(*out, mask) if mask is not None else out
 
     def body(state):
         x, f, jtj, jtr, lam, it_lanes, it, done = state
@@ -310,9 +381,20 @@ def fit_css_lm(x0: jnp.ndarray, y: jnp.ndarray, p: int, q: int, icpt: int,
 
     jtj0, jtr0, f0 = ne(x0)
     lam0 = jnp.full((S,), 1e-3, jnp.float32)
+    # block-alignment padding lanes start done: they must neither hold
+    # the loop open nor count iterations
+    done0 = (jnp.arange(S) % (S_y + pad) >= S_y) if pad \
+        else jnp.zeros((S,), bool)
     state = jax.lax.while_loop(
         cond, body,
         (x0, f0, jtj0, jtr0, lam0, jnp.zeros((S,), jnp.int32),
-         jnp.asarray(0), jnp.zeros((S,), bool)))
+         jnp.asarray(0), done0))
     x, f, _, _, _, it_lanes, _, done = state
+    if pad:
+        C = S // (S_y + pad)
+
+        def unpad(a):
+            return a.reshape(C, S_y + pad, *a.shape[1:])[:, :S_y] \
+                .reshape(n_real, *a.shape[1:])
+        x, f, done, it_lanes = (unpad(a) for a in (x, f, done, it_lanes))
     return x, f, done, it_lanes

@@ -123,6 +123,117 @@ def test_fit_routes_through_pallas_when_forced(monkeypatch):
     assert np.asarray(m_3d.coefficients).shape == (2, S // 2, 3)
 
 
+def test_masked_normal_equations_match_xla_kernel():
+    # per-lane candidate masks (the fused auto-ARIMA grid's shape):
+    # frozen slots must zero out of JtJ/Jtr exactly as the XLA kernel's
+    # chain-rule outer-product scale does
+    rng = np.random.default_rng(4)
+    S, n = 96, 72
+    p = q = 2
+    k = 1 + p + q
+    y = _panel(rng, S, n)
+    params = (0.1 * rng.normal(size=(S, k))).astype(np.float32)
+    mask = (rng.random((S, k)) < 0.6).astype(np.float32)
+
+    jtj, jtr, sse = pallas_arma.normal_equations(
+        jnp.asarray(params), jnp.asarray(y), p, q, 1,
+        mask=jnp.asarray(mask), interpret=True)
+    ref = jax.vmap(lambda prm, yy, mm: arima._arma_normal_eqs(
+        prm, yy, p, q, 1, mask=mm))(
+        jnp.asarray(params), jnp.asarray(y), jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(jtj), np.asarray(ref[0]),
+                               rtol=2e-4, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(jtr), np.asarray(ref[1]),
+                               rtol=2e-4, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(sse), np.asarray(ref[2]),
+                               rtol=2e-4, atol=2e-2)
+    # frozen slots never move in the driver either
+    x, _, _, _ = pallas_arma.fit_css_lm(
+        jnp.asarray(params), jnp.asarray(y), p, q, 1, max_iter=5,
+        mask=jnp.asarray(mask), interpret=True)
+    assert np.all(np.asarray(x)[mask == 0.0] == 0.0)
+
+
+def test_shared_panel_candidate_lanes_match_tiled():
+    # x0 with C*S lanes over a (S, n) panel: when the lane block divides
+    # S the driver re-reads the one blocked panel per candidate (y_blocks
+    # modulo map) — results must equal the explicit C-fold tile
+    rng = np.random.default_rng(8)
+    S_y, n, C = 8192, 24, 2          # block = 64*128 = 8192 divides S_y
+    p = q = 1
+    k = 1 + p + q
+    y = _panel(rng, 64, n)
+    y = jnp.asarray(np.tile(y, (S_y // 64, 1)))
+    x0 = jnp.asarray((0.1 * rng.normal(size=(C * S_y, k)))
+                     .astype(np.float32))
+    mask = jnp.asarray((rng.random((C * S_y, k)) < 0.7)
+                       .astype(np.float32))
+
+    shared = pallas_arma.fit_css_lm(x0, y, p, q, 1, max_iter=3,
+                                    mask=mask, interpret=True)
+    tiled = pallas_arma.fit_css_lm(x0, jnp.tile(y, (C, 1)), p, q, 1,
+                                   max_iter=3, mask=mask, interpret=True)
+    for a, b in zip(shared, tiled):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_shared_panel_pad_alignment_matches_per_candidate():
+    # series count NOT a multiple of the lane block: each candidate's
+    # lane run is padded to the block boundary (never tiling the panel);
+    # results must equal fitting each candidate separately
+    rng = np.random.default_rng(12)
+    S_y, n, C = 100, 32, 3
+    p = q = 1
+    k = 1 + p + q
+    y = jnp.asarray(_panel(rng, S_y, n))
+    x0 = jnp.asarray((0.1 * rng.normal(size=(C * S_y, k)))
+                     .astype(np.float32))
+    mask = jnp.asarray((rng.random((C * S_y, k)) < 0.7)
+                       .astype(np.float32))
+
+    joint = pallas_arma.fit_css_lm(x0, y, p, q, 1, max_iter=4,
+                                   mask=mask, interpret=True)
+    for c in range(C):
+        sl = slice(c * S_y, (c + 1) * S_y)
+        solo = pallas_arma.fit_css_lm(x0[sl], y, p, q, 1, max_iter=4,
+                                      mask=mask[sl], interpret=True)
+        for a, b in zip(joint, solo):
+            np.testing.assert_allclose(np.asarray(a)[sl], np.asarray(b),
+                                       rtol=1e-6, atol=1e-6)
+
+
+def test_auto_fit_panel_forced_pallas_matches_xla(monkeypatch):
+    # the fused grid's screen+refine stages must select the same orders
+    # and land on close coefficients through the kernel driver.  The
+    # routing decision is a STATIC jit argument (baked into the trace it
+    # would make same-shape toggles silently reuse the first executable),
+    # and the spy proves the kernel genuinely ran on the forced call
+    rng = np.random.default_rng(6)
+    y = _panel(rng, 24, 80)
+
+    calls = []
+    real = pallas_arma.fit_css_lm
+    monkeypatch.setattr(pallas_arma, "fit_css_lm",
+                        lambda *a, **kw: calls.append(1) or real(*a, **kw))
+
+    monkeypatch.setenv("STS_PALLAS", "0")
+    r_xla = arima.auto_fit_panel(jnp.asarray(y), max_p=1, max_d=1,
+                                 max_q=1, max_iter=30)
+    assert not calls                        # XLA run never touches it
+    monkeypatch.setenv("STS_PALLAS", "1")
+    r_pl = arima.auto_fit_panel(jnp.asarray(y), max_p=1, max_d=1,
+                                max_q=1, max_iter=30)
+    assert len(calls) == 2                  # screen + refine stages
+
+    same = np.all(np.asarray(r_xla.orders) == np.asarray(r_pl.orders),
+                  axis=1)
+    assert same.mean() >= 0.85          # f32 AIC ties can flip a lane
+    dx = np.max(np.abs(np.asarray(r_xla.coefficients, np.float64)
+                       - np.asarray(r_pl.coefficients, np.float64)),
+                axis=1)[same]
+    assert np.median(dx) < 5e-3
+
+
 def test_lm_driver_matches_xla_fit():
     rng = np.random.default_rng(2)
     S, n = 96, 128
