@@ -145,6 +145,54 @@ def main():
           "unit": "s/fit",
           **({"cpu_interpret": True} if interpret else {})})
 
+    # --- Holt-Winters box fit: Pallas driver vs vmapped minimize_box --------
+    # (the routing default in holt_winters.fit is OFF until this line
+    # shows a win on the real chip — flip default_on with the number)
+    from spark_timeseries_tpu.models.holt_winters import (
+        _hw_sse_value_and_grad)
+    from spark_timeseries_tpu.ops import pallas_hw
+    from spark_timeseries_tpu.ops.optimize import minimize_box
+
+    S_hw = int(os.environ.get("AB_HW_SERIES", "4096" if on_tpu else "256"))
+    n_hw = int(os.environ.get("AB_HW_OBS", "120" if on_tpu else "48"))
+    period = 12 if on_tpu else 8
+    t_ax = np.arange(n_hw)
+    hw_y = (10.0 + 0.05 * t_ax + 2.0 * np.sin(2 * np.pi * t_ax / period)
+            )[None, :] + 0.3 * np.random.default_rng(0).normal(
+        size=(S_hw, n_hw))
+    hw_y = jnp.asarray(hw_y, jnp.float32)
+    hw_x0 = jnp.broadcast_to(jnp.asarray([0.3, 0.1, 0.1], jnp.float32),
+                             (S_hw, 3))
+    hw_iter = 200
+
+    def hw_xla():
+        def run(x0, y):
+            return minimize_box(
+                lambda p, s: _hw_sse_value_and_grad(p, s, period,
+                                                    "additive")[0],
+                x0, 0.0, 1.0, y, tol=1e-6, max_iter=hw_iter,
+                value_and_grad_fn=lambda p, s: _hw_sse_value_and_grad(
+                    p, s, period, "additive")).x
+        return timed(jax.jit(run), hw_x0, hw_y)
+
+    def hw_pl():
+        def run(x0, y):
+            return pallas_hw.fit_box(x0, y, period, "additive", tol=1e-6,
+                                     max_iter=hw_iter,
+                                     interpret=interpret)[0]
+        return timed(jax.jit(run), hw_x0, hw_y)
+
+    t_hw_xla = hw_xla()
+    t_hw_pl = hw_pl()
+    emit({"metric": f"HoltWinters additive box fit ({S_hw}x{n_hw} f32, "
+                    f"period={period}, max_iter={hw_iter})",
+          "xla_s": round(t_hw_xla, 3), "pallas_s": round(t_hw_pl, 3),
+          "speedup": round(t_hw_xla / t_hw_pl, 2),
+          "xla_series_per_sec": round(S_hw / t_hw_xla, 1),
+          "pallas_series_per_sec": round(S_hw / t_hw_pl, 1),
+          "unit": "s/fit",
+          **({"cpu_interpret": True} if interpret else {})})
+
 
 if __name__ == "__main__":
     main()

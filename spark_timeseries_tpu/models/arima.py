@@ -756,44 +756,13 @@ def hannan_rissanen_init(p: int, q: int, y: jnp.ndarray,
 def _use_pallas_lm(diffed: jnp.ndarray, nv) -> bool:
     """Route the css-lm solve through the Pallas fused-NE kernel?
 
-    Default: on the TPU backend, for dense (non-ragged) float32 panels —
-    the production throughput shape, where the kernel's panel-batched LM
-    driver measured 1.57x over the vmapped XLA fused-carry path
-    (``benchmarks/pallas_ab_r04_tpu.jsonl``).  ``STS_PALLAS=0`` disables;
-    ``STS_PALLAS=1`` forces it anywhere (interpreter mode off-TPU — slow,
-    for tests).  Ragged panels (``nv``) and f64 parity fits stay on the
-    XLA path, which supports masks and wide dtypes.
+    Gate semantics live in :func:`ops.pallas_arma.route_panel` (shared
+    with the Holt-Winters driver); the measured win here is 1.57x over
+    the vmapped XLA fused-carry path
+    (``benchmarks/pallas_ab_r04_tpu.jsonl``).
     """
-    # the kernel driver is (lanes, obs)-shaped and f32: ragged panels,
-    # deeper batch nests, and f64 parity fits keep the XLA path always
-    # (under force too — forcing must never silently degrade an f64 fit)
-    eligible = (nv is None and diffed.ndim <= 2
-                and diffed.dtype == jnp.float32)
-    # the kernel blocks lanes in rows×128 tiles (≥1024 lanes/block):
-    # small panels would pad up to a mostly-empty block — up to
-    # block/S-fold wasted VPU work, and under the grid every candidate
-    # pays it — so the DEFAULT route needs a real panel; STS_PALLAS=1
-    # still forces small shapes (correctness tests)
-    big_enough = diffed.ndim == 2 and diffed.shape[0] >= 1024
-    flag = os.environ.get("STS_PALLAS")
-    if flag is not None and flag not in ("0", "1"):
-        raise ValueError(f"STS_PALLAS must be '0' or '1', got {flag!r}")
-    if flag == "0":
-        return False
-    if flag == "1":
-        return eligible
-    from ..ops.pallas_arma import use_pallas
-    # single-device data only by default: the SPMD partitioner cannot
-    # split a pallas_call over a sharded series axis, so sharded panels
-    # keep the XLA path (force STS_PALLAS=1 from inside a shard_map
-    # region, where each shard is device-local).  A concrete array tells
-    # us its placement directly; a tracer (fit under jit) cannot, so
-    # there the conservative proxy is a single-device process
-    try:
-        on_one_device = len(diffed.sharding.device_set) == 1
-    except Exception:       # noqa: BLE001 — tracers have no sharding
-        on_one_device = jax.device_count() == 1
-    return eligible and big_enough and use_pallas() and on_one_device
+    from ..ops.pallas_arma import route_panel
+    return route_panel(diffed, nv, allow_1d=True)
 
 
 def fit(p: int, d: int, q: int, ts: jnp.ndarray,

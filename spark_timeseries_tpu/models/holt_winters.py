@@ -23,7 +23,7 @@ import numpy as np
 from jax import lax
 
 from ..ops.lag import lag_matrix
-from ..ops.optimize import minimize_box
+from ..ops.optimize import MinimizeResult, minimize_box
 from ..ops.ragged import (apply_short_quarantine, ragged_view, short_lanes,
                           step_weights)
 from .base import (FitDiagnostics, diagnostics_from, normal_quantile,
@@ -391,8 +391,22 @@ def fit(ts: jnp.ndarray, period: int, model_type: str = "additive",
     vag = value_and_grad if fused else None
 
     x0 = jnp.broadcast_to(jnp.asarray(init, ts.dtype), (*ts.shape[:-1], 3))
-    res = minimize_box(objective, x0, 0.0, 1.0, ts, *extra, tol=tol,
-                       max_iter=max_iter, value_and_grad_fn=vag)
+    # Pallas driver (ops/pallas_hw.py): VMEM-resident carry + batched
+    # backtracking, one kernel dispatch per line-search trial.  OPT-IN
+    # via its OWN flag (STS_PALLAS_HW=1 — so forcing the measured ARIMA
+    # kernel with STS_PALLAS=1 never opts into this unmeasured one)
+    # until benchmarks/pallas_ab.py's HW A/B measures a win on the real
+    # chip; flip default_on=True and move to the shared flag with the
+    # measured number when it lands.
+    from ..ops.pallas_arma import route_panel
+    if route_panel(ts, obs_len, default_on=False,
+                   flag_env="STS_PALLAS_HW"):
+        from ..ops import pallas_hw
+        res = MinimizeResult(*pallas_hw.fit_box(
+            x0, ts, period, model_type, tol=tol, max_iter=max_iter))
+    else:
+        res = minimize_box(objective, x0, 0.0, 1.0, ts, *extra, tol=tol,
+                           max_iter=max_iter, value_and_grad_fn=vag)
     ok = jnp.all(jnp.isfinite(res.x), axis=-1, keepdims=True)
     p = jnp.where(ok, res.x, x0)
     conv = diagnostics_from(res, ok)

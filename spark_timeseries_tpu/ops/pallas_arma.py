@@ -35,6 +35,7 @@ TPU.  Use :func:`use_pallas` to gate call sites by backend.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Tuple
 
 import jax
@@ -52,9 +53,65 @@ def use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def route_panel(y: jnp.ndarray, n_valid=None, allow_1d: bool = False,
+                min_lanes: int = 1024, default_on: bool = True,
+                flag_env: str = "STS_PALLAS") -> bool:
+    """Shared default-routing gate for the Pallas fit drivers.
+
+    The kernels are (lanes, obs)-shaped and f32: ragged panels
+    (``n_valid``), deeper batch nests, and f64 parity fits always keep
+    the XLA path — under force too (forcing must never silently degrade
+    an f64 fit).  The default route additionally needs a real panel
+    (>= ``min_lanes`` series — smaller ones would mostly pad the
+    1024-lane blocks), the TPU backend, and single-device data (the SPMD
+    partitioner cannot split a pallas_call over a sharded series axis; a
+    concrete array tells us its placement, a tracer falls back to the
+    single-device-process proxy).  ``STS_PALLAS=0`` disables, ``=1``
+    forces any eligible shape (interpreter mode off-TPU, for tests).
+    ``default_on=False`` keeps a driver opt-in (force-only) until its
+    win is measured on the real chip; such a driver names its OWN
+    ``flag_env`` so forcing it is a separate decision from forcing the
+    measured ones (a user setting ``STS_PALLAS=1`` for the documented
+    shard_map workflow must not silently opt into unmeasured drivers).
+    """
+    nd_ok = y.ndim == 2 or (allow_1d and y.ndim == 1)
+    eligible = n_valid is None and nd_ok and y.dtype == jnp.float32
+    flag = os.environ.get(flag_env)
+    if flag is not None and flag not in ("0", "1"):
+        raise ValueError(f"{flag_env} must be '0' or '1', got {flag!r}")
+    if flag == "0":
+        return False
+    if flag == "1":
+        return eligible
+    if not default_on:
+        return False
+    big_enough = y.ndim == 2 and y.shape[0] >= min_lanes
+    try:
+        on_one_device = len(y.sharding.device_set) == 1
+    except Exception:       # noqa: BLE001 — tracers have no sharding
+        on_one_device = jax.device_count() == 1
+    return eligible and big_enough and use_pallas() and on_one_device
+
+
 def _block_rows(n_series: int) -> int:
     rows = -(-n_series // LANES)
     return max(8, min(MAX_ROWS, ((rows + 7) // 8) * 8))
+
+
+def _grid_rows(s_y: int) -> int:
+    """Block rows for the shared-panel grid: every candidate's lane run
+    pads to the block boundary, so pick the row count that minimizes
+    that padding (largest rows on ties — fewer grid steps).  With the
+    maximal block an unaligned panel just over a block multiple would
+    waste up to ~2x kernel compute per candidate, more than the
+    measured Pallas win."""
+    best_rows, best_pad = 8, None
+    for r in range(8, MAX_ROWS + 1, 8):
+        pad = (-s_y) % (r * LANES)
+        if best_pad is None or pad < best_pad or \
+                (pad == best_pad and r > best_rows):
+            best_rows, best_pad = r, pad
+    return best_rows
 
 
 def _triu_pairs(k: int):
@@ -313,10 +370,10 @@ def fit_css_lm(x0: jnp.ndarray, y: jnp.ndarray, p: int, q: int, icpt: int,
                 f"x0 lane count {S} is not a multiple of the panel's "
                 f"{S_y} series")
         C = S // S_y
-        # block by the PANEL's lane count, not the grid's: candidate
-        # runs pad to the block boundary, so smaller blocks mean less
-        # padding waste on panels that don't align
-        rows = _block_rows(S_y)
+        # block by the PANEL's alignment, not the grid's size: candidate
+        # runs pad to the block boundary, so choose the row count that
+        # minimizes that padding
+        rows = _grid_rows(S_y)
         block = rows * LANES
         pad = (-S_y) % block
         if pad:

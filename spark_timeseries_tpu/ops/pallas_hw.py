@@ -1,0 +1,284 @@
+"""Pallas TPU kernel for the Holt-Winters fused SSE value-and-grad.
+
+The slowest model family in the suite is Holt-Winters: every projected-
+gradient trial evaluates ``models.holt_winters._hw_sse_value_and_grad``
+(ref recurrence ``/root/reference/src/main/scala/com/cloudera/sparkts/models/HoltWinters.scala:180-226``;
+SSE objective ``:66-83``), a ``lax.scan`` whose per-lane carry — level,
+trend, the period-``m`` season ring, their three tangents each, and the
+(sse, grad) accumulators, ``4m + 12`` floats — streams through HBM every
+step group exactly like the pre-Pallas ARMA pass did.  This kernel keeps
+that carry in VMEM for the whole time axis, the architecture proven by
+``ops/pallas_arma.py`` (1.57-2.23x measured on the ARMA fit):
+
+- lanes block as ``(rows, 128)`` tiles with the full time axis resident;
+- time advances in 16-step static-unrolled chunks (every series read a
+  static VMEM index);
+- the season rings are Python lists of VMEM values, rotated statically.
+
+:func:`fit_box` is the panel-batched projected-gradient driver mirroring
+``ops.optimize._minimize_box_one``'s state machine (Armijo backtracking
+on the projected-gradient arc, per-lane convergence) in plain array ops
+— one kernel dispatch per line-search trial for the whole panel, where
+the vmapped driver pays XLA's batched while-in-while carry masking.
+
+Numerics are pinned to ``_hw_sse_value_and_grad`` (itself pinned to
+autodiff) by ``tests/test_pallas_hw.py``; the routing default stays OFF
+until ``benchmarks/pallas_ab.py``'s HW A/B measures a win on the real
+chip (the build-measure-then-ship discipline from rounds 3-4).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .pallas_arma import (LANES, TIME_CHUNK, _block_rows, _blocked,
+                          use_pallas)
+
+
+def _hw_kernel(m: int, additive: bool, n_steps: int,
+               params_ref, init_ref, y_ref, out_ref):
+    """One lane block.  ``params (3, rows, 128)`` = (α, β, γ);
+    ``init (2+m, rows, 128)`` = (level0, trend0, season0[m]);
+    ``y (n_steps, rows, 128)`` = series[period:];
+    ``out (4, rows, 128)`` = (sse, dsse/dα, dsse/dβ, dsse/dγ).
+
+    Step recurrence and tangents exactly as
+    ``models.holt_winters._hw_sse_value_and_grad`` (dense path).
+    """
+    a, b, g = params_ref[0], params_ref[1], params_ref[2]
+    zero = a * 0.0
+    one_m_a = 1.0 - a
+    one_m_b = 1.0 - b
+    one_m_g = 1.0 - g
+    n_chunks = n_steps // TIME_CHUNK
+    tail = n_steps - n_chunks * TIME_CHUNK
+
+    def steps(y_chunk, carry, count):
+        (level, trend, seasons, dl, db_, dseasons, sse, grad) = carry
+        for i in range(count):
+            x = y_chunk[i]
+            s_i = seasons[0]
+            ds_i = dseasons[0]
+            base = level + trend
+            dbase = [dl[j] + db_[j] for j in range(3)]
+            if additive:
+                e = x - (base + s_i)
+                de = [-(dbase[j] + ds_i[j]) for j in range(3)]
+                lw = x - s_i
+                dlw = [-ds_i[j] for j in range(3)]
+            else:
+                e = x - base * s_i
+                de = [-(dbase[j] * s_i + base * ds_i[j]) for j in range(3)]
+                lw = x / s_i
+                x_s2 = x / (s_i * s_i)
+                dlw = [-x_s2 * ds_i[j] for j in range(3)]
+            new_level = a * lw + one_m_a * base
+            dnl = [a * dlw[j] + one_m_a * dbase[j] for j in range(3)]
+            dnl[0] = dnl[0] + (lw - base)              # e_α term
+            new_trend = b * (new_level - level) + one_m_b * trend
+            dnt = [b * (dnl[j] - dl[j]) + one_m_b * db_[j]
+                   for j in range(3)]
+            dnt[1] = dnt[1] + (new_level - level - trend)   # e_β term
+            if additive:
+                sw = x - new_level
+                dsw = [-dnl[j] for j in range(3)]
+            else:
+                sw = x / new_level
+                x_l2 = x / (new_level * new_level)
+                dsw = [-x_l2 * dnl[j] for j in range(3)]
+            new_season = g * sw + one_m_g * s_i
+            dns = [g * dsw[j] + one_m_g * ds_i[j] for j in range(3)]
+            dns[2] = dns[2] + (sw - s_i)               # e_γ term
+            seasons = seasons[1:] + [new_season]
+            dseasons = dseasons[1:] + [dns]
+            level, trend, dl, db_ = new_level, new_trend, dnl, dnt
+            sse = sse + e * e
+            grad = [grad[j] + 2.0 * e * de[j] for j in range(3)]
+        return (level, trend, seasons, dl, db_, dseasons, sse, grad)
+
+    def flatten(carry):
+        level, trend, seasons, dl, db_, dseasons, sse, grad = carry
+        return (level, trend) + tuple(seasons) + tuple(dl) + tuple(db_) \
+            + tuple(x for row in dseasons for x in row) + (sse,) \
+            + tuple(grad)
+
+    def unflatten(flat):
+        level, trend = flat[0], flat[1]
+        seasons = list(flat[2:2 + m])
+        off = 2 + m
+        dl = list(flat[off:off + 3])
+        db_ = list(flat[off + 3:off + 6])
+        off += 6
+        dseasons = [list(flat[off + 3 * j: off + 3 * (j + 1)])
+                    for j in range(m)]
+        off += 3 * m
+        return (level, trend, seasons, dl, db_, dseasons, flat[off],
+                list(flat[off + 1:off + 4]))
+
+    def chunk_body(ci, flat):
+        base_t = pl.multiple_of(ci * TIME_CHUNK, 1)
+        y_c = y_ref[pl.ds(base_t, TIME_CHUNK)]
+        carry = steps([y_c[i] for i in range(TIME_CHUNK)],
+                      unflatten(flat), TIME_CHUNK)
+        return flatten(carry)
+
+    carry0 = (init_ref[0], init_ref[1],
+              [init_ref[2 + j] for j in range(m)],
+              [zero] * 3, [zero] * 3,
+              [[zero] * 3 for _ in range(m)], zero, [zero] * 3)
+    flat = jax.lax.fori_loop(0, n_chunks, chunk_body, flatten(carry0)) \
+        if n_chunks else flatten(carry0)
+    if tail:
+        base_t = n_chunks * TIME_CHUNK
+        carry = steps([y_ref[base_t + i] for i in range(tail)],
+                      unflatten(flat), tail)
+    else:
+        carry = unflatten(flat)
+    _, _, _, _, _, _, sse, grad = carry
+    out_ref[0] = sse
+    for j in range(3):
+        out_ref[1 + j] = grad[j]
+
+
+@functools.lru_cache(maxsize=None)
+def _build_call(m: int, additive: bool, n_steps: int, n_blocks: int,
+                rows: int, interpret: bool):
+    kernel = functools.partial(_hw_kernel, m, additive, n_steps)
+    return pl.pallas_call(
+        kernel,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((3, 1, rows, LANES), lambda i: (0, i, 0, 0)),
+            pl.BlockSpec((2 + m, 1, rows, LANES), lambda i: (0, i, 0, 0)),
+            pl.BlockSpec((n_steps, 1, rows, LANES), lambda i: (0, i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((4, 1, rows, LANES), lambda i: (0, i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((4, n_blocks, rows, LANES),
+                                       jnp.float32),
+        interpret=interpret,
+    )
+
+
+def sse_value_and_grad(params: jnp.ndarray, y_steps_b, init_b,
+                       S: int, rows: int, n_blocks: int,
+                       m: int, additive: bool, n_steps: int,
+                       interpret: bool):
+    """Blocked-input form: one kernel dispatch for the whole panel.
+    ``params (S, 3)`` raw; ``y_steps_b``/``init_b`` pre-blocked."""
+    params_b, _ = _blocked(params.astype(jnp.float32), S, rows)
+    call = _build_call(m, additive, n_steps, n_blocks, rows, interpret)
+    out = call(params_b, init_b, y_steps_b)       # (4, nb, rows, 128)
+    out = out.reshape(4, -1)[:, :S]
+    return out[0], out[1:].T                      # f (S,), g (S, 3)
+
+
+def _prep(series: jnp.ndarray, period: int, model_type: str):
+    """Shared data prep for the pass and the driver: validate the
+    window, compute the data-only init components, and block the series
+    and init planes once.  Returns
+    ``(y_b, init_b, S, rows, n_blocks, n_steps, additive)``."""
+    from ..models.holt_winters import HoltWintersModel
+    additive = model_type.lower().startswith("additive")
+    S, n = series.shape
+    n_steps = n - period
+    if n_steps < 1:
+        raise ValueError(
+            f"series too short for Holt-Winters: need more than "
+            f"period = {period} observations, got {n}")
+    probe = HoltWintersModel(model_type, period, 0.0, 0.0, 0.0)
+    level0, trend0, season0 = probe._init_components(series)
+    rows = _block_rows(S)
+    y_b, n_blocks = _blocked(series[:, period:].astype(jnp.float32), S,
+                             rows)
+    init = jnp.concatenate([level0[:, None], trend0[:, None], season0],
+                           axis=-1).astype(jnp.float32)
+    init_b, _ = _blocked(init, S, rows)
+    return y_b, init_b, S, rows, n_blocks, n_steps, additive
+
+
+def value_and_grad(params: jnp.ndarray, series: jnp.ndarray, period: int,
+                   model_type: str, interpret: bool | None = None):
+    """Standalone batched ``(sse (S,), grad (S, 3))`` — drop-in numerics
+    for ``models.holt_winters._hw_sse_value_and_grad`` (dense panels)."""
+    if interpret is None:
+        interpret = not use_pallas()
+    y_b, init_b, S, rows, n_blocks, n_steps, additive = _prep(
+        series, period, model_type)
+    return sse_value_and_grad(params, y_b, init_b, S, rows, n_blocks,
+                              period, additive, n_steps, interpret)
+
+
+def _project(x):
+    return jnp.clip(x, 0.0, 1.0)
+
+
+def fit_box(x0: jnp.ndarray, series: jnp.ndarray, period: int,
+            model_type: str, tol: float = 1e-10, max_iter: int = 1000,
+            max_backtracks: int = 40, interpret: bool | None = None):
+    """Panel-batched projected gradient on [0, 1]³ with the kernel pass.
+
+    Mirrors ``ops.optimize._minimize_box_one`` (Armijo backtracking on
+    the projected-gradient arc, identical accept/convergence tests) in
+    plain array ops.  Returns ``(x, fun, converged, n_iter)``.
+    """
+    if interpret is None:
+        interpret = not use_pallas()
+    x0 = _project(x0.astype(jnp.float32))
+    # init components are data-only: computed once, outside the loop
+    y_b, init_b, S, rows, n_blocks, n_steps, additive = _prep(
+        series, period, model_type)
+
+    def vag(x):
+        return sse_value_and_grad(x, y_b, init_b, S, rows, n_blocks,
+                                  period, additive, n_steps, interpret)
+
+    f0, g0 = vag(x0)
+
+    def bt_cond(c):
+        accepted, k, done = c[2], c[6], c[7]
+        return jnp.logical_and(jnp.any(~accepted & ~done),
+                               k < max_backtracks)
+
+    def bt_body(c):
+        t, x, accepted, xb, fb, gb, k, done, f, g = c
+        x_trial = _project(x - t[:, None] * g)
+        f_t, g_t = vag(x_trial)
+        decrease = jnp.sum(g * (x - x_trial), axis=-1)
+        ok = (f_t <= f - 1e-4 * decrease) & jnp.isfinite(f_t)
+        newly = ok & ~accepted & ~done
+        xb = jnp.where(newly[:, None], x_trial, xb)
+        fb = jnp.where(newly, f_t, fb)
+        gb = jnp.where(newly[:, None], g_t, gb)
+        return (jnp.where(accepted | newly, t, t * 0.5), x,
+                accepted | newly, xb, fb, gb, k + 1, done, f, g)
+
+    def body(state):
+        x, f, g, it_lanes, it, done = state
+        t0 = jnp.ones((S,), jnp.float32)
+        bt0 = (t0, x, jnp.zeros((S,), bool), x, f, g,
+               jnp.asarray(0), done, f, g)
+        _, _, accepted, x_new, f_new, g_new, _, _, _, _ = \
+            jax.lax.while_loop(bt_cond, bt_body, bt0)
+        step_norm = jnp.max(jnp.abs(x_new - x), axis=-1)
+        f_stall = jnp.abs(f_new - f) <= tol * (jnp.abs(f) + tol)
+        newly_done = (step_norm <= tol) | f_stall | ~accepted
+        active = ~done
+        take = accepted & active
+        x = jnp.where(take[:, None], x_new, x)
+        f = jnp.where(take, f_new, f)
+        g = jnp.where(take[:, None], g_new, g)
+        return (x, f, g, it_lanes + active.astype(jnp.int32), it + 1,
+                done | (newly_done & active))
+
+    def cond(state):
+        done, it = state[5], state[4]
+        return jnp.logical_and(~jnp.all(done), it < max_iter)
+
+    x, f, _, it_lanes, _, done = jax.lax.while_loop(
+        cond, body, (x0, f0, g0, jnp.zeros((S,), jnp.int32),
+                     jnp.asarray(0), jnp.zeros((S,), bool)))
+    return x, f, done, it_lanes
