@@ -13,7 +13,14 @@ chunk (131072 x 128 f32, ARIMA(2,1,2), override via ``AB_N_SERIES`` /
 - one in-loop LM iteration, XLA vs Pallas (differenced fits:
   ``(fit(max_iter=52) - fit(max_iter=2)) / 50`` — fixed costs cancel,
   and the wide span keeps the delta far above the tunnel's RTT jitter);
-- the full fit wall time, both paths.
+- the full fit wall time, both paths (driver-level);
+- the PUBLIC ``arima.fit`` end to end, ``STS_PALLAS=0`` vs forced
+  (``AB_N_SERIES x AB_N_OBS``);
+- ``auto_fit_panel``'s fused grid, XLA vs Pallas screen/refine
+  (``AB_GRID_SERIES`` lanes, clamped to the panel);
+- the Holt-Winters box fit, vmapped ``minimize_box`` vs the
+  ``pallas_hw.fit_box`` driver (``AB_HW_SERIES x AB_HW_OBS`` — the
+  number that decides ``holt_winters.fit``'s ``default_on`` flip).
 
 Prints one JSON line per measurement; shares ``bench._resolve_platform``
 (probe in subprocess, labeled degraded CPU fallback, rc 0 either way).
@@ -143,6 +150,60 @@ def main():
           "xla_series_per_sec": round(S / t_fit_xla, 1),
           "pallas_series_per_sec": round(S / t_fit_pl, 1),
           "unit": "s/fit",
+          **({"cpu_interpret": True} if interpret else {})})
+
+    # --- the PUBLIC fit, end to end: STS_PALLAS=0 vs =1 (forced) ------------
+    # (the full arima.fit includes differencing + HR init + quarantine
+    # around the solver, so its ratio can exceed the driver-level line
+    # above.  Forced rather than default routing so the measurement is
+    # the same on any host: under jit the default gate's tracer branch
+    # falls back to a device-count proxy, which on a multi-device host
+    # would silently measure XLA vs XLA)
+    panel_j = jax.device_put(jnp.asarray(panel, jnp.float32))
+
+    def fit_wall(flag):
+        os.environ["STS_PALLAS"] = flag
+        try:
+            f = jax.jit(lambda v: arima.fit(2, 1, 2, v, warn=False)
+                        .coefficients)
+            return timed(f, panel_j)
+        finally:
+            os.environ.pop("STS_PALLAS", None)
+
+    t_pub_xla = fit_wall("0")
+    t_pub_pl = fit_wall("1")
+    emit({"metric": f"public arima.fit(2,1,2) device-resident, forced "
+                    f"routing ({S}x{n_obs} f32)",
+          "xla_s": round(t_pub_xla, 3), "pallas_s": round(t_pub_pl, 3),
+          "speedup": round(t_pub_xla / t_pub_pl, 2),
+          "xla_series_per_sec": round(S / t_pub_xla, 1),
+          "pallas_series_per_sec": round(S / t_pub_pl, 1),
+          "unit": "s/fit",
+          **({"cpu_interpret": True} if interpret else {})})
+
+    # --- auto_fit_panel's fused grid: XLA vs Pallas screen/refine -----------
+    S_grid = min(int(os.environ.get("AB_GRID_SERIES",
+                                    "16384" if on_tpu else "128")),
+                 panel.shape[0])
+    grid_y = jnp.asarray(panel[:S_grid], jnp.float32)
+
+    def grid_wall(flag):
+        os.environ["STS_PALLAS"] = flag
+        try:
+            return timed(lambda v: arima.auto_fit_panel(
+                v, max_p=2, max_d=2, max_q=2).orders, grid_y)
+        finally:
+            os.environ.pop("STS_PALLAS", None)
+
+    t_grid_xla = grid_wall("0")
+    t_grid_pl = grid_wall("1")
+    emit({"metric": f"auto_fit_panel grid (p,q<=2, d<=2) ({S_grid}x"
+                    f"{n_obs} f32)",
+          "xla_s": round(t_grid_xla, 3), "pallas_s": round(t_grid_pl, 3),
+          "speedup": round(t_grid_xla / t_grid_pl, 2),
+          "xla_series_per_sec": round(S_grid / t_grid_xla, 1),
+          "pallas_series_per_sec": round(S_grid / t_grid_pl, 1),
+          "unit": "s/search",
           **({"cpu_interpret": True} if interpret else {})})
 
     # --- Holt-Winters box fit: Pallas driver vs vmapped minimize_box --------

@@ -252,21 +252,31 @@ def test_forced_kernel_composes_with_shard_map(monkeypatch, mesh):
                         lambda *a, **kw: calls.append(1) or real(*a, **kw))
 
     def per_shard(y_local):           # (S/8, n) device-local block
-        return arima.fit(1, 0, 1, y_local, warn=False).coefficients
+        m = arima.fit(1, 0, 1, y_local, warn=False)
+        return m.coefficients, m.diagnostics.converged
 
     sharded = jax.device_put(jnp.asarray(y),
                              NamedSharding(mesh, P("series", None)))
     # check_vma=False: pallas_call's out_shape carries no varying-mesh
     # annotation, so shard_map's vma check must be off around it (part
     # of the documented workflow, docs/users.md)
-    out = jax.shard_map(per_shard, mesh=mesh, in_specs=P("series", None),
-                        out_specs=P("series", None),
-                        check_vma=False)(sharded)
+    out, out_conv = jax.shard_map(
+        per_shard, mesh=mesh, in_specs=P("series", None),
+        out_specs=(P("series", None), P("series")),
+        check_vma=False)(sharded)
     assert calls                      # the kernel genuinely ran in-shard
 
-    ref = arima.fit(1, 0, 1, jnp.asarray(y), warn=False).coefficients
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               rtol=2e-4, atol=2e-4)
+    # reference on the XLA path: the comparison is cross-path, so a
+    # routing bug shared by both sides cannot hide; same converged-lane
+    # quantile contract as the sibling forced-routing tests (f32 ridge
+    # lanes can land apart across paths)
+    monkeypatch.delenv("STS_PALLAS")
+    ref = arima.fit(1, 0, 1, jnp.asarray(y), warn=False)
+    conv = np.asarray(out_conv) & np.asarray(ref.diagnostics.converged)
+    assert conv.mean() > 0.8
+    dx = np.max(np.abs(np.asarray(out, np.float64)
+                       - np.asarray(ref.coefficients)), axis=1)[conv]
+    assert np.median(dx) < 2e-3 and np.mean(dx < 5e-3) >= 0.9
 
 
 def test_lm_driver_matches_xla_fit():
