@@ -112,6 +112,47 @@ def _resolve_platform():
     return platform, degraded
 
 
+def timed_min(fn, *args, reps: int = 3) -> float:
+    """Wall-time ``fn(*args)`` (materializing every output), min over
+    ``reps`` after one warm call: the tunnel's per-call RTT jitter is
+    strictly additive noise, so the minimum is the cleanest estimator.
+    Shared by ``benchmarks/roofline.py`` and ``benchmarks/pallas_ab.py``
+    so their timing protocol cannot drift apart."""
+    import time as _time
+
+    import jax
+    import numpy as _np
+
+    out = fn(*args)
+    jax.tree_util.tree_map(_np.asarray, out)    # warm + tunnel sync
+    best = float("inf")
+    for _ in range(reps):
+        t0 = _time.perf_counter()
+        out = fn(*args)
+        jax.tree_util.tree_map(_np.asarray, out)
+        best = min(best, _time.perf_counter() - t0)
+    return best
+
+
+def chained(pass_fn, reps: int):
+    """Jit a fori_loop chaining ``reps`` calls of a scalar-returning
+    ``pass_fn(params, *args)`` with a tiny feedback term into params, so
+    the calls serialize, CSE cannot collapse them, D2H stays one float,
+    and the tunnel's fixed round trip amortizes ``1/reps`` — divide the
+    measured wall time by ``reps``."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(prm, *args):
+        def body(_, carry):
+            x, acc = carry
+            s = pass_fn(x, *args)
+            return (x + 1e-30 * s, acc + s)
+        return jax.lax.fori_loop(
+            0, reps, body, (prm, jnp.zeros((), prm.dtype)))[1]
+    return jax.jit(run)
+
+
 def _synthetic_arima_panel(n_series: int, n_obs: int,
                            seed: int = 0) -> np.ndarray:
     """ARIMA(2,1,2) draws: ARMA(2,2) innovations then one integration."""

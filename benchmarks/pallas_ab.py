@@ -32,7 +32,6 @@ so CPU runs shrink the shape and the lines are marked
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -71,18 +70,32 @@ def main():
     init = arima.hannan_rissanen_init(p, q, y, True).astype(jnp.float32)
     init = jnp.where(jnp.isfinite(init), init, 0.0)
 
-    def timed(fn, *args, reps=3):
-        """min over reps: the tunnel's RTT jitter (~±10 ms) is strictly
-        additive noise, so the minimum is the cleanest estimator."""
-        out = fn(*args)
-        jax.tree_util.tree_map(np.asarray, out)      # warm + materialize
-        best = float("inf")
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            out = fn(*args)
-            jax.tree_util.tree_map(np.asarray, out)
-            best = min(best, time.perf_counter() - t0)
-        return best
+    from bench import timed_min as timed   # shared timing protocol
+
+    from contextlib import contextmanager
+
+    @contextmanager
+    def pallas_flag(value):
+        prior = os.environ.get("STS_PALLAS")
+        os.environ["STS_PALLAS"] = value
+        try:
+            yield
+        finally:
+            if prior is None:
+                os.environ.pop("STS_PALLAS", None)
+            else:
+                os.environ["STS_PALLAS"] = prior
+
+    def emit_ab(metric, t_xla, t_pl, unit, n_items=None):
+        line = {"metric": metric,
+                "xla_s": round(t_xla, 3), "pallas_s": round(t_pl, 3),
+                "speedup": round(t_xla / t_pl, 2), "unit": unit}
+        if n_items is not None:
+            line["xla_series_per_sec"] = round(n_items / t_xla, 1)
+            line["pallas_series_per_sec"] = round(n_items / t_pl, 1)
+        if interpret:
+            line["cpu_interpret"] = True
+        emit(line)
 
     # --- one fused NE pass, chained so fixed costs amortize -----------------
     R = 8
@@ -142,15 +155,8 @@ def main():
           **({"cpu_interpret": True} if interpret else {})})
 
     # --- full fit wall time -------------------------------------------------
-    t_fit_xla = lm_xla(50)
-    t_fit_pl = lm_pl(50)
-    emit({"metric": f"full css-lm fit ({S}x{n_obs} f32, max_iter=50)",
-          "xla_s": round(t_fit_xla, 3), "pallas_s": round(t_fit_pl, 3),
-          "speedup": round(t_fit_xla / t_fit_pl, 2),
-          "xla_series_per_sec": round(S / t_fit_xla, 1),
-          "pallas_series_per_sec": round(S / t_fit_pl, 1),
-          "unit": "s/fit",
-          **({"cpu_interpret": True} if interpret else {})})
+    emit_ab(f"full css-lm fit ({S}x{n_obs} f32, max_iter=50)",
+            lm_xla(50), lm_pl(50), "s/fit", n_items=S)
 
     # --- the PUBLIC fit, end to end: STS_PALLAS=0 vs =1 (forced) ------------
     # (the full arima.fit includes differencing + HR init + quarantine
@@ -162,24 +168,14 @@ def main():
     panel_j = jax.device_put(jnp.asarray(panel, jnp.float32))
 
     def fit_wall(flag):
-        os.environ["STS_PALLAS"] = flag
-        try:
+        with pallas_flag(flag):
             f = jax.jit(lambda v: arima.fit(2, 1, 2, v, warn=False)
                         .coefficients)
             return timed(f, panel_j)
-        finally:
-            os.environ.pop("STS_PALLAS", None)
 
-    t_pub_xla = fit_wall("0")
-    t_pub_pl = fit_wall("1")
-    emit({"metric": f"public arima.fit(2,1,2) device-resident, forced "
-                    f"routing ({S}x{n_obs} f32)",
-          "xla_s": round(t_pub_xla, 3), "pallas_s": round(t_pub_pl, 3),
-          "speedup": round(t_pub_xla / t_pub_pl, 2),
-          "xla_series_per_sec": round(S / t_pub_xla, 1),
-          "pallas_series_per_sec": round(S / t_pub_pl, 1),
-          "unit": "s/fit",
-          **({"cpu_interpret": True} if interpret else {})})
+    emit_ab(f"public arima.fit(2,1,2) device-resident, forced routing "
+            f"({S}x{n_obs} f32)",
+            fit_wall("0"), fit_wall("1"), "s/fit", n_items=S)
 
     # --- auto_fit_panel's fused grid: XLA vs Pallas screen/refine -----------
     S_grid = min(int(os.environ.get("AB_GRID_SERIES",
@@ -188,23 +184,12 @@ def main():
     grid_y = jnp.asarray(panel[:S_grid], jnp.float32)
 
     def grid_wall(flag):
-        os.environ["STS_PALLAS"] = flag
-        try:
+        with pallas_flag(flag):
             return timed(lambda v: arima.auto_fit_panel(
                 v, max_p=2, max_d=2, max_q=2).orders, grid_y)
-        finally:
-            os.environ.pop("STS_PALLAS", None)
 
-    t_grid_xla = grid_wall("0")
-    t_grid_pl = grid_wall("1")
-    emit({"metric": f"auto_fit_panel grid (p,q<=2, d<=2) ({S_grid}x"
-                    f"{n_obs} f32)",
-          "xla_s": round(t_grid_xla, 3), "pallas_s": round(t_grid_pl, 3),
-          "speedup": round(t_grid_xla / t_grid_pl, 2),
-          "xla_series_per_sec": round(S_grid / t_grid_xla, 1),
-          "pallas_series_per_sec": round(S_grid / t_grid_pl, 1),
-          "unit": "s/search",
-          **({"cpu_interpret": True} if interpret else {})})
+    emit_ab(f"auto_fit_panel grid (p,q<=2, d<=2) ({S_grid}x{n_obs} f32)",
+            grid_wall("0"), grid_wall("1"), "s/search", n_items=S_grid)
 
     # --- Holt-Winters box fit: Pallas driver vs vmapped minimize_box --------
     # (the routing default in holt_winters.fit is OFF until this line
@@ -243,16 +228,9 @@ def main():
                                      interpret=interpret)[0]
         return timed(jax.jit(run), hw_x0, hw_y)
 
-    t_hw_xla = hw_xla()
-    t_hw_pl = hw_pl()
-    emit({"metric": f"HoltWinters additive box fit ({S_hw}x{n_hw} f32, "
-                    f"period={period}, max_iter={hw_iter})",
-          "xla_s": round(t_hw_xla, 3), "pallas_s": round(t_hw_pl, 3),
-          "speedup": round(t_hw_xla / t_hw_pl, 2),
-          "xla_series_per_sec": round(S_hw / t_hw_xla, 1),
-          "pallas_series_per_sec": round(S_hw / t_hw_pl, 1),
-          "unit": "s/fit",
-          **({"cpu_interpret": True} if interpret else {})})
+    emit_ab(f"HoltWinters additive box fit ({S_hw}x{n_hw} f32, "
+            f"period={period}, max_iter={hw_iter})",
+            hw_xla(), hw_pl(), "s/fit", n_items=S_hw)
 
 
 if __name__ == "__main__":

@@ -12,7 +12,8 @@ chunk shape):
   (one full LM iteration's recurrence work; ratio to residual_pass shows
   the tangent-pass share)
 - ``lm_iteration``    — marginal wall time per LM iteration, from fits at
-  max_iter=2 vs max_iter=12 (includes the solve + bookkeeping)
+  max_iter=2 vs max_iter=52 (includes the solve + bookkeeping; the wide
+  span keeps the delta far above the tunnel's RTT jitter)
 - ``obs_scaling``     — normal_eqs time at n_obs 64/128/256: linear growth
   = throughput-bound in the scan body; flat = per-step latency dominates
 - ``batch_scaling``   — normal_eqs time at 16k/64k/131k series: flat time
@@ -25,24 +26,13 @@ for smoke only.
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _timed(fn, *args, reps=5):
-    import jax
-    out = fn(*args)
-    jax.block_until_ready(out)
-    np.asarray(jax.tree_util.tree_leaves(out)[0])       # tunnel sync
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args)
-        jax.block_until_ready(out)
-        np.asarray(jax.tree_util.tree_leaves(out)[0])
-    return (time.perf_counter() - t0) / reps
+from bench import timed_min as _timed  # noqa: E402 — needs the sys.path line
 
 
 def main():
@@ -102,51 +92,64 @@ def main():
         return jnp.sum(JJt) + jnp.sum(Jr_) + jnp.sum(sse)
 
     diffed = jnp.asarray(np.diff(panel, axis=1), dtype)
-    rp = jax.jit(residual_pass)
-    ne = jax.jit(normal_eqs_pass)
 
-    t_resid = _timed(rp, x0, diffed)
-    emit(f"residual primal pass ({n}x{n_obs})", t_resid)
-    t_ne = _timed(ne, x0, diffed)
-    emit(f"normal-equations pass: primal + {k} tangents ({n}x{n_obs})",
+    # standalone pass timings CHAIN R passes inside one jit with a data
+    # dependence (the r04 capture's single-call numbers were ~140 ms of
+    # pure tunnel RTT floor — batch=16384 vs 131072 differed by 6 ms):
+    # the feedback term stops CSE, the scalar output keeps D2H at one
+    # float, and the fixed round trip amortizes 1/R
+    R = int(os.environ.get("ROOF_CHAIN", "8"))
+    from bench import chained
+
+    rp = chained(residual_pass, R)
+    ne = chained(normal_eqs_pass, R)
+
+    t_resid = _timed(rp, x0, diffed) / R
+    emit(f"residual primal pass ({n}x{n_obs}, chained x{R})", t_resid)
+    t_ne = _timed(ne, x0, diffed) / R
+    emit(f"normal-equations pass: primal + {k} tangents ({n}x{n_obs}, "
+         f"chained x{R})",
          t_ne, tangent_share=round(1 - t_resid / t_ne, 3))
 
     # the production pass: hand-fused carry accumulation (design.md §9)
     from spark_timeseries_tpu.models.arima import _arma_normal_eqs
-    @jax.jit
+
     def fused_scalar(prm, y):
         jtj, jtr, sse = jax.vmap(
             lambda prm_i, y_i: _arma_normal_eqs(prm_i, y_i, p, q, 1))(
                 prm, y)
         return jnp.sum(jtj) + jnp.sum(jtr) + jnp.sum(sse)
 
-    t_fused = _timed(fused_scalar, x0, diffed)
-    emit(f"fused-carry normal-equations pass ({n}x{n_obs})", t_fused,
-         vs_linearize=round(t_ne / t_fused, 2))
+    fused = chained(fused_scalar, R)
+    t_fused = _timed(fused, x0, diffed) / R
+    emit(f"fused-carry normal-equations pass ({n}x{n_obs}, chained x{R})",
+         t_fused, vs_linearize=round(t_ne / t_fused, 2))
 
-    # marginal LM iteration cost from two fixed-budget fits
+    # marginal LM iteration cost from two fixed-budget fits — wide span
+    # (2 vs 52) so the ~100-350 ms delta dwarfs the RTT jitter
     vals = jnp.asarray(panel, dtype)
     f2 = jax.jit(lambda v: jnp.sum(arima.fit(2, 1, 2, v, warn=False,
                                              max_iter=2).coefficients))
-    f12 = jax.jit(lambda v: jnp.sum(arima.fit(2, 1, 2, v, warn=False,
-                                              max_iter=12).coefficients))
+    f52 = jax.jit(lambda v: jnp.sum(arima.fit(2, 1, 2, v, warn=False,
+                                              max_iter=52).coefficients))
     t2 = _timed(f2, vals, reps=3)
-    t12 = _timed(f12, vals, reps=3)
-    emit(f"marginal LM iteration ({n}x{n_obs})", (t12 - t2) / 10.0,
-         fit_2iter_ms=round(t2 * 1e3, 2), fit_12iter_ms=round(t12 * 1e3, 2))
+    t52 = _timed(f52, vals, reps=3)
+    emit(f"marginal LM iteration ({n}x{n_obs})", (t52 - t2) / 50.0,
+         fit_2iter_ms=round(t2 * 1e3, 2), fit_52iter_ms=round(t52 * 1e3, 2))
 
     # n_obs scaling of the normal-equations pass
     for m in (64, 128, 256):
         pm = _synthetic_arima_panel(n, m, seed=1)
         dm = jnp.asarray(np.diff(pm, axis=1), dtype)
-        t = _timed(ne, x0, dm, reps=3)       # same jit object: one compile
-        emit(f"normal-equations pass, n_obs={m} ({n} series)", t)
+        t = _timed(ne, x0, dm, reps=3) / R   # same jit object per shape
+        emit(f"normal-equations pass, n_obs={m} ({n} series, "
+             f"chained x{R})", t)
 
     # batch scaling of the normal-equations pass
     for b in dict.fromkeys(min(b, n) for b in (16384, 65536, n)):
-        t = _timed(ne, x0[:b], diffed[:b], reps=3)
-        emit(f"normal-equations pass, batch={b} (n_obs={n_obs})", t,
-             series_per_sec=round(b / t, 1))
+        t = _timed(ne, x0[:b], diffed[:b], reps=3) / R
+        emit(f"normal-equations pass, batch={b} (n_obs={n_obs}, "
+             f"chained x{R})", t, series_per_sec=round(b / t, 1))
 
 
 if __name__ == "__main__":

@@ -266,10 +266,17 @@ def test_forced_kernel_composes_with_shard_map(monkeypatch, mesh):
         check_vma=False)(sharded)
     assert calls                      # the kernel genuinely ran in-shard
 
-    # reference on the XLA path: the comparison is cross-path, so a
-    # routing bug shared by both sides cannot hide; same converged-lane
-    # quantile contract as the sibling forced-routing tests (f32 ridge
-    # lanes can land apart across paths)
+    # same-path strict invariant: the forced fit must not depend on
+    # which shard a lane lives in (a block-padding bug at 4 lanes/shard
+    # vs 32 unsharded would show here immediately)
+    same_path = arima.fit(1, 0, 1, jnp.asarray(y), warn=False)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(same_path.coefficients),
+                               rtol=2e-4, atol=2e-4)
+
+    # cross-path check against the XLA reference: a routing bug shared
+    # by both sides cannot hide; converged-lane quantile contract (f32
+    # ridge lanes can land apart across paths)
     monkeypatch.delenv("STS_PALLAS")
     ref = arima.fit(1, 0, 1, jnp.asarray(y), warn=False)
     conv = np.asarray(out_conv) & np.asarray(ref.diagnostics.converged)
