@@ -112,26 +112,26 @@ def _resolve_platform():
     return platform, degraded
 
 
-def timed_min(fn, *args, reps: int = 3) -> float:
+def timed_min(fn, *args, reps: int = 3, want_out: bool = False):
     """Wall-time ``fn(*args)`` (materializing every output), min over
     ``reps`` after one warm call: the tunnel's per-call RTT jitter is
     strictly additive noise, so the minimum is the cleanest estimator.
-    Shared by ``benchmarks/roofline.py`` and ``benchmarks/pallas_ab.py``
-    so their timing protocol cannot drift apart."""
+    Shared by the benchmark entry points (``roofline.py``,
+    ``pallas_ab.py``, ``bench_suite.py``) so their timing protocol
+    cannot drift apart.  ``want_out=True`` returns ``(seconds, out)``
+    with the last run's materialized outputs."""
     import time as _time
 
     import jax
     import numpy as _np
 
-    out = fn(*args)
-    jax.tree_util.tree_map(_np.asarray, out)    # warm + tunnel sync
+    out = jax.tree_util.tree_map(_np.asarray, fn(*args))  # warm + sync
     best = float("inf")
     for _ in range(reps):
         t0 = _time.perf_counter()
-        out = fn(*args)
-        jax.tree_util.tree_map(_np.asarray, out)
+        out = jax.tree_util.tree_map(_np.asarray, fn(*args))
         best = min(best, _time.perf_counter() - t0)
-    return best
+    return (best, out) if want_out else best
 
 
 def chained(pass_fn, reps: int):

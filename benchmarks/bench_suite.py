@@ -29,16 +29,16 @@ BASELINE_SAMPLE = 6
 
 
 def _timed(fn, *args, reps=3):
+    """Shared protocol (bench.timed_min): min over reps — the tunnel's
+    RTT jitter is additive, so the previous mean-of-reps biased the
+    suite's records high relative to roofline/pallas_ab.  Returns
+    ``(seconds, leaves)`` with the last run's materialized leaf list
+    (this file's historical contract: callers index ``out[0]``)."""
     import jax
 
-    def materialize(out):
-        return [np.asarray(leaf) for leaf in jax.tree_util.tree_leaves(out)]
-
-    materialize(fn(*args))
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = materialize(fn(*args))
-    return (time.perf_counter() - t0) / reps, out
+    from bench import timed_min
+    dt, out = timed_min(fn, *args, reps=reps, want_out=True)
+    return dt, [np.asarray(leaf) for leaf in jax.tree_util.tree_leaves(out)]
 
 
 def _baseline(per_series_fn, panel: np.ndarray,

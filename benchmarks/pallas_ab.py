@@ -99,31 +99,25 @@ def main():
 
     # --- one fused NE pass, chained so fixed costs amortize -----------------
     R = 8
+    from bench import chained
 
-    # every output (jtj included) feeds the data dependence: XLA's DCE
-    # would otherwise strip the unused JtJ accumulation from its side of
-    # the A/B while the Pallas kernel always computes its fused output
-    def chain_xla(prm):
-        def body(i, carry):
-            x, acc = carry
-            jtj, jtr, sse = jax.vmap(
-                lambda pp, yy: arima._arma_normal_eqs(pp, yy, p, q, icpt)
-            )(x, y)
-            return (x + 1e-30 * jtr,
-                    acc + jnp.sum(sse) + 1e-30 * jnp.sum(jtj))
-        return jax.lax.fori_loop(0, R, body, (prm, jnp.zeros((), y.dtype)))[1]
+    # every output (jtj included) feeds the data dependence through the
+    # chained scalar: XLA's DCE would otherwise strip the unused JtJ
+    # accumulation from its side of the A/B while the Pallas kernel
+    # always computes its fused output
+    def ne_xla(x, yy):
+        jtj, jtr, sse = jax.vmap(
+            lambda pp, vv: arima._arma_normal_eqs(pp, vv, p, q, icpt)
+        )(x, yy)
+        return jnp.sum(sse) + 1e-30 * (jnp.sum(jtj) + jnp.sum(jtr))
 
-    def chain_pallas(prm):
-        def body(i, carry):
-            x, acc = carry
-            jtj, jtr, sse = pallas_arma.normal_equations(
-                x, y, p, q, icpt, interpret=interpret)
-            return (x + 1e-30 * jtr,
-                    acc + jnp.sum(sse) + 1e-30 * jnp.sum(jtj))
-        return jax.lax.fori_loop(0, R, body, (prm, jnp.zeros((), y.dtype)))[1]
+    def ne_pl(x, yy):
+        jtj, jtr, sse = pallas_arma.normal_equations(
+            x, yy, p, q, icpt, interpret=interpret)
+        return jnp.sum(sse) + 1e-30 * (jnp.sum(jtj) + jnp.sum(jtr))
 
-    t_xla = timed(jax.jit(chain_xla), init) / R
-    t_pl = timed(jax.jit(chain_pallas), init) / R
+    t_xla = timed(chained(ne_xla, R), init, y) / R
+    t_pl = timed(chained(ne_pl, R), init, y) / R
     emit({"metric": f"fused NE pass ({S}x{n_obs} f32, chained x{R})",
           "xla_ms": round(1e3 * t_xla, 3), "pallas_ms": round(1e3 * t_pl, 3),
           "speedup": round(t_xla / t_pl, 2), "unit": "ms/pass",

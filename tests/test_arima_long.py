@@ -36,6 +36,33 @@ def test_fit_long_matches_direct_fit():
                                np.asarray(direct.coefficients), atol=0.05)
 
 
+def test_fit_long_forced_pallas_matches_xla(monkeypatch):
+    # fit_long's segment solve goes through fit's css-lm dispatch, so on
+    # TPU its segment lanes route through the Pallas kernel whenever the
+    # gate allows; pin the forced path against the XLA path (the spy
+    # proves it genuinely engaged)
+    from spark_timeseries_tpu.ops import pallas_arma
+
+    y = jnp.asarray(_long_arma(16384, seed=5), jnp.float32)
+    monkeypatch.setenv("STS_PALLAS", "0")
+    ref = arima.fit_long(2, 0, 1, y, segment_len=2048)
+
+    calls = []
+    real = pallas_arma.fit_css_lm
+    monkeypatch.setattr(pallas_arma, "fit_css_lm",
+                        lambda *a, **kw: calls.append(1) or real(*a, **kw))
+    monkeypatch.setenv("STS_PALLAS", "1")
+    seg = arima.fit_long(2, 0, 1, y, segment_len=2048)
+    assert calls
+    assert bool(np.asarray(seg.diagnostics.converged))
+    # cross-path f32 tolerance: one segment landing on a slightly
+    # different point shifts the precision-weighted combination a little
+    # (the repo's cross-path contract; see test_pallas_arma.py)
+    np.testing.assert_allclose(np.asarray(seg.coefficients, np.float64),
+                               np.asarray(ref.coefficients, np.float64),
+                               atol=2e-2)
+
+
 def test_fit_long_recovers_truth_with_differencing():
     y = _long_arma(32768, seed=3)
     ts = np.cumsum(y)                      # I(1)
