@@ -304,6 +304,21 @@ def main():
 
     panel = _synthetic_arima_panel(n_target, n_obs)
 
+    # record which css-lm solver the fits will use, so the artifact is
+    # self-describing.  Probe the gate through eval_shape so it takes
+    # exactly the branch the jitted fits take (a tracer — the
+    # device-count fallback, not a concrete array's sharding, which can
+    # disagree on single-process multi-device hosts), with the chunk's
+    # lane count and no device allocation at all
+    gate = {}
+
+    def _gate_probe(v):
+        gate["pallas"] = arima._use_pallas_lm(v, None)
+        return v
+
+    jax.eval_shape(_gate_probe, jax.ShapeDtypeStruct((chunk, 2), dtype))
+    css_lm_path = "pallas" if gate["pallas"] else "xla"
+
     # CPU-baseline emulation first: it is cheap, accelerator-independent,
     # and lets every streamed curve point carry vs_baseline
     cpu_rate, cpu_times = _baseline_rate(panel)
@@ -400,6 +415,7 @@ def main():
                 "partial": n != n_target,
                 "n_chunks": -(-n // c),
                 "platform": platform,
+                "css_lm_path": css_lm_path,
             }
             if h2d_mbps is not None:
                 point["h2d_mbps"] = h2d_mbps
@@ -533,6 +549,7 @@ def main():
             "unit": "series/sec",
             "vs_baseline": round(device_resident / cpu_rate, 2),
             "platform": platform,
+            "css_lm_path": css_lm_path,
         })
     except Exception as e:          # noqa: BLE001 — optional extra
         print(f"# device-resident timing failed: {type(e).__name__}: {e}",
@@ -570,6 +587,7 @@ def main():
         "h2d_overlap_pct": overlap_pct,
         "device_resident_rate": device_resident,
         "platform": platform,
+        "css_lm_path": css_lm_path,
         "peak_device_memory_mb": peak_mb,
         "refit_demo": refit_demo,
         "baseline_emulation": {
