@@ -308,15 +308,18 @@ def main():
     # self-describing.  Probe the gate through eval_shape so it takes
     # exactly the branch the jitted fits take (a tracer — the
     # device-count fallback, not a concrete array's sharding, which can
-    # disagree on single-process multi-device hosts), with the chunk's
-    # lane count and no device allocation at all
+    # disagree on single-process multi-device hosts), at the REAL
+    # post-differencing chunk shape (chunk, n_obs - 1) — the gate is
+    # obs-dependent (VMEM bound), so a placeholder obs count would
+    # mislabel the artifact (advisor r4) — and no device allocation
     gate = {}
 
     def _gate_probe(v):
         gate["pallas"] = arima._use_pallas_lm(v, None)
         return v
 
-    jax.eval_shape(_gate_probe, jax.ShapeDtypeStruct((chunk, 2), dtype))
+    jax.eval_shape(_gate_probe,
+                   jax.ShapeDtypeStruct((chunk, n_obs - 1), dtype))
     css_lm_path = "pallas" if gate["pallas"] else "xla"
 
     # CPU-baseline emulation first: it is cheap, accelerator-independent,

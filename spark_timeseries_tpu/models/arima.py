@@ -753,14 +753,24 @@ def hannan_rissanen_init(p: int, q: int, y: jnp.ndarray,
     return res.beta
 
 
-def _use_pallas_lm(diffed: jnp.ndarray, nv) -> bool:
+def _pallas_lm_mode(diffed: jnp.ndarray, nv) -> str:
     """Route the css-lm solve through the Pallas fused-NE kernel?
+    ``"pallas"`` / ``"pallas_shard_map"`` / ``"xla"``.
 
-    Gate semantics live in :func:`ops.pallas_arma.route_panel` (shared
+    Gate semantics live in :func:`ops.pallas_arma.route_mode` (shared
     with the Holt-Winters driver); the measured win here is 1.57x over
     the vmapped XLA fused-carry path
-    (``benchmarks/pallas_ab_r04_tpu.jsonl``).
+    (``benchmarks/pallas_ab_r04_tpu.jsonl``).  Series-sharded panels
+    keep the kernel via a per-shard ``shard_map`` wrap rather than
+    silently dropping to the XLA path (r4 verdict weak #4).
     """
+    from ..ops.pallas_arma import route_mode
+    return route_mode(diffed, nv, allow_1d=True)
+
+
+def _use_pallas_lm(diffed: jnp.ndarray, nv) -> bool:
+    """Bool view for grid callers that have no shard_map wrap (the
+    fused auto-fit); warns when a forced flag meets a sharded panel."""
     from ..ops.pallas_arma import route_panel
     return route_panel(diffed, nv, allow_1d=True)
 
@@ -780,12 +790,20 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
       residual sum of squares (the likelihood is monotone in it,
       ``ARIMA.scala:430-445``), and LM stays robust in float32 on TPU where
       a BFGS line search underflows.  On the TPU backend, dense float32
-      panels of >= 1024 series on one device route through the Pallas
-      fused-NE kernel (``ops.pallas_arma.fit_css_lm``, measured 1.57x
-      over the XLA path; smaller panels would mostly pad the kernel's
-      1024-lane blocks, so they keep the XLA path); ``STS_PALLAS=0``
-      restores the XLA path, ``STS_PALLAS=1`` forces the kernel anywhere
-      (interpreter mode off-TPU, for tests).
+      panels of >= 1024 series route through the Pallas fused-NE kernel
+      (``ops.pallas_arma.fit_css_lm``, measured 1.57x over the XLA
+      path; smaller panels would mostly pad the kernel's 1024-lane
+      blocks, and very long series would overflow a VMEM-resident
+      block — both keep the XLA path, ``ops.pallas_arma.vmem_fits``).
+      Series-sharded panels (``NamedSharding`` over the series axis,
+      >= 1024 lanes per shard) keep the kernel too, one ``shard_map``
+      shard per device (``ops.pallas_arma.fit_css_lm_sharded`` —
+      distribution changes neither the math nor the routing).
+      ``STS_PALLAS=0`` restores the XLA path, ``STS_PALLAS=1`` forces
+      the kernel anywhere (interpreter mode off-TPU, for tests); the
+      routing is decided at call time on the concrete panel, so a
+      user-held ``jax.jit`` around ``fit`` bakes it in — re-jit after
+      changing the flag.
     - ``"css-cgd"``: batched BFGS on the autodiff gradient (the reference's
       conjugate-gradient analog).
     - ``"css-bobyqa"``: projected gradient with backtracking (the
@@ -896,12 +914,14 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
 
     if method == "css-lm":
         mi = max_iter if max_iter is not None else LM_MAX_ITER
-        if _use_pallas_lm(diffed, nv):
-            from ..ops.pallas_arma import fit_css_lm
+        lm_mode = _pallas_lm_mode(diffed, nv)
+        if lm_mode != "xla":
+            from ..ops.pallas_arma import fit_css_lm, fit_css_lm_sharded
             x2 = init if init.ndim == 2 else init[None]
             y2 = diffed if diffed.ndim == 2 else diffed[None]
-            res = MinimizeResult(*fit_css_lm(x2, y2, p, q, icpt,
-                                             max_iter=mi))
+            solver = fit_css_lm_sharded if lm_mode == "pallas_shard_map" \
+                else fit_css_lm
+            res = MinimizeResult(*solver(x2, y2, p, q, icpt, max_iter=mi))
             if init.ndim != 2:
                 res = MinimizeResult(res.x[0], res.fun[0],
                                      res.converged[0], res.n_iter[0])

