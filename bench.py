@@ -49,20 +49,36 @@ def _probe_backend():
     A wedged TPU tunnel can make backend init either raise UNAVAILABLE
     (round 2's failure) or hang indefinitely (unkillable from inside the
     process) — probing in a child with a hard timeout protects the parent
-    from both.  Retries with linear backoff; returns the platform string
-    ("axon"/"tpu"/...) on success or None when the accelerator is
-    unreachable, in which case the caller runs a labeled degraded CPU
-    bench instead of dying with rc=1.
+    from both.  Returns the platform string ("axon"/"tpu"/...) on
+    success or None when the accelerator is unreachable, in which case
+    the caller runs a labeled degraded CPU bench instead of dying with
+    rc=1.
+
+    The probe LOOP is window-budgeted, not try-budgeted (r4 verdict:
+    three rounds of official records fell back to CPU because a ~20-min
+    try budget gave up inside tunnel wedges that the out-of-band watcher
+    script simply waited out): probes repeat every
+    ``BENCH_PROBE_BACKOFF`` seconds (default 120) with a
+    ``BENCH_PROBE_TIMEOUT``-second cap each (default 240) until one
+    succeeds or ``BENCH_PROBE_WINDOW`` minutes elapse (default 45; 0
+    restores the single-pass behavior of ``BENCH_PROBE_TRIES``
+    attempts).  Every failed probe emits a JSON line to stdout — the
+    driver's record then contains the proof of how long the chip was
+    actually down, not just the fallback's ``degraded`` marker.
     """
     tries = int(os.environ.get("BENCH_PROBE_TRIES", "3"))
-    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "300"))
-    backoff = float(os.environ.get("BENCH_PROBE_BACKOFF", "45"))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+    backoff = float(os.environ.get("BENCH_PROBE_BACKOFF", "120"))
+    window_s = 60.0 * float(os.environ.get("BENCH_PROBE_WINDOW", "45"))
     code = ("import jax, jax.numpy as jnp\n"
             "d = jax.devices()[0]\n"
             "x = jnp.ones((8, 8))\n"
             "(x @ x).block_until_ready()\n"
             "print('PLATFORM=' + d.platform, flush=True)\n")
-    for attempt in range(1, tries + 1):
+    start = time.monotonic()
+    attempt = 0
+    while True:
+        attempt += 1
         try:
             out = subprocess.run([sys.executable, "-c", code],
                                  capture_output=True, text=True,
@@ -73,11 +89,16 @@ def _probe_backend():
             reason = (out.stderr.strip().splitlines() or ["no output"])[-1]
         except subprocess.TimeoutExpired:
             reason = f"probe hung > {probe_timeout:.0f}s"
-        print(f"# backend probe {attempt}/{tries} failed: {reason}",
-              file=sys.stderr, flush=True)
-        if attempt < tries:
-            time.sleep(backoff * attempt)
-    return None
+        elapsed = time.monotonic() - start
+        _emit({"probe_attempt": attempt, "elapsed_s": round(elapsed, 1),
+               "window_s": window_s, "reason": reason[-200:]})
+        print(f"# backend probe {attempt} failed at {elapsed:.0f}s: "
+              f"{reason}", file=sys.stderr, flush=True)
+        out_of_window = window_s > 0 and elapsed + backoff > window_s
+        out_of_tries = window_s == 0 and attempt >= tries
+        if out_of_window or out_of_tries:
+            return None
+        time.sleep(backoff if window_s else backoff * attempt)
 
 
 DEGRADED_NOTE = "TPU unreachable after backend probes; CPU fallback"

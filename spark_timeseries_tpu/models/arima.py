@@ -1235,7 +1235,8 @@ def _auto_fit_panel_kernel(values: jnp.ndarray, masks_base: jnp.ndarray,
                            pq_arr: jnp.ndarray, crit: float,
                            max_p: int, max_q: int, max_d: int,
                            max_iter: int, screen_iter: int,
-                           use_pallas_lm: bool = False) -> tuple:
+                           use_pallas_lm: bool = False,
+                           n_valid: Optional[jnp.ndarray] = None) -> tuple:
     """Fully fused panel auto-fit — ONE dispatch for the whole search:
     batched KPSS d-selection, per-series differencing (a gather from the
     size-preserving diff stack), Hannan-Rissanen init, one batched LM solve
@@ -1259,6 +1260,14 @@ def _auto_fit_panel_kernel(values: jnp.ndarray, masks_base: jnp.ndarray,
     Returns ``(orders (S, 3), coefs (S, k), aic (S,), d_ok (S,),
     screen_capped (S,))`` — the last flags winners whose screen stage hit
     the reduced iteration cap (selection-risk telemetry).
+
+    ``n_valid (S,)`` restricts each lane to its left-aligned valid window
+    (``ops.ragged``; r4 verdict weak #7): the KPSS d-selection, the
+    Hannan-Rissanen grams, the masked LM objective, and the per-lane AIC
+    sample size all see the window length, so a NaN-padded ingestion
+    panel auto-selects orders without a destructive ``fill`` — per-lane
+    results equal independent auto-fits of the trimmed series (pinned by
+    ``tests/test_ragged.py``).
     """
     dtype = values.dtype
     S, n = values.shape
@@ -1269,7 +1278,10 @@ def _auto_fit_panel_kernel(values: jnp.ndarray, masks_base: jnp.ndarray,
     # the full stack of candidate differencing orders, ref ARIMA.scala:287-297)
     diffs = jnp.stack([differences_of_order_d(values, dd)
                        for dd in range(max_d + 1)])          # (D, S, n)
-    stats = jnp.stack([kpsstest(diffs[dd], "c")[0]
+    # n_valid is d-invariant: the size-preserving diff keeps the first d
+    # entries raw (the reference quirk), so every lane's window length
+    # survives differencing unchanged
+    stats = jnp.stack([kpsstest(diffs[dd], "c", n_valid=n_valid)[0]
                        for dd in range(max_d + 1)])          # (D, S)
     passes = stats < crit
     d_ok = jnp.any(passes, axis=0)
@@ -1288,7 +1300,7 @@ def _auto_fit_panel_kernel(values: jnp.ndarray, masks_base: jnp.ndarray,
     # then one *masked* OLS per candidate from shared normal equations
     m = max(max_p, max_q) + 1
     mx = max(max_p, max_q)
-    ar = autoregression.fit(diffed, m)
+    ar = autoregression.fit(diffed, m, n_valid=n_valid)
     est = lag_matvec(diffed, jnp.atleast_1d(ar.coefficients), m) \
         + jnp.asarray(ar.c)[..., None]
     y_trunc = diffed[..., m:]
@@ -1299,8 +1311,17 @@ def _auto_fit_panel_kernel(values: jnp.ndarray, masks_base: jnp.ndarray,
          _lag_stack_or_empty(y_trunc, max_p)[..., -n_rows:],
          _lag_stack_or_empty(errors, max_q)[..., -n_rows:]], axis=-2)
     target = y_trunc[..., mx:]
-    N = jnp.einsum("skn,sln->skl", Xs, Xs)           # XᵀX (S, k, k)
-    b = jnp.einsum("skn,sn->sk", Xs, target)
+    if n_valid is not None:
+        # rows whose target index falls past the valid window get weight
+        # 0 in the grams (0/1 weights square to themselves, so weighting
+        # one side is exact) — same rule as hannan_rissanen_init
+        w_hr = step_weights(n_rows, jnp.asarray(n_valid)[..., None],
+                            offset=m + mx, dtype=dtype)      # (S, n_rows)
+        Xs_w = Xs * w_hr[:, None, :]
+    else:
+        Xs_w = Xs
+    N = jnp.einsum("skn,sln->skl", Xs_w, Xs)         # XᵀX (S, k, k)
+    b = jnp.einsum("skn,sn->sk", Xs_w, target)
     # candidate-masked normal equations: (M N M + (I - M)) β = M b — SPD
     # (masked gram + identity fill), so the unrolled Cholesky path applies
     Mn = masks[..., :, None] * N[None] * masks[..., None, :]
@@ -1330,10 +1351,16 @@ def _auto_fit_panel_kernel(values: jnp.ndarray, masks_base: jnp.ndarray,
                                   flat[2].reshape(lead),
                                   flat[3].reshape(lead))
         y_bc = jnp.broadcast_to(y, (*x0.shape[:-1], y.shape[-1]))
+        if n_valid is None:
+            return minimize_least_squares(
+                None, x0, y_bc, mask, max_iter=iters,
+                normal_eqs_fn=lambda prm, yy, mm: _arma_normal_eqs(
+                    prm, yy, max_p, max_q, 1, mask=mm))
+        nv_bc = jnp.broadcast_to(jnp.asarray(n_valid), x0.shape[:-1])
         return minimize_least_squares(
-            None, x0, y_bc, mask, max_iter=iters,
-            normal_eqs_fn=lambda prm, yy, mm: _arma_normal_eqs(
-                prm, yy, max_p, max_q, 1, mask=mm))
+            None, x0, y_bc, mask, nv_bc, max_iter=iters,
+            normal_eqs_fn=lambda prm, yy, mm, vv: _arma_normal_eqs(
+                prm, yy, max_p, max_q, 1, mask=mm, n_valid=vv))
 
     res = _grid_lm(init, diffed, masks, screen_iter)
     lane_ok = jnp.all(jnp.isfinite(res.x), axis=-1, keepdims=True)
@@ -1344,7 +1371,8 @@ def _auto_fit_panel_kernel(values: jnp.ndarray, masks_base: jnp.ndarray,
     # sigma² = sse/n', ll = -(n'/2)(log(2π·sse/n') + 1).  Quarantined
     # lanes (x reset to init) keep res.fun's value, but their aic is
     # non-finite or their params screen out below, same as before.
-    n_eff = n
+    n_eff = n if n_valid is None \
+        else jnp.maximum(jnp.asarray(n_valid).astype(dtype), 1.0)  # (S,)
     neg_ll = 0.5 * n_eff * (jnp.log(2.0 * jnp.pi * res.fun / n_eff) + 1.0)
 
     # admissibility screen + AIC argmin, all on device (no host round-trip)
@@ -1385,7 +1413,8 @@ def _auto_fit_panel_kernel(values: jnp.ndarray, masks_base: jnp.ndarray,
         keep &= _step_down_stationary(-refined[:, 1 + max_p:],
                                       orders[:, 2])
         keep &= ~failed
-        neg_ll_r = 0.5 * n * (jnp.log(2.0 * jnp.pi * res_r.fun / n) + 1.0)
+        neg_ll_r = 0.5 * n_eff * (
+            jnp.log(2.0 * jnp.pi * res_r.fun / n_eff) + 1.0)
         aic_r = 2.0 * neg_ll_r + 2.0 * (
             orders[:, 0] + orders[:, 2] + icpt.astype(pq_arr.dtype)
         ).astype(dtype)
@@ -1422,8 +1451,16 @@ def auto_fit_panel(values: jnp.ndarray, max_p: int = 5, max_d: int = 2,
     ``t < max(max_p, max_q)`` residual window instead of its own
     ``max(p, q)``, so AICs are compared on the *same* sample (the
     reference compares AICs computed on per-order sample sizes).
+
+    NaN-padded panels (leading/trailing padding per lane, the
+    ``from_observations`` + ``union`` ingestion shape) auto-fit
+    directly, like ``fit``: each lane's valid window drives its KPSS
+    d-selection, HR init, masked LM, and AIC sample size.  Lanes too
+    short for the order grid get NaN coefficients, +inf aic, and orders
+    (0, 0, 0) instead of failing the panel.
     """
     values = jnp.asarray(values)
+    values, obs_len = ragged_view(values)
     if max_iter is None:
         max_iter = LM_MAX_ITER
     screen_iter = min(SCREEN_MAX_ITER if screen_max_iter is None
@@ -1444,17 +1481,34 @@ def auto_fit_panel(values: jnp.ndarray, max_p: int = 5, max_d: int = 2,
     # calls (jit caches key on function + avals + statics, not env).
     # Deciding here also reads the CONCRETE panel's sharding, which the
     # in-trace gate cannot
-    use_pl = _use_pallas_lm(values, None)
+    use_pl = _use_pallas_lm(values, obs_len)
+    # lanes whose window can't support the padded-order HR init (the
+    # grid's shared m = max(max_p, max_q) + 1 stages): quarantine rather
+    # than poison/raise — the batched replacement for the reference's
+    # per-series autoFit must degrade per lane (ARIMA.scala:280-304)
+    short = None
+    if obs_len is not None:
+        mx = max(max_p, max_q)
+        min_n = 2 * mx + 3 + max_p + max_q
+        short = short_lanes(obs_len, min_n,
+                            f"auto_fit_panel (max_p={max_p}, max_q={max_q}) "
+                            f"Hannan-Rissanen initialization")
     kernel = jax.jit(_auto_fit_panel_kernel,
                      static_argnums=(4, 5, 6, 7, 8, 9))
     orders, coefs, aic, d_ok, screen_capped = kernel(
         values, jnp.asarray(masks), jnp.asarray(pq, dtype=np.int32),
-        float(crit), max_p, max_q, max_d, max_iter, screen_iter, use_pl)
+        float(crit), max_p, max_q, max_d, max_iter, screen_iter, use_pl,
+        obs_len)
+
+    short_np = np.asarray(short) if short is not None else None
 
     # advisor r3: the reduced screen budget can change order selection on
     # slow-converging panels; surface it when it plausibly did
     if screen_iter < max_iter:
-        capped_frac = float(np.mean(np.asarray(screen_capped)))
+        capped = np.asarray(screen_capped)
+        if short_np is not None:
+            capped = capped[~short_np]
+        capped_frac = float(np.mean(capped)) if capped.size else 0.0
         if capped_frac > 0.5:
             warnings.warn(
                 f"auto_fit_panel: {capped_frac:.0%} of winning lanes hit the "
@@ -1464,6 +1518,8 @@ def auto_fit_panel(values: jnp.ndarray, max_p: int = 5, max_d: int = 2,
                 stacklevel=2)
 
     d_ok = np.asarray(d_ok)
+    if short_np is not None:
+        d_ok = d_ok | short_np      # short lanes quarantine, never raise
     if not d_ok.all():
         bad = int(np.sum(~d_ok))
         raise ValueError(
@@ -1471,15 +1527,20 @@ def auto_fit_panel(values: jnp.ndarray, max_p: int = 5, max_d: int = 2,
             f"for {bad} series")
 
     out_aic = np.asarray(aic)
+    out_orders = np.asarray(orders, dtype=np.int64)
+    out_coefs = np.asarray(coefs, dtype=np.float64)
+    if short_np is not None and short_np.any():
+        out_aic = np.where(short_np, np.inf, out_aic)
+        out_coefs = np.where(short_np[:, None], np.nan, out_coefs)
+        out_orders = np.where(short_np[:, None], 0, out_orders)
     # single-series auto_fit raises in this situation; for a panel, mark the
     # failed lanes (aic stays +inf, coefficients zero) and warn instead of
     # failing every other series
-    n_failed = int(np.sum(~np.isfinite(out_aic)))
+    n_failed = int(np.sum(~np.isfinite(out_aic))
+                   - (short_np.sum() if short_np is not None else 0))
     if n_failed:
         warnings.warn(
             f"auto_fit_panel: no admissible ARMA candidate for {n_failed} "
             f"series; their aic is +inf and coefficients are zero",
             stacklevel=2)
-    return PanelARIMAFit(np.asarray(orders, dtype=np.int64),
-                         np.asarray(coefs, dtype=np.float64),
-                         out_aic, max_p)
+    return PanelARIMAFit(out_orders, out_coefs, out_aic, max_p)

@@ -1,0 +1,91 @@
+"""Native (C++) runtime helpers, compiled on demand and always optional.
+
+The TPU compute path is JAX/XLA/Pallas; the host runtime around it — here,
+the persistence tier's text codec — goes native where the reference's does
+(the JVM's Double.toString/parseDouble under ``TimeSeriesRDD.scala:498-509``
+are C-speed codecs; CPython's equivalents are not).  Build model:
+
+- source ships in the package (``fastcsv.cpp``); the shared object is
+  compiled ONCE per source hash into ``~/.cache/spark_timeseries_tpu/``
+  (or ``STS_NATIVE_CACHE``) with plain ``g++ -O3 -shared -fPIC`` — no
+  pybind11, no build-system dependency; the ABI is C + ctypes;
+- every caller keeps a pure-Python fallback: no compiler, a failed build,
+  or ``STS_NO_NATIVE=1`` simply means the slow path (tests pin both paths
+  to identical bytes);
+- thread-safe and race-safe across processes (atomic rename into place).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+_SRC = os.path.join(os.path.dirname(__file__), "fastcsv.cpp")
+_lock = threading.Lock()
+_cached: dict = {}
+
+
+def _cache_dir() -> str:
+    base = os.environ.get("STS_NATIVE_CACHE")
+    if base:
+        return base
+    xdg = os.environ.get("XDG_CACHE_HOME",
+                         os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(xdg, "spark_timeseries_tpu")
+
+
+def _build(src: str, tag: str) -> Optional[str]:
+    """Compile ``src`` into the cache (atomic rename); None on failure."""
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    out_dir = _cache_dir()
+    so_path = os.path.join(out_dir, f"{tag}-{digest}.so")
+    if os.path.exists(so_path):
+        return so_path
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=out_dir)
+        os.close(fd)
+        res = subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp],
+            capture_output=True, timeout=120)
+        if res.returncode != 0:
+            os.unlink(tmp)
+            return None
+        os.replace(tmp, so_path)          # atomic: racing builders agree
+        return so_path
+    except Exception:                     # noqa: BLE001 — fall back to Python
+        return None
+
+
+def fastcsv() -> Optional[ctypes.CDLL]:
+    """The fastcsv shared library, building it on first use; None when
+    native is unavailable or disabled (``STS_NO_NATIVE=1``)."""
+    if os.environ.get("STS_NO_NATIVE") == "1":
+        return None
+    with _lock:
+        if "fastcsv" in _cached:
+            return _cached["fastcsv"]
+        lib = None
+        so = _build(_SRC, "fastcsv")
+        if so is not None:
+            try:
+                lib = ctypes.CDLL(so)
+                LL = ctypes.c_longlong
+                lib.sts_format_csv.restype = LL
+                lib.sts_format_csv.argtypes = [
+                    ctypes.c_char_p, LL, ctypes.c_void_p, LL, LL,
+                    ctypes.c_void_p]
+                lib.sts_parse_csv.restype = LL
+                lib.sts_parse_csv.argtypes = [
+                    ctypes.c_char_p, LL, LL, LL, ctypes.c_void_p,
+                    ctypes.c_void_p, ctypes.POINTER(LL)]
+            except Exception:             # noqa: BLE001
+                lib = None
+        _cached["fastcsv"] = lib
+        return lib

@@ -217,7 +217,8 @@ def bptest(residuals: jnp.ndarray,
     return stat, 1.0 - chi2.cdf(stat, df)
 
 
-def _newey_west_variance(errors: jnp.ndarray, lag: int) -> jnp.ndarray:
+def _newey_west_variance(errors: jnp.ndarray, lag: int,
+                         n_eff=None) -> jnp.ndarray:
     """Newey-West long-run variance with Bartlett weights, batched
     (ref ``TimeSeriesStatisticalTests.scala:405-431``, itself following R
     tseries' ppsum.c).
@@ -225,9 +226,13 @@ def _newey_west_variance(errors: jnp.ndarray, lag: int) -> jnp.ndarray:
     All ``lag`` autocovariances come from ONE stacked contraction (an MXU
     matmul over the panel) instead of a per-lag reduction loop — KPSS runs
     ``max_d + 1`` times over the whole panel inside ``auto_fit_panel``, so
-    this is on the batch hot path."""
+    this is on the batch hot path.
+
+    ``n_eff (...)`` replaces the denominator for ragged lanes whose
+    errors are zero beyond their valid window (zeros contribute nothing
+    to the sums, so only the normalization changes)."""
     e = jnp.asarray(errors)
-    n = e.shape[-1]
+    n = e.shape[-1] if n_eff is None else n_eff
     var0 = jnp.sum(e * e, axis=-1) / n
     if lag == 0:
         return var0
@@ -241,7 +246,7 @@ def _newey_west_variance(errors: jnp.ndarray, lag: int) -> jnp.ndarray:
     return 2.0 * jnp.sum(covs * w, axis=-1) / n + var0
 
 
-def kpsstest(ts: jnp.ndarray, method: str = "c"
+def kpsstest(ts: jnp.ndarray, method: str = "c", n_valid=None
              ) -> Tuple[jnp.ndarray, Dict[float, float]]:
     """KPSS stationarity test, batched
     (ref ``TimeSeriesStatisticalTests.scala:369-394``; R tseries semantics,
@@ -250,11 +255,33 @@ def kpsstest(ts: jnp.ndarray, method: str = "c"
     Returns ``(stat, critical_values)`` where ``stat`` has shape
     ``ts.shape[:-1]`` and the critical values are the KPSS table for the
     chosen method.
+
+    ``n_valid (...)`` restricts each lane to its left-aligned valid
+    window (``ops.ragged``; ``"c"`` only): the demeaning, partial sums,
+    long-run variance, and ``n²`` normalization all see the per-lane
+    window length.  One documented deviation: the Newey-West lag stays
+    the panel-level ``int(3·sqrt(n)/13)`` (a per-lane lag would be a
+    data-dependent shape) — for d-selection this only matters when
+    windows differ from the panel width by orders of magnitude.
     """
     if method not in ("c", "ct"):
         raise ValueError("method must be 'c' or 'ct'")
     ts = jnp.asarray(ts)
     n = ts.shape[-1]
+    if n_valid is not None:
+        if method != "c":
+            raise ValueError("n_valid supports method 'c' only")
+        nv = jnp.asarray(n_valid).astype(ts.dtype)
+        w = ((jnp.arange(n) < nv[..., None])).astype(ts.dtype)
+        mean = jnp.sum(ts * w, axis=-1, keepdims=True) \
+            / jnp.maximum(nv[..., None], 1.0)
+        resid = (ts - mean) * w
+        s2 = jnp.sum(jnp.cumsum(resid, axis=-1) ** 2 * w, axis=-1)
+        lag = int(3 * np.sqrt(n) / 13)
+        long_run_var = _newey_west_variance(resid, lag,
+                                            n_eff=jnp.maximum(nv, 1.0))
+        stat = (s2 / long_run_var) / jnp.maximum(nv * nv, 1.0)
+        return stat, KPSS_CONSTANT_CRITICAL_VALUES
     if method == "c":
         resid = ts - jnp.mean(ts, axis=-1, keepdims=True)
         critical_values = KPSS_CONSTANT_CRITICAL_VALUES

@@ -27,7 +27,20 @@ def panel():
     return stt.Panel(idx, jnp.asarray(vals), [f"s{i}" for i in range(5)])
 
 
-def test_csv_round_trip(tmp_path, panel):
+@pytest.fixture(params=["native", "python"])
+def csv_path_mode(request, monkeypatch):
+    """Run CSV tests through BOTH codecs: the on-demand C++ one and the
+    pure-Python fallback (decimal spellings differ — shortest repr vs
+    %.17g — but parsed values must be bit-identical either way)."""
+    import spark_timeseries_tpu.native as nat
+    if request.param == "python":
+        monkeypatch.setenv("STS_NO_NATIVE", "1")
+    elif nat.fastcsv() is None:
+        pytest.skip("native toolchain unavailable")
+    return request.param
+
+
+def test_csv_round_trip(tmp_path, panel, csv_path_mode):
     path = str(tmp_path / "panel_csv")
     stio.save_csv(panel, path)
     back = stio.load_csv(path)
@@ -37,7 +50,32 @@ def test_csv_round_trip(tmp_path, panel):
     assert back.index.to_string() == panel.index.to_string()
 
 
-def test_csv_round_trip_keys_with_delimiters(tmp_path):
+def test_csv_cross_codec_bit_exact(tmp_path, panel, monkeypatch):
+    # native-written files load bit-exactly through the Python loader and
+    # vice versa — the two codecs implement ONE file contract (shortest
+    # repr and %.17g decimals both round-trip float64 exactly)
+    import spark_timeseries_tpu.native as nat
+    if nat.fastcsv() is None:
+        pytest.skip("native toolchain unavailable")
+    vals = np.asarray(panel.values).copy()
+    vals[0, :7] = [5e-324, 1.7976931348623157e308, np.nan, np.inf,
+                   -np.inf, -0.0, 1 / 3]
+    p = stt.Panel(panel.index, jnp.asarray(vals), panel.keys)
+    d_nat, d_py = str(tmp_path / "nat"), str(tmp_path / "py")
+    stio.save_csv(p, d_nat)                       # native writer
+    monkeypatch.setenv("STS_NO_NATIVE", "1")
+    stio.save_csv(p, d_py)                        # python writer
+    back_py = stio.load_csv(d_nat)                # python reader <- native
+    monkeypatch.delenv("STS_NO_NATIVE")
+    back_nat = stio.load_csv(d_py)                # native reader <- python
+    for back in (back_py, back_nat):
+        assert back.keys == panel.keys
+        assert np.array_equal(
+            np.asarray(back.values, np.float64).view(np.int64),
+            vals.view(np.int64))
+
+
+def test_csv_round_trip_keys_with_delimiters(tmp_path, csv_path_mode):
     """Keys containing commas/quotes survive save/load (the reference's raw
     write corrupts them, TimeSeriesRDD.scala:498-509; quoting fixes the
     data loss while plain keys keep the bare file contract)."""
@@ -228,7 +266,7 @@ def test_yahoo_files_directory(tmp_path):
     np.testing.assert_allclose(b_open[1:], [18.0, 20.0])
 
 
-def test_load_csv_handles_nan_and_scale(tmp_path):
+def test_load_csv_handles_nan_and_scale(tmp_path, csv_path_mode):
     # vectorized parse path: NaN round-trips, and a wide panel loads fast
     from spark_timeseries_tpu.panel import Panel
     from spark_timeseries_tpu.time import uniform
@@ -247,7 +285,7 @@ def test_load_csv_handles_nan_and_scale(tmp_path):
     np.testing.assert_allclose(np.asarray(back.values), vals)
 
 
-def test_load_csv_rejects_corruption(tmp_path):
+def test_load_csv_rejects_corruption(tmp_path, csv_path_mode):
     # a truncated row or an empty field must fail loudly, not NaN-fill
     from spark_timeseries_tpu.time import uniform
     from spark_timeseries_tpu.time.frequency import DayFrequency
@@ -257,10 +295,13 @@ def test_load_csv_rejects_corruption(tmp_path):
     (d / "timeIndex").write_text(
         uniform("2020-01-01T00:00Z", 3, DayFrequency(1)).to_string())
     (d / "data.csv").write_text("a,1.0,2.0,3.0\nb,4.0,5.0\n")
-    with pytest.raises(ValueError, match="has 2 values"):
+    with pytest.raises(ValueError, match="corrupt data.csv"):
         stio.load_csv(str(d))
     (d / "data.csv").write_text("a,1.0,2.0,3.0\nb,4.0,,6.0\n")
-    with pytest.raises(ValueError, match="empty field"):
+    with pytest.raises(ValueError, match="corrupt data.csv"):
+        stio.load_csv(str(d))
+    (d / "data.csv").write_text("a,1.0,2.0,3.0\nb,4.0,xx,6.0\n")
+    with pytest.raises(ValueError, match="corrupt data.csv"):
         stio.load_csv(str(d))
 
 
