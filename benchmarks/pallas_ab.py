@@ -98,6 +98,12 @@ def main():
         emit(line)
 
     # --- one fused NE pass, chained so fixed costs amortize -----------------
+    # The Pallas side runs on PRE-blocked inputs — the layout its LM loop
+    # actually feeds it.  The r4 artifact's 0.91x pass line called
+    # normal_equations() per rep, which re-blocks (pads + transposes) the
+    # 64 MB panel every rep; the production driver hoists that, so the
+    # old line compared "XLA pass" against "Pallas pass + panel relayout"
+    # (the r4-verdict ~10x floor puzzle traced to exactly this).
     R = 8
     from bench import chained
 
@@ -111,14 +117,20 @@ def main():
         )(x, yy)
         return jnp.sum(sse) + 1e-30 * (jnp.sum(jtj) + jnp.sum(jtr))
 
-    def ne_pl(x, yy):
-        jtj, jtr, sse = pallas_arma.normal_equations(
-            x, yy, p, q, icpt, interpret=interpret)
+    S_y, n_y = y.shape
+    rows = pallas_arma._block_rows(S_y, n_y)
+    y_blocked, n_blocks = pallas_arma._blocked(
+        y.astype(jnp.float32), S_y, rows)
+
+    def ne_pl(x, yb):
+        jtj, jtr, sse = pallas_arma._ne_from_blocked(
+            x, yb, S_y, rows, n_blocks, p, q, icpt, n_y, interpret)
         return jnp.sum(sse) + 1e-30 * (jnp.sum(jtj) + jnp.sum(jtr))
 
     t_xla = timed(chained(ne_xla, R), init, y) / R
-    t_pl = timed(chained(ne_pl, R), init, y) / R
-    emit({"metric": f"fused NE pass ({S}x{n_obs} f32, chained x{R})",
+    t_pl = timed(chained(ne_pl, R), init, y_blocked) / R
+    emit({"metric": f"fused NE pass ({S}x{n_obs} f32, chained x{R}, "
+                    f"pallas pre-blocked)",
           "xla_ms": round(1e3 * t_xla, 3), "pallas_ms": round(1e3 * t_pl, 3),
           "speedup": round(t_xla / t_pl, 2), "unit": "ms/pass",
           **({"cpu_interpret": True} if interpret else {})})
