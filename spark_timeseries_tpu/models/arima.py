@@ -762,17 +762,19 @@ def _pallas_lm_mode(diffed: jnp.ndarray, nv) -> str:
     the vmapped XLA fused-carry path
     (``benchmarks/pallas_ab_r04_tpu.jsonl``).  Series-sharded panels
     keep the kernel via a per-shard ``shard_map`` wrap rather than
-    silently dropping to the XLA path (r4 verdict weak #4).
+    silently dropping to the XLA path (r4 verdict weak #4); ragged
+    panels keep it too — the kernel computes per-lane step weights in
+    VMEM (r5).
     """
     from ..ops.pallas_arma import route_mode
-    return route_mode(diffed, nv, allow_1d=True)
+    return route_mode(diffed, nv, allow_1d=True, allow_ragged=True)
 
 
 def _use_pallas_lm(diffed: jnp.ndarray, nv) -> bool:
     """Bool view for grid callers that have no shard_map wrap (the
     fused auto-fit); warns when a forced flag meets a sharded panel."""
     from ..ops.pallas_arma import route_panel
-    return route_panel(diffed, nv, allow_1d=True)
+    return route_panel(diffed, nv, allow_1d=True, allow_ragged=True)
 
 
 def fit(p: int, d: int, q: int, ts: jnp.ndarray,
@@ -789,8 +791,10 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
       residuals.  Maximizing the CSS likelihood is exactly minimizing the
       residual sum of squares (the likelihood is monotone in it,
       ``ARIMA.scala:430-445``), and LM stays robust in float32 on TPU where
-      a BFGS line search underflows.  On the TPU backend, dense float32
-      panels of >= 1024 series route through the Pallas fused-NE kernel
+      a BFGS line search underflows.  On the TPU backend, float32
+      panels of >= 1024 series — dense or NaN-padded ragged (the kernel
+      computes per-lane step weights in VMEM) — route through the
+      Pallas fused-NE kernel
       (``ops.pallas_arma.fit_css_lm``, measured 1.57x over the XLA
       path; smaller panels would mostly pad the kernel's 1024-lane
       blocks, and very long series would overflow a VMEM-resident
@@ -919,9 +923,13 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
             from ..ops.pallas_arma import fit_css_lm, fit_css_lm_sharded
             x2 = init if init.ndim == 2 else init[None]
             y2 = diffed if diffed.ndim == 2 else diffed[None]
+            nv2 = None
+            if nv is not None:
+                nv2 = jnp.atleast_1d(jnp.asarray(nv))
             solver = fit_css_lm_sharded if lm_mode == "pallas_shard_map" \
                 else fit_css_lm
-            res = MinimizeResult(*solver(x2, y2, p, q, icpt, max_iter=mi))
+            res = MinimizeResult(*solver(x2, y2, p, q, icpt, max_iter=mi,
+                                         n_valid=nv2))
             if init.ndim != 2:
                 res = MinimizeResult(res.x[0], res.fun[0],
                                      res.converged[0], res.n_iter[0])
@@ -1345,7 +1353,8 @@ def _auto_fit_panel_kernel(values: jnp.ndarray, masks_base: jnp.ndarray,
             from ..ops.pallas_arma import fit_css_lm
             lead = x0.shape[:-1]
             flat = fit_css_lm(x0.reshape(-1, k), y, max_p, max_q, 1,
-                              max_iter=iters, mask=mask.reshape(-1, k))
+                              max_iter=iters, mask=mask.reshape(-1, k),
+                              n_valid=n_valid)
             return MinimizeResult(flat[0].reshape(*lead, k),
                                   flat[1].reshape(lead),
                                   flat[2].reshape(lead),

@@ -106,15 +106,19 @@ def _series_sharding(y):
 
 def route_mode(y: jnp.ndarray, n_valid=None, allow_1d: bool = False,
                min_lanes: int = 1024, default_on: bool = True,
-               flag_env: str = "STS_PALLAS") -> str:
+               flag_env: str = "STS_PALLAS",
+               allow_ragged: bool = False) -> str:
     """Shared default-routing gate for the Pallas fit drivers; returns
     ``"pallas"`` (direct kernel call), ``"pallas_shard_map"`` (kernel
     per shard under :func:`fit_css_lm_sharded`), or ``"xla"``.
 
-    The kernels are (lanes, obs)-shaped and f32: ragged panels
-    (``n_valid``), deeper batch nests, and f64 parity fits always keep
-    the XLA path — under force too (forcing must never silently degrade
-    an f64 fit).  The default route additionally needs a real panel
+    The kernels are (lanes, obs)-shaped and f32: deeper batch nests and
+    f64 parity fits always keep the XLA path — under force too (forcing
+    must never silently degrade an f64 fit).  Ragged panels
+    (``n_valid``) are eligible only when the CALLER's driver threads the
+    per-lane window through (``allow_ragged=True`` — the ARMA NE kernel
+    does, r5; the Holt-Winters driver does not).  The default route
+    additionally needs a real panel
     (>= ``min_lanes`` series — smaller ones would mostly pad the
     1024-lane blocks), the TPU backend, and a block that fits VMEM
     (:func:`vmem_fits`; long-obs panels keep the streaming XLA path).
@@ -138,7 +142,8 @@ def route_mode(y: jnp.ndarray, n_valid=None, allow_1d: bool = False,
     unmeasured drivers).
     """
     nd_ok = y.ndim == 2 or (allow_1d and y.ndim == 1)
-    eligible = n_valid is None and nd_ok and y.dtype == jnp.float32
+    ragged_ok = n_valid is None or allow_ragged
+    eligible = ragged_ok and nd_ok and y.dtype == jnp.float32
     flag = os.environ.get(flag_env)
     if flag is not None and flag not in ("0", "1"):
         raise ValueError(f"{flag_env} must be '0' or '1', got {flag!r}")
@@ -167,13 +172,15 @@ def route_mode(y: jnp.ndarray, n_valid=None, allow_1d: bool = False,
 
 def route_panel(y: jnp.ndarray, n_valid=None, allow_1d: bool = False,
                 min_lanes: int = 1024, default_on: bool = True,
-                flag_env: str = "STS_PALLAS") -> bool:
+                flag_env: str = "STS_PALLAS",
+                allow_ragged: bool = False) -> bool:
     """Bool view of :func:`route_mode` for callers without a shard_map
     wrapper (the Holt-Winters driver, the auto-fit grid): True only for
     the direct path.  A FORCED flag meeting the sharded shape falls back
     to XLA *loudly* — forcing must never silently degrade."""
     mode = route_mode(y, n_valid, allow_1d=allow_1d, min_lanes=min_lanes,
-                      default_on=default_on, flag_env=flag_env)
+                      default_on=default_on, flag_env=flag_env,
+                      allow_ragged=allow_ragged)
     if mode == "pallas_shard_map" and os.environ.get(flag_env) == "1":
         import warnings
         warnings.warn(
@@ -219,11 +226,13 @@ def _triu_pairs(k: int):
     return [(a, b) for a in range(k) for b in range(a, k)]
 
 
-def _ne_kernel(p: int, q: int, icpt: int, n_obs: int,
-               params_ref, y_ref, out_ref):
+def _ne_kernel(p: int, q: int, icpt: int, n_obs: int, ragged: bool,
+               params_ref, *refs):
     """One lane block.  ``params (k, ROWS, 128)``, ``y (n_obs, ROWS, 128)``
     VMEM-resident; ``out (n_out, ROWS, 128)`` with
     ``n_out = 1 + len(triu) + k`` laid out ``[sse, jtj_triu..., jtr...]``.
+    ``ragged`` adds an ``nv (1, ROWS, 128)`` input after params: the
+    per-lane valid-window length.
 
     The recurrence per step (matching ``arima._arma_normal_eqs``):
 
@@ -233,7 +242,14 @@ def _ne_kernel(p: int, q: int, icpt: int, n_obs: int,
         sse += e², jtj += T Tᵀ (triu), jtr += T e
 
     starting at t = max(p, q) with zero rings — identical conditioning.
+    Ragged lanes weight ``e`` and ``T`` by ``(t < nv)`` BEFORE the
+    accumulators and the ring pushes, exactly the XLA kernel's order, so
+    results equal the trimmed series' (the zero tail never contributes).
     """
+    if ragged:
+        nv_ref, y_ref, out_ref = refs
+    else:
+        y_ref, out_ref = refs
     k = icpt + p + q
     max_lag = max(p, q)
     pairs = _triu_pairs(k)
@@ -245,11 +261,13 @@ def _ne_kernel(p: int, q: int, icpt: int, n_obs: int,
     c = params_ref[0] if icpt else zero
     phi = [params_ref[icpt + j] for j in range(p)]
     theta = [params_ref[icpt + p + m] for m in range(q)]
+    nv = nv_ref[0] if ragged else None
 
-    def steps(y_chunk, y_lag_chunks, carry, count):
-        """``count`` static steps; every index below is static.
+    def steps(y_chunk, y_lag_chunks, carry, count, base_abs):
+        """``count`` static steps; every VMEM index below is static.
         ``y_chunk[i]`` is y_t for step i; ``y_lag_chunks[j][i]`` is
-        y_{t-j-1}."""
+        y_{t-j-1}; ``base_abs`` is step 0's absolute time index (traced
+        under the fori_loop) for the ragged step weight."""
         e_ring, T_ring, sse, jtj, jtr = carry
         for i in range(count):
             y_t = y_chunk[i]
@@ -271,6 +289,10 @@ def _ne_kernel(p: int, q: int, icpt: int, n_obs: int,
                 for m in range(q):
                     s = s + theta[m] * T_ring[m][x]
                 T.append(-s)
+            if ragged:
+                w = jnp.where((base_abs + i) < nv, zero + 1.0, zero)
+                e = e * w
+                T = [t_x * w for t_x in T]
             sse = sse + e * e
             jtj = [jtj[idx] + T[a] * T[b]
                    for idx, (a, b) in enumerate(pairs)]
@@ -302,7 +324,7 @@ def _ne_kernel(p: int, q: int, icpt: int, n_obs: int,
         lag_c = [y_ref[pl.ds(base - (j + 1), TIME_CHUNK)] for j in range(p)]
         carry = steps([y_c[i] for i in range(TIME_CHUNK)],
                       [[lc[i] for i in range(TIME_CHUNK)] for lc in lag_c],
-                      unflatten(flat), TIME_CHUNK)
+                      unflatten(flat), TIME_CHUNK, base)
         return flatten(carry)
 
     carry0 = ([zero] * q, [[zero] * k for _ in range(q)], zero,
@@ -314,7 +336,7 @@ def _ne_kernel(p: int, q: int, icpt: int, n_obs: int,
         y_c = [y_ref[base + i] for i in range(tail)]
         lag_c = [[y_ref[base + i - (j + 1)] for i in range(tail)]
                  for j in range(p)]
-        carry = steps(y_c, lag_c, unflatten(flat), tail)
+        carry = steps(y_c, lag_c, unflatten(flat), tail, base)
     else:
         carry = unflatten(flat)
     _, _, sse, jtj, jtr = carry
@@ -327,22 +349,27 @@ def _ne_kernel(p: int, q: int, icpt: int, n_obs: int,
 
 @functools.lru_cache(maxsize=None)
 def _build_call(p: int, q: int, icpt: int, n_obs: int, n_blocks: int,
-                rows: int, interpret: bool, y_blocks: int | None = None):
+                rows: int, interpret: bool, y_blocks: int | None = None,
+                ragged: bool = False):
     """``y_blocks`` < ``n_blocks`` re-reads the same panel blocks for
     several parameter blocks (candidate-major grid lanes over one shared
-    panel): param/out block ``i`` pairs with y block ``i % y_blocks``."""
+    panel): param/out block ``i`` pairs with y block ``i % y_blocks``.
+    ``ragged`` adds the per-lane ``nv`` input, block-mapped like ``y``
+    (it is a property of the PANEL lane, so the grid's modulo map
+    applies to it too)."""
     k = icpt + p + q
     n_out = 1 + len(_triu_pairs(k)) + k
-    kernel = functools.partial(_ne_kernel, p, q, icpt, n_obs)
+    kernel = functools.partial(_ne_kernel, p, q, icpt, n_obs, ragged)
     y_map = (lambda i: (0, i % y_blocks, 0, 0)) if y_blocks \
         else (lambda i: (0, i, 0, 0))
+    in_specs = [pl.BlockSpec((k, 1, rows, LANES), lambda i: (0, i, 0, 0))]
+    if ragged:
+        in_specs.append(pl.BlockSpec((1, 1, rows, LANES), y_map))
+    in_specs.append(pl.BlockSpec((n_obs, 1, rows, LANES), y_map))
     return pl.pallas_call(
         kernel,
         grid=(n_blocks,),
-        in_specs=[
-            pl.BlockSpec((k, 1, rows, LANES), lambda i: (0, i, 0, 0)),
-            pl.BlockSpec((n_obs, 1, rows, LANES), y_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((n_out, 1, rows, LANES),
                                lambda i: (0, i, 0, 0)),
         out_shape=jax.ShapeDtypeStruct(
@@ -366,6 +393,7 @@ def _blocked(x: jnp.ndarray, n_series: int, rows: int):
 def normal_equations(params: jnp.ndarray, y: jnp.ndarray,
                      p: int, q: int, icpt: int,
                      mask: jnp.ndarray | None = None,
+                     n_valid: jnp.ndarray | None = None,
                      interpret: bool | None = None):
     """Batched fused ``(JᵀJ (S, k, k), Jᵀr (S, k), sse (S,))`` for the ARMA
     CSS residuals — drop-in numerics for ``arima._arma_normal_eqs`` over a
@@ -375,7 +403,11 @@ def normal_equations(params: jnp.ndarray, y: jnp.ndarray,
     ``r(x ∘ mask)`` exactly as the XLA kernel does
     (``arima._arma_normal_eqs``): the recurrence runs at the masked
     point and the chain-rule factor is an outer-product scale on the
-    outputs — nothing inside the Pallas kernel changes."""
+    outputs — nothing inside the Pallas kernel changes.
+
+    ``n_valid`` (S,) restricts each lane to its left-aligned valid
+    window (``ops.ragged``): step weights are computed in-kernel from
+    the per-lane length, so ragged panels keep the VMEM-resident path."""
     if interpret is None:
         interpret = not use_pallas()
     k = icpt + p + q
@@ -388,11 +420,15 @@ def normal_equations(params: jnp.ndarray, y: jnp.ndarray,
             f"max(p, q) = {max(p, q)} observations, got {n_obs}")
     rows = _block_rows(S, n_obs)
     y_b, n_blocks = _blocked(y.astype(jnp.float32), S, rows)
+    nv_b = None
+    if n_valid is not None:
+        nv_b, _ = _blocked(
+            jnp.asarray(n_valid, jnp.float32)[:, None], S, rows)
     if mask is not None:
         mask = mask.astype(jnp.float32)
         params = params * mask
     out = _ne_from_blocked(params, y_b, S, rows, n_blocks, p, q,
-                           icpt, n_obs, interpret)
+                           icpt, n_obs, interpret, nv_b=nv_b)
     return _masked_ne(*out, mask) if mask is not None else out
 
 
@@ -404,12 +440,13 @@ def _masked_ne(jtj, jtr, sse, mask):
 
 
 def _ne_from_blocked(params, y_b, S, rows, n_blocks, p, q, icpt, n_obs,
-                     interpret, y_blocks=None):
+                     interpret, y_blocks=None, nv_b=None):
     k = icpt + p + q
     params_b, _ = _blocked(params.astype(jnp.float32), S, rows)
     call = _build_call(p, q, icpt, n_obs, n_blocks, rows, interpret,
-                       y_blocks)
-    out = call(params_b, y_b)                     # (n_out, nb, rows, 128)
+                       y_blocks, nv_b is not None)
+    out = call(params_b, *(() if nv_b is None else (nv_b,)),
+               y_b)                               # (n_out, nb, rows, 128)
     out = out.reshape(out.shape[0], -1)[:, :S].T  # (S, n_out)
     pairs = _triu_pairs(k)
     sse = out[:, 0]
@@ -426,6 +463,7 @@ def _ne_from_blocked(params, y_b, S, rows, n_blocks, p, q, icpt, n_obs,
 def fit_css_lm(x0: jnp.ndarray, y: jnp.ndarray, p: int, q: int, icpt: int,
                tol: float = 1e-6, max_iter: int = 50,
                mask: jnp.ndarray | None = None,
+               n_valid: jnp.ndarray | None = None,
                interpret: bool | None = None):
     """Panel-batched Levenberg-Marquardt on the CSS residuals with the
     normal equations built by the Pallas kernel.
@@ -450,6 +488,11 @@ def fit_css_lm(x0: jnp.ndarray, y: jnp.ndarray, p: int, q: int, icpt: int,
     not divide ``S``, every candidate's lane run is padded up to the
     block boundary (padded lanes start ``done`` and are sliced off the
     results) — the panel is never tiled.
+
+    ``n_valid (S,)`` restricts each PANEL lane to its left-aligned
+    valid window (``ops.ragged``): the kernel computes step weights
+    from the per-lane length in VMEM, so ragged panels keep the
+    Pallas path (r5; previously they always fell back to XLA).
     """
     if interpret is None:
         interpret = not use_pallas()
@@ -465,6 +508,8 @@ def fit_css_lm(x0: jnp.ndarray, y: jnp.ndarray, p: int, q: int, icpt: int,
             f"max(p, q) = {max(p, q)} observations, got {n_obs}")
     y_blocks = None
     n_real, pad = S, 0
+    if n_valid is not None:
+        n_valid = jnp.asarray(n_valid, jnp.float32)
     if S != S_y:
         if S % S_y:
             raise ValueError(
@@ -486,19 +531,25 @@ def fit_css_lm(x0: jnp.ndarray, y: jnp.ndarray, p: int, q: int, icpt: int,
             if mask is not None:
                 mask = jnp.pad(mask.reshape(C, S_y, k),
                                ((0, 0), (0, pad), (0, 0))).reshape(-1, k)
+            if n_valid is not None:
+                n_valid = jnp.pad(n_valid, (0, pad))
             S = C * (S_y + pad)
         y_b, y_blocks = _blocked(y.astype(jnp.float32), S_y + pad, rows)
         n_blocks = S // block
     else:
         rows = _block_rows(S, n_obs)
         y_b, n_blocks = _blocked(y.astype(jnp.float32), S, rows)
+    nv_b = None
+    if n_valid is not None:
+        nv_b, _ = _blocked(n_valid[:, None],
+                           (S_y + pad) if y_blocks else S, rows)
     eye = jnp.eye(k, dtype=jnp.float32)
 
     def ne(x):
         if mask is not None:
             x = x * mask
         out = _ne_from_blocked(x, y_b, S, rows, n_blocks, p, q,
-                               icpt, n_obs, interpret, y_blocks)
+                               icpt, n_obs, interpret, y_blocks, nv_b)
         return _masked_ne(*out, mask) if mask is not None else out
 
     def body(state):
@@ -560,6 +611,7 @@ def fit_css_lm(x0: jnp.ndarray, y: jnp.ndarray, p: int, q: int, icpt: int,
 
 def fit_css_lm_sharded(x0: jnp.ndarray, y: jnp.ndarray, p: int, q: int,
                        icpt: int, tol: float = 1e-6, max_iter: int = 50,
+                       n_valid: jnp.ndarray | None = None,
                        interpret: bool | None = None):
     """:func:`fit_css_lm` on a series-sharded panel, kernel-per-shard.
 
@@ -580,13 +632,21 @@ def fit_css_lm_sharded(x0: jnp.ndarray, y: jnp.ndarray, p: int, q: int,
     mesh, axis, _ = _series_sharding(y)
     lane_sharding = NamedSharding(mesh, P(axis, None))
     x0 = jax.device_put(x0.astype(jnp.float32), lane_sharding)
+    args = (x0, y)
+    in_specs = (P(axis, None), P(axis, None))
+    if n_valid is not None:
+        args += (jax.device_put(jnp.asarray(n_valid, jnp.float32),
+                                NamedSharding(mesh, P(axis))),)
+        in_specs += (P(axis),)
 
-    def per_shard(x0_l, y_l):
+    def per_shard(x0_l, y_l, *nv_l):
         return fit_css_lm(x0_l, y_l, p, q, icpt, tol=tol,
-                          max_iter=max_iter, interpret=interpret)
+                          max_iter=max_iter,
+                          n_valid=nv_l[0] if nv_l else None,
+                          interpret=interpret)
 
     return jax.shard_map(
         per_shard, mesh=mesh,
-        in_specs=(P(axis, None), P(axis, None)),
+        in_specs=in_specs,
         out_specs=(P(axis, None), P(axis), P(axis), P(axis)),
-        check_vma=False)(x0, y)
+        check_vma=False)(*args)

@@ -94,14 +94,14 @@ def test_fit_routes_through_pallas_when_forced(monkeypatch):
                        - np.asarray(m_xla.coefficients)), axis=1)[conv]
     assert np.median(dx) < 2e-3 and np.mean(dx < 5e-3) >= 0.9
 
-    # ragged panels must stay on the (mask-aware) XLA path even when
-    # forced — float32, so it is the nv gate (not the dtype gate) that
-    # keeps the kernel out; the spy proves it never ran
+    # ragged panels KEEP the kernel (r5: per-lane step weights are
+    # computed in VMEM) — the spy proves the driver ran, and the lane
+    # results stay finite
     calls.clear()
     y_rag = y.copy()                                  # float32
     y_rag[0, :7] = np.nan
     m_rag = arima.fit(1, 0, 1, jnp.asarray(y_rag), warn=False)
-    assert not calls
+    assert calls, "forced ragged fit must reach the Pallas driver (r5)"
     assert np.isfinite(np.asarray(m_rag.coefficients)).all()
     assert m_rag.coefficients.dtype == jnp.float32
 
@@ -411,3 +411,67 @@ def test_default_route_shard_map_equivalence(monkeypatch, mesh):
     dx = np.max(np.abs(np.asarray(m_shard.coefficients, np.float64)
                        - np.asarray(m_xla.coefficients)), axis=1)[conv]
     assert np.median(dx) < 2e-3 and np.mean(dx < 5e-3) >= 0.9
+
+
+def test_normal_equations_ragged_matches_xla_kernel():
+    # per-lane valid windows computed IN-kernel must reproduce the XLA
+    # kernel's n_valid weighting exactly (same accumulators, same ring
+    # contents — the weighted e/T enter the rings)
+    rng = np.random.default_rng(3)
+    S, n = 160, 96
+    y = _panel(rng, S, n)
+    nv = rng.integers(10, n + 1, size=S)
+    # zero the tails like ragged_view's left-aligned output
+    y = y * (np.arange(n)[None, :] < nv[:, None])
+    params = (0.1 * rng.normal(size=(S, 5))).astype(np.float32)
+
+    jtj, jtr, sse = pallas_arma.normal_equations(
+        jnp.asarray(params), jnp.asarray(y), 2, 2, 1,
+        n_valid=jnp.asarray(nv), interpret=True)
+    ref = jax.vmap(lambda prm, yy, vv: arima._arma_normal_eqs(
+        prm, yy, 2, 2, 1, n_valid=vv))(
+        jnp.asarray(params), jnp.asarray(y), jnp.asarray(nv))
+    np.testing.assert_allclose(np.asarray(jtj), np.asarray(ref[0]),
+                               rtol=2e-4, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(jtr), np.asarray(ref[1]),
+                               rtol=2e-4, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(sse), np.asarray(ref[2]),
+                               rtol=2e-4, atol=2e-2)
+
+
+def test_ragged_fit_routes_pallas_and_matches_xla(monkeypatch):
+    # a NaN-padded panel keeps the Pallas path (r5) and lands on the
+    # same per-lane results as the XLA ragged fit
+    rng = np.random.default_rng(7)
+    S, n = 48, 100
+    clean = _panel(rng, S, n).astype(np.float64)
+    starts = rng.integers(0, 20, size=S)
+    padded = np.full((S, n), np.nan)
+    for i, s in enumerate(starts):
+        padded[i, s:] = clean[i, s:]
+
+    calls = []
+    real = pallas_arma.fit_css_lm
+    monkeypatch.setattr(pallas_arma, "fit_css_lm",
+                        lambda *a, **kw: calls.append(1) or real(*a, **kw))
+    monkeypatch.setenv("STS_PALLAS", "1")
+    m_pl = arima.fit(1, 0, 1, jnp.asarray(padded, jnp.float32), warn=False)
+    assert calls, "ragged fit must reach the Pallas driver when forced"
+
+    monkeypatch.setenv("STS_PALLAS", "0")
+    m_xla = arima.fit(1, 0, 1, jnp.asarray(padded, jnp.float32), warn=False)
+    conv = np.asarray(m_pl.diagnostics.converged) \
+        & np.asarray(m_xla.diagnostics.converged)
+    assert conv.mean() > 0.7
+    dx = np.max(np.abs(np.asarray(m_pl.coefficients, np.float64)
+                       - np.asarray(m_xla.coefficients)), axis=1)[conv]
+    assert np.median(dx) < 2e-3 and np.mean(dx < 5e-3) >= 0.85
+
+
+def test_route_mode_ragged(monkeypatch):
+    monkeypatch.setattr(pallas_arma, "use_pallas", lambda: True)
+    y = jnp.zeros((8192, 128), jnp.float32)
+    nv = jnp.full((8192,), 100)
+    # ragged is eligible only where the caller's driver threads it
+    assert pallas_arma.route_mode(y, nv, allow_ragged=True) == "pallas"
+    assert pallas_arma.route_mode(y, nv) == "xla"
