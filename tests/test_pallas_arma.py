@@ -475,3 +475,34 @@ def test_route_mode_ragged(monkeypatch):
     # ragged is eligible only where the caller's driver threads it
     assert pallas_arma.route_mode(y, nv, allow_ragged=True) == "pallas"
     assert pallas_arma.route_mode(y, nv) == "xla"
+
+
+def test_sharded_ragged_fit_matches_unsharded(monkeypatch, mesh):
+    # the full routing matrix corner: a series-sharded AND NaN-padded
+    # panel — fit must thread the per-lane windows through the shard_map
+    # wrap and agree with the unsharded ragged kernel fit per lane
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rng = np.random.default_rng(23)
+    S, n = 32, 80
+    clean = _panel(rng, S, n).astype(np.float64)
+    padded = np.full((S, n), np.nan)
+    for i, s in enumerate(rng.integers(0, 12, size=S)):
+        padded[i, s:] = clean[i, s:]
+    monkeypatch.setenv("STS_PALLAS", "1")
+
+    calls = []
+    real = pallas_arma.fit_css_lm_sharded
+    monkeypatch.setattr(pallas_arma, "fit_css_lm_sharded",
+                        lambda *a, **kw: calls.append(1) or real(*a, **kw))
+
+    sharded = jax.device_put(jnp.asarray(padded, jnp.float32),
+                             NamedSharding(mesh, P("series", None)))
+    m_shard = arima.fit(1, 0, 1, sharded, warn=False)
+    assert calls, "sharded ragged fit must use the shard_map wrap"
+
+    m_flat = arima.fit(1, 0, 1, jnp.asarray(padded, jnp.float32),
+                       warn=False)
+    np.testing.assert_allclose(np.asarray(m_shard.coefficients),
+                               np.asarray(m_flat.coefficients),
+                               rtol=2e-4, atol=2e-4)
