@@ -17,10 +17,10 @@ chunk (131072 x 128 f32, ARIMA(2,1,2), override via ``AB_N_SERIES`` /
 - the PUBLIC ``arima.fit`` end to end, ``STS_PALLAS=0`` vs forced
   (``AB_N_SERIES x AB_N_OBS``);
 - ``auto_fit_panel``'s fused grid, XLA vs Pallas screen/refine
-  (``AB_GRID_SERIES`` lanes, clamped to the panel);
-- the Holt-Winters box fit, vmapped ``minimize_box`` vs the
-  ``pallas_hw.fit_box`` driver (``AB_HW_SERIES x AB_HW_OBS`` — the
-  number that decides ``holt_winters.fit``'s ``default_on`` flip).
+  (``AB_GRID_SERIES`` lanes, clamped to the panel).
+
+(The Holt-Winters box-fit A/B lives with its archived driver in
+``docs/experiments/hw_pallas.py``, runnable directly.)
 
 Prints one JSON line per measurement; shares ``bench._resolve_platform``
 (probe in subprocess, labeled degraded CPU fallback, rc 0 either way).
@@ -197,46 +197,9 @@ def main():
     emit_ab(f"auto_fit_panel grid (p,q<=2, d<=2) ({S_grid}x{n_obs} f32)",
             grid_wall("0"), grid_wall("1"), "s/search", n_items=S_grid)
 
-    # --- Holt-Winters box fit: Pallas driver vs vmapped minimize_box --------
-    # (the routing default in holt_winters.fit is OFF until this line
-    # shows a win on the real chip — flip default_on with the number)
-    from spark_timeseries_tpu.models.holt_winters import (
-        _hw_sse_value_and_grad)
-    from spark_timeseries_tpu.ops import pallas_hw
-    from spark_timeseries_tpu.ops.optimize import minimize_box
-
-    S_hw = int(os.environ.get("AB_HW_SERIES", "4096" if on_tpu else "256"))
-    n_hw = int(os.environ.get("AB_HW_OBS", "120" if on_tpu else "48"))
-    period = 12 if on_tpu else 8
-    t_ax = np.arange(n_hw)
-    hw_y = (10.0 + 0.05 * t_ax + 2.0 * np.sin(2 * np.pi * t_ax / period)
-            )[None, :] + 0.3 * np.random.default_rng(0).normal(
-        size=(S_hw, n_hw))
-    hw_y = jnp.asarray(hw_y, jnp.float32)
-    hw_x0 = jnp.broadcast_to(jnp.asarray([0.3, 0.1, 0.1], jnp.float32),
-                             (S_hw, 3))
-    hw_iter = 200
-
-    def hw_xla():
-        def run(x0, y):
-            return minimize_box(
-                lambda p, s: _hw_sse_value_and_grad(p, s, period,
-                                                    "additive")[0],
-                x0, 0.0, 1.0, y, tol=1e-6, max_iter=hw_iter,
-                value_and_grad_fn=lambda p, s: _hw_sse_value_and_grad(
-                    p, s, period, "additive")).x
-        return timed(jax.jit(run), hw_x0, hw_y)
-
-    def hw_pl():
-        def run(x0, y):
-            return pallas_hw.fit_box(x0, y, period, "additive", tol=1e-6,
-                                     max_iter=hw_iter,
-                                     interpret=interpret)[0]
-        return timed(jax.jit(run), hw_x0, hw_y)
-
-    emit_ab(f"HoltWinters additive box fit ({S_hw}x{n_hw} f32, "
-            f"period={period}, max_iter={hw_iter})",
-            hw_xla(), hw_pl(), "s/fit", n_items=S_hw)
+    # (the Holt-Winters Pallas A/B moved with its archived driver to
+    # docs/experiments/hw_pallas.py — run that file directly on a
+    # healthy chip; the r4-r5 chips never admitted the measurement)
 
 
 if __name__ == "__main__":

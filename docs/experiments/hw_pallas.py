@@ -21,10 +21,28 @@ on the projected-gradient arc, per-lane convergence) in plain array ops
 — one kernel dispatch per line-search trial for the whole panel, where
 the vmapped driver pays XLA's batched while-in-while carry masking.
 
-Numerics are pinned to ``_hw_sse_value_and_grad`` (itself pinned to
-autodiff) by ``tests/test_pallas_hw.py``; the routing default stays OFF
-until ``benchmarks/pallas_ab.py``'s HW A/B measures a win on the real
-chip (the build-measure-then-ship discipline from rounds 3-4).
+ARCHIVED (round 5, unmeasured): this driver shipped opt-in behind
+``STS_PALLAS_HW=1`` in round 4 explicitly "until its A/B line is
+captured on chip" — and the chip never admitted the capture: the one
+healthy tunnel window of round 5 (08:32-08:51 UTC) wedged mid-
+``pallas_ab.py`` before the HW line ran, and the wedge outlasted the
+round (probe log: ``benchmarks/probe_log_r05.txt``).  The
+build-measure-then-ship discipline cuts both ways: a perf path that was
+never measured does not ship, even gated — so the driver moved here and
+``holt_winters.fit`` keeps the measured XLA box fit
+(``ops.optimize.minimize_box`` over the fused value-and-grad pass) as
+its only path.
+
+To measure and revive: run ``python docs/experiments/hw_pallas.py`` on
+a healthy chip — it prints the A/B JSON line (this driver vs the
+vmapped ``minimize_box``, the capture shape r4's ``pallas_ab.py`` used).
+If it clears ~1.2x, restore the file to ``ops/pallas_hw.py``, re-wire
+the ``route_panel`` gate in ``holt_winters.fit`` (git history:
+``models/holt_winters.py`` @ r4-r5, gate at the ``minimize_box`` call),
+and resurrect ``tests/test_pallas_hw.py`` from git history (it pinned
+this kernel to ``_hw_sse_value_and_grad`` at interpret mode).  The
+numerics were green when archived: the kernel matched the XLA pass and
+the driver's fits matched ``minimize_box`` per lane.
 """
 
 from __future__ import annotations
@@ -35,8 +53,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from .pallas_arma import (LANES, TIME_CHUNK, _block_rows, _blocked,
-                          use_pallas)
+from spark_timeseries_tpu.ops.pallas_arma import (LANES, TIME_CHUNK,
+                                                  _block_rows, _blocked,
+                                                  use_pallas)
 
 
 def _hw_kernel(m: int, additive: bool, n_steps: int,
@@ -282,3 +301,58 @@ def fit_box(x0: jnp.ndarray, series: jnp.ndarray, period: int,
         cond, body, (x0, f0, g0, jnp.zeros((S,), jnp.int32),
                      jnp.asarray(0), jnp.zeros((S,), bool)))
     return x, f, done, it_lanes
+
+
+if __name__ == "__main__":
+    # The A/B that decides revival (see the module docstring): this
+    # driver vs the shipped vmapped minimize_box, at the shape round
+    # 4's pallas_ab.py used.  Run on a healthy chip; off-TPU the kernel
+    # interprets (hours — smoke only at tiny HW_AB_* overrides).
+    import json
+    import os
+    import sys
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+    from bench import timed_min
+    from spark_timeseries_tpu.models.holt_winters import (
+        _hw_sse_value_and_grad)
+    from spark_timeseries_tpu.ops.optimize import minimize_box
+
+    on_tpu = use_pallas()
+    S = int(os.environ.get("HW_AB_SERIES", "4096" if on_tpu else "64"))
+    n = int(os.environ.get("HW_AB_OBS", "120" if on_tpu else "32"))
+    period = 12 if on_tpu else 8
+    t_ax = np.arange(n)
+    y = (10.0 + 0.05 * t_ax + 2.0 * np.sin(2 * np.pi * t_ax / period)
+         )[None, :] + 0.3 * np.random.default_rng(0).normal(size=(S, n))
+    y = jnp.asarray(y, jnp.float32)
+    x0 = jnp.broadcast_to(jnp.asarray([0.3, 0.1, 0.1], jnp.float32),
+                          (S, 3))
+    iters = 200
+
+    def xla():
+        def run(x0_, y_):
+            return minimize_box(
+                lambda p, s: _hw_sse_value_and_grad(p, s, period,
+                                                    "additive")[0],
+                x0_, 0.0, 1.0, y_, tol=1e-6, max_iter=iters,
+                value_and_grad_fn=lambda p, s: _hw_sse_value_and_grad(
+                    p, s, period, "additive")).x
+        return timed_min(jax.jit(run), x0, y)
+
+    def pl_():
+        def run(x0_, y_):
+            return fit_box(x0_, y_, period, "additive", tol=1e-6,
+                           max_iter=iters, interpret=not on_tpu)[0]
+        return timed_min(jax.jit(run), x0, y)
+
+    t_x, t_p = xla(), pl_()
+    print(json.dumps({
+        "metric": f"HoltWinters additive box fit ({S}x{n} f32, "
+                  f"period={period}, max_iter={iters})",
+        "xla_s": round(t_x, 3), "pallas_s": round(t_p, 3),
+        "speedup": round(t_x / t_p, 2), "unit": "s/fit",
+        "revive_if": ">= ~1.2x on a healthy chip"}))

@@ -391,22 +391,13 @@ def fit(ts: jnp.ndarray, period: int, model_type: str = "additive",
     vag = value_and_grad if fused else None
 
     x0 = jnp.broadcast_to(jnp.asarray(init, ts.dtype), (*ts.shape[:-1], 3))
-    # Pallas driver (ops/pallas_hw.py): VMEM-resident carry + batched
-    # backtracking, one kernel dispatch per line-search trial.  OPT-IN
-    # via its OWN flag (STS_PALLAS_HW=1 — so forcing the measured ARIMA
-    # kernel with STS_PALLAS=1 never opts into this unmeasured one)
-    # until benchmarks/pallas_ab.py's HW A/B measures a win on the real
-    # chip; flip default_on=True and move to the shared flag with the
-    # measured number when it lands.
-    from ..ops.pallas_arma import route_panel
-    if route_panel(ts, obs_len, default_on=False,
-                   flag_env="STS_PALLAS_HW"):
-        from ..ops import pallas_hw
-        res = MinimizeResult(*pallas_hw.fit_box(
-            x0, ts, period, model_type, tol=tol, max_iter=max_iter))
-    else:
-        res = minimize_box(objective, x0, 0.0, 1.0, ts, *extra, tol=tol,
-                           max_iter=max_iter, value_and_grad_fn=vag)
+    # A Pallas VMEM-resident box-fit driver was built in round 4 but its
+    # A/B was never admitted by the chip; build-measure-then-ship cuts
+    # both ways, so it is archived with its revival recipe in
+    # docs/experiments/hw_pallas.py and the measured XLA box fit is the
+    # one shipped path.
+    res = minimize_box(objective, x0, 0.0, 1.0, ts, *extra, tol=tol,
+                       max_iter=max_iter, value_and_grad_fn=vag)
     ok = jnp.all(jnp.isfinite(res.x), axis=-1, keepdims=True)
     p = jnp.where(ok, res.x, x0)
     conv = diagnostics_from(res, ok)
