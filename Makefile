@@ -1,0 +1,18 @@
+# Canonical build/verify entry points — builders, reviewers, and CI all
+# invoke the same line (ROADMAP.md "Tier-1 verify").
+
+PY ?= python
+
+.PHONY: verify compileall tier1
+
+# byte-compile the whole package (catches syntax errors in files the test
+# sweep doesn't import) then run the tier-1 test sweep
+verify: compileall tier1
+
+compileall:
+	$(PY) -m compileall -q spark_timeseries_tpu
+
+tier1:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider \
+		-p no:xdist -p no:randomly

@@ -136,25 +136,15 @@ def _resolve_platform():
 
 
 def timed_min(fn, *args, reps: int = 3, want_out: bool = False):
-    """Wall-time ``fn(*args)`` (materializing every output), min over
-    ``reps`` after one warm call: the tunnel's per-call RTT jitter is
-    strictly additive noise, so the minimum is the cleanest estimator.
-    Shared by the benchmark entry points (``roofline.py``,
-    ``pallas_ab.py``, ``bench_suite.py``) so their timing protocol
-    cannot drift apart.  ``want_out=True`` returns ``(seconds, out)``
-    with the last run's materialized outputs."""
-    import time as _time
-
-    import jax
-    import numpy as _np
-
-    out = jax.tree_util.tree_map(_np.asarray, fn(*args))  # warm + sync
-    best = float("inf")
-    for _ in range(reps):
-        t0 = _time.perf_counter()
-        out = jax.tree_util.tree_map(_np.asarray, fn(*args))
-        best = min(best, _time.perf_counter() - t0)
-    return (best, out) if want_out else best
+    """Thin wrapper over the shared min-estimator harness
+    (``spark_timeseries_tpu.utils.observability.timed_min`` — the one
+    place the protocol is documented and implemented).  Kept here because
+    the benchmark entry points (``roofline.py``, ``pallas_ab.py``,
+    ``bench_suite.py``, ``docs/experiments/hw_pallas.py``) import it as
+    ``from bench import timed_min``; the import is deferred so merely
+    importing bench.py never initializes a JAX backend."""
+    from spark_timeseries_tpu.utils.observability import timed_min as _tm
+    return _tm(fn, *args, reps=reps, want_out=want_out)
 
 
 def chained(pass_fn, reps: int):
@@ -295,18 +285,42 @@ def _peak_memory_bytes():
 def main():
     platform, degraded = _resolve_platform()
 
+    import jax
+    import jax.numpy as jnp
+    from spark_timeseries_tpu.models import arima
+    from spark_timeseries_tpu.utils import metrics
+
+    # recompile/compile-seconds tracking rides jax.monitoring; when the
+    # installed JAX lacks the hooks the stats stay 0 and hooks_installed
+    # says so in the artifact (graceful no-op fallback)
+    metrics.install_jax_hooks()
+
+    def _metrics_block() -> dict:
+        """Why-block for every record: recompiles + compile seconds from
+        the jax.monitoring hooks, per-span wall-time stats for every
+        instrumented stage (the model fits' spans fire at trace time under
+        the jitted fit, so each model family fitted shows up), and the
+        accumulated fit counter bundles."""
+        snap = metrics.snapshot()
+        block = dict(metrics.jax_stats(snap=snap))
+        block["spans"] = snap["spans"]
+        fit_counters = {k: v for k, v in snap["counters"].items()
+                        if k.startswith(("fit.", "optimize."))}
+        if fit_counters:
+            block["fit_counters"] = fit_counters
+        return block
+
     def emit(obj: dict) -> None:
         # EVERY line of a probe-failure fallback carries the marker — a
         # partial record surviving a mid-curve crash must be as clearly
         # labeled as the headline (sites that set a more specific
-        # degraded message keep theirs)
+        # degraded message keep theirs).  Every record also carries the
+        # metrics block current at emit time, so a partial record still
+        # explains its own recompiles/spans.
         if degraded:
             obj.setdefault("degraded", DEGRADED_NOTE)
+        obj.setdefault("metrics", _metrics_block())
         _emit(obj)
-
-    import jax
-    import jax.numpy as jnp
-    from spark_timeseries_tpu.models import arima
 
     n_series_env = os.environ.get("BENCH_N_SERIES")
     n_target = int(n_series_env) if n_series_env else 1000000
@@ -347,7 +361,8 @@ def main():
 
     # CPU-baseline emulation first: it is cheap, accelerator-independent,
     # and lets every streamed curve point carry vs_baseline
-    cpu_rate, cpu_times = _baseline_rate(panel)
+    with metrics.span("bench.baseline_emulation"):
+        cpu_rate, cpu_times = _baseline_rate(panel)
 
     def _fit(v, n_real):
         m = arima.fit(2, 1, 2, v, warn=False)
@@ -411,8 +426,9 @@ def main():
             if n > n_target:
                 continue
             c = min(chunk, n)
-            np.asarray(fit(jnp.asarray(panel[:c], dtype),
-                           jnp.asarray(c))[0])              # warm this shape
+            with metrics.span("bench.warmup"):
+                np.asarray(fit(jnp.asarray(panel[:c], dtype),
+                               jnp.asarray(c))[0])          # warm this shape
             # per-point H2D bandwidth at this point's chunk shape (cached
             # by shape — re-shipping an identical chunk measures nothing
             # new): the curve's shape is transfer-dominated over the dev
@@ -424,12 +440,14 @@ def main():
             h2d_mbps = None
             if on_tpu:
                 if c not in h2d_by_chunk:
-                    h2d_by_chunk[c] = round(
-                        _measure_h2d(panel[:c], np_dtype), 2)
+                    with metrics.span("bench.h2d_probe"):
+                        h2d_by_chunk[c] = round(
+                            _measure_h2d(panel[:c], np_dtype), 2)
                 h2d_mbps = h2d_by_chunk[c]
                 curve_h2d[str(n)] = h2d_mbps
             reps = 2 if n <= 65536 else 1
-            dt, conv = min(run(panel[:n], c) for _ in range(reps))
+            with metrics.span("bench.fit_panel"):
+                dt, conv = min(run(panel[:n], c) for _ in range(reps))
             curve[str(n)] = round(n / dt, 1)
             converged_target = conv
             point = {
@@ -467,17 +485,21 @@ def main():
             from spark_timeseries_tpu.models.arima import LM_MAX_ITER
 
             demo_n = min(chunk, n_target)
-            fit_model = jax.jit(lambda v: arima.fit(2, 1, 2, v, warn=False))
-            model = fit_model(jnp.asarray(panel[:demo_n], dtype))
-            before = float(np.asarray(model.diagnostics.converged).mean())
-            t0 = time.perf_counter()
-            model2 = refit_unconverged(
-                panel[:demo_n].astype(np_dtype),
-                model,
-                lambda v, m: arima.fit(2, 1, 2, v, warn=False,
-                                       max_iter=4 * LM_MAX_ITER,
-                                       user_init_params=m.coefficients))
-            after = float(np.asarray(model2.diagnostics.converged).mean())
+            with metrics.span("bench.refit_demo"):
+                fit_model = jax.jit(
+                    lambda v: arima.fit(2, 1, 2, v, warn=False))
+                model = fit_model(jnp.asarray(panel[:demo_n], dtype))
+                before = float(
+                    np.asarray(model.diagnostics.converged).mean())
+                t0 = time.perf_counter()
+                model2 = refit_unconverged(
+                    panel[:demo_n].astype(np_dtype),
+                    model,
+                    lambda v, m: arima.fit(2, 1, 2, v, warn=False,
+                                           max_iter=4 * LM_MAX_ITER,
+                                           user_init_params=m.coefficients))
+                after = float(
+                    np.asarray(model2.diagnostics.converged).mean())
             refit_demo = {
                 "chunk": demo_n,
                 "converged_pct_before": round(100 * before, 2),
@@ -559,15 +581,16 @@ def main():
     # the double buffering couldn't hide (the roofline's numerator).
     device_resident = None
     try:
-        c = min(chunk, best_n)
-        dev = jax.device_put(jnp.asarray(panel[:c], dtype))
-        np.asarray(fit(dev, jnp.asarray(c))[0])              # warm
-        reps_dr = 3
-        t0 = time.perf_counter()
-        for _ in range(reps_dr):
-            np.asarray(fit(dev, jnp.asarray(c))[0])
-        device_resident = round(c * reps_dr
-                                / (time.perf_counter() - t0), 1)
+        with metrics.span("bench.device_resident"):
+            c = min(chunk, best_n)
+            dev = jax.device_put(jnp.asarray(panel[:c], dtype))
+            np.asarray(fit(dev, jnp.asarray(c))[0])          # warm
+            reps_dr = 3
+            t0 = time.perf_counter()
+            for _ in range(reps_dr):
+                np.asarray(fit(dev, jnp.asarray(c))[0])
+            device_resident = round(c * reps_dr
+                                    / (time.perf_counter() - t0), 1)
         emit({
             "metric": "ARIMA(2,1,2) series fitted/sec/chip "
                       f"(device-resident chunk {c}x{n_obs}, no H2D)",

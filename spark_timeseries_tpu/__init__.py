@@ -11,7 +11,15 @@ loops replaced by vmapped, XLA-compiled kernels.
 
 __version__ = "0.1.0"
 
-from . import io, models, ops, parallel, stats, time, utils  # noqa: F401
+import logging as _logging
+
+# Library-logging hygiene: the package logs (e.g. observability.fit_report)
+# through logging.getLogger("spark_timeseries_tpu") but never configures
+# the root logger or prints by default; applications opt in via
+# utils.observability.configure_logging(level).
+_logging.getLogger(__name__).addHandler(_logging.NullHandler())
+
+from . import io, models, ops, parallel, stats, time, utils  # noqa: F401,E402
 from .panel import Panel, lagged_pair_key, lagged_string_key  # noqa: F401
 
 __all__ = ["io", "models", "ops", "parallel", "stats", "time", "utils",

@@ -30,6 +30,7 @@ import numpy as np
 
 from .panel import Panel
 from .time import index as dtindex
+from .utils import metrics as _metrics
 
 CSV_DATA_FILE = "data.csv"
 CSV_INDEX_FILE = "timeIndex"   # same sidecar name as the reference
@@ -82,6 +83,7 @@ def _split_key(line: str) -> tuple:
     return key, rest
 
 
+@_metrics.instrumented("io.save_csv")
 def save_csv(panel: Panel, path: str) -> None:
     """Write ``path/data.csv`` (one ``key,v0,v1,...`` row per series) and the
     ``path/timeIndex`` sidecar.
@@ -136,6 +138,7 @@ def _unquote_key(token: str) -> str:
     return _split_key(token + ",")[0]
 
 
+@_metrics.instrumented("io.load_csv")
 def load_csv(path: str) -> Panel:
     """Inverse of :func:`save_csv` (ref ``timeSeriesRDDFromCsv``).
 
@@ -181,6 +184,8 @@ def load_csv(path: str) -> Panel:
         # spans are BYTE offsets — slice the bytes, then decode, so
         # non-ASCII keys stay correct
         keys = [_unquote_key(raw[a:b].decode()) for a, b in spans[:n]]
+        _metrics.inc("io.csv_series_loaded", int(n))
+        _metrics.inc("io.csv_bytes_read", len(raw))
         return Panel(index, jnp.asarray(values[:n]), keys)
 
     import pandas as pd
@@ -217,6 +222,9 @@ def load_csv(path: str) -> Panel:
         raise ValueError(
             f"corrupt data.csv: a numeric field failed to parse ({e})"
         ) from e
+    _metrics.inc("io.csv_series_loaded", len(keys))
+    _metrics.inc("io.csv_bytes_read",
+                 os.path.getsize(os.path.join(path, CSV_DATA_FILE)))
     return Panel(index, jnp.asarray(data), keys)
 
 
@@ -224,6 +232,7 @@ def load_csv(path: str) -> Panel:
 # Parquet (ref TimeSeriesRDD.scala:526-551 save, :769-780 load)
 # ---------------------------------------------------------------------------
 
+@_metrics.instrumented("io.save_parquet")
 def save_parquet(panel: Panel, path: str,
                  ts_col: str = "timestamp", key_col: str = "key",
                  value_col: str = "value") -> None:
@@ -235,6 +244,7 @@ def save_parquet(panel: Panel, path: str,
         f.write(panel.index.to_string())
 
 
+@_metrics.instrumented("io.load_parquet")
 def load_parquet(path: str, ts_col: str = "timestamp", key_col: str = "key",
                  value_col: str = "value") -> Panel:
     """Inverse of :func:`save_parquet`
@@ -272,6 +282,7 @@ def yahoo_string_to_panel(text: str, key_prefix: str = "",
     return Panel(index, jnp.asarray(data), labels)
 
 
+@_metrics.instrumented("io.yahoo_file")
 def yahoo_file_to_panel(path: str, key_prefix: Optional[str] = None,
                         zone: Optional[str] = None) -> Panel:
     """Parse one Yahoo CSV file; the default key prefix is the file name
@@ -282,6 +293,7 @@ def yahoo_file_to_panel(path: str, key_prefix: Optional[str] = None,
         return yahoo_string_to_panel(f.read(), key_prefix, zone)
 
 
+@_metrics.instrumented("io.yahoo_files")
 def yahoo_files_to_panel(path: str, zone: Optional[str] = None) -> Panel:
     """Load a directory of Yahoo CSV files into one panel — the counterpart
     of the reference's whole-directory ``yahooFiles``

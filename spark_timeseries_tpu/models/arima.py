@@ -44,6 +44,7 @@ from ..ops.optimize import (MinimizeResult, minimize_bfgs, minimize_box,
 from ..ops.univariate import (differences_of_order_d,
                               inverse_differences_of_order_d)
 from ..stats import KPSS_CONSTANT_CRITICAL_VALUES, kpsstest
+from ..utils import metrics as _metrics
 from . import autoregression
 from .base import (FitDiagnostics, diagnostics_from, normal_quantile,
                    scan_unroll)
@@ -733,7 +734,7 @@ def hannan_rissanen_init(p: int, q: int, y: jnp.ndarray,
     m = max(p, q) + 1
     mx = max(p, q)
 
-    ar = autoregression.fit(y, m, n_valid=n_valid)
+    ar = autoregression.fit.__wrapped__(y, m, n_valid=n_valid)
     est = lag_matvec(y, jnp.atleast_1d(ar.coefficients), m) \
         + jnp.asarray(ar.c)[..., None]
     y_trunc = y[..., m:]
@@ -777,6 +778,7 @@ def _use_pallas_lm(diffed: jnp.ndarray, nv) -> bool:
     return route_panel(diffed, nv, allow_1d=True, allow_ragged=True)
 
 
+@_metrics.instrument_fit("arima")
 def fit(p: int, d: int, q: int, ts: jnp.ndarray,
         include_intercept: bool = True, method: str = "css-lm",
         user_init_params: Optional[jnp.ndarray] = None,
@@ -858,8 +860,8 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
         # AR fast path (ref ARIMA.scala:90-96); OLS is direct, so the
         # diagnostics mark every finite lane converged in 0 iterations
         short = _short_lanes(2 * p + icpt + 1)
-        ar = autoregression.fit(diffed, p, no_intercept=not include_intercept,
-                                n_valid=nv)
+        ar = autoregression.fit.__wrapped__(
+            diffed, p, no_intercept=not include_intercept, n_valid=nv)
         parts = ([jnp.asarray(ar.c)[..., None]] if include_intercept else []) \
             + [jnp.atleast_1d(ar.coefficients)]
         coefs = jnp.concatenate(parts, axis=-1)
@@ -960,6 +962,12 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
     return model
 
 
+# undecorated fit for internal search/segment loops (auto_fit candidates,
+# fit_long segments): internal exploratory fits must not inflate the public
+# fit.arima.* counter bundle — only the entry point the user called records
+_fit_unrecorded = fit.__wrapped__
+
+
 def _ll_batched(coefs: jnp.ndarray, diffed: jnp.ndarray,
                 nv: Optional[jnp.ndarray], p: int, q: int,
                 icpt: int) -> jnp.ndarray:
@@ -980,18 +988,22 @@ def _warn_stationarity_invertibility(model: ARIMAModel, warn: bool) -> None:
     """ref ``ARIMA.scala:246-256`` (println there; ``warnings`` here)."""
     if not warn:
         return
+    # stacklevel walks _warn(1) -> fit(2) -> instrument_fit wrapper(3) ->
+    # the user's call site(4)
     if not np.all(model.is_stationary()):
-        warnings.warn("AR parameters are not stationary", stacklevel=3)
+        warnings.warn("AR parameters are not stationary", stacklevel=4)
     if not np.all(model.is_invertible()):
-        warnings.warn("MA parameters are not invertible", stacklevel=3)
+        warnings.warn("MA parameters are not invertible", stacklevel=4)
 
 
+@_metrics.instrument_fit("arima", record=False)
 def fit_panel(panel, p: int, d: int, q: int, **kwargs) -> ARIMAModel:
     """Batched fit over a Panel — the ``rdd.mapValues(ARIMA.fitModel(...))``
     equivalent (ref ``src/site/markdown/docs/users.md:107-118``)."""
     return fit(p, d, q, panel.values, **kwargs)
 
 
+@_metrics.instrument_fit("arima")
 def fit_long(p: int, d: int, q: int, ts: jnp.ndarray,
              segment_len: int = 65536, **kwargs) -> ARIMAModel:
     """ARIMA for ultra-long series: segment-parallel CSS fits combined by
@@ -1052,7 +1064,7 @@ def fit_long(p: int, d: int, q: int, ts: jnp.ndarray,
 
     include_intercept = kwargs.get("include_intercept", True)
     warn = kwargs.pop("warn", True)
-    m = fit(p, 0, q, segs, warn=False, **kwargs)
+    m = _fit_unrecorded(p, 0, q, segs, warn=False, **kwargs)
 
     icpt = 1 if include_intercept else 0
     dim = icpt + p + q
@@ -1145,6 +1157,7 @@ def _choose_d(ts: jnp.ndarray, max_d: int) -> int:
         f"stationarity not achieved with differencing order <= {max_d}")
 
 
+@_metrics.instrument_fit("arima")
 def auto_fit(ts: jnp.ndarray, max_p: int = 5, max_d: int = 2,
              max_q: int = 5) -> ARIMAModel:
     """Hyndman-Khandakar stepwise automatic ARIMA (ref ``ARIMA.scala:280-375``):
@@ -1165,8 +1178,9 @@ def auto_fit(ts: jnp.ndarray, max_p: int = 5, max_d: int = 2,
     def try_fit(p, q, intercept):
         for method in ("css-lm", "css-bobyqa"):
             try:
-                m = fit(p, 0, q, diffed, include_intercept=intercept,
-                        method=method, warn=False)
+                m = _fit_unrecorded(p, 0, q, diffed,
+                                    include_intercept=intercept,
+                                    method=method, warn=False)
                 if np.all(np.isfinite(np.asarray(m.coefficients))):
                     return m
             except (ValueError, FloatingPointError,
@@ -1210,8 +1224,11 @@ def auto_fit(ts: jnp.ndarray, max_p: int = 5, max_d: int = 2,
 
     if best_model is None:
         raise ValueError("auto_fit failed to fit any admissible ARMA model")
+    # carry the winning candidate's diagnostics: fit_report / the
+    # fit.arima.* counter bundle then work on auto_fit output too
     return ARIMAModel(best_model.p, d, best_model.q,
-                      best_model.coefficients, best_model.has_intercept)
+                      best_model.coefficients, best_model.has_intercept,
+                      diagnostics=best_model.diagnostics)
 
 
 class PanelARIMAFit(NamedTuple):
@@ -1308,7 +1325,7 @@ def _auto_fit_panel_kernel(values: jnp.ndarray, masks_base: jnp.ndarray,
     # then one *masked* OLS per candidate from shared normal equations
     m = max(max_p, max_q) + 1
     mx = max(max_p, max_q)
-    ar = autoregression.fit(diffed, m, n_valid=n_valid)
+    ar = autoregression.fit.__wrapped__(diffed, m, n_valid=n_valid)
     est = lag_matvec(diffed, jnp.atleast_1d(ar.coefficients), m) \
         + jnp.asarray(ar.c)[..., None]
     y_trunc = diffed[..., m:]
@@ -1433,6 +1450,7 @@ def _auto_fit_panel_kernel(values: jnp.ndarray, masks_base: jnp.ndarray,
     return orders, coefs, chosen_aic, d_ok, screen_capped
 
 
+@_metrics.instrument_fit("arima", record=False)
 def auto_fit_panel(values: jnp.ndarray, max_p: int = 5, max_d: int = 2,
                    max_q: int = 5, max_iter: Optional[int] = None,
                    screen_max_iter: Optional[int] = None) -> PanelARIMAFit:
@@ -1524,7 +1542,7 @@ def auto_fit_panel(values: jnp.ndarray, max_p: int = 5, max_d: int = 2,
                 f"screen-stage iteration cap ({screen_iter}); order selection "
                 f"may differ from a full-budget grid — pass "
                 f"screen_max_iter=max_iter to restore one",
-                stacklevel=2)
+                stacklevel=3)
 
     d_ok = np.asarray(d_ok)
     if short_np is not None:
@@ -1551,5 +1569,5 @@ def auto_fit_panel(values: jnp.ndarray, max_p: int = 5, max_d: int = 2,
         warnings.warn(
             f"auto_fit_panel: no admissible ARMA candidate for {n_failed} "
             f"series; their aic is +inf and coefficients are zero",
-            stacklevel=2)
+            stacklevel=3)
     return PanelARIMAFit(out_orders, out_coefs, out_aic, max_p)

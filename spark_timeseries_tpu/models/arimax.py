@@ -47,6 +47,7 @@ from jax import lax
 
 from ..ops.univariate import differences_of_order_d
 from . import autoregression_x
+from ..utils import metrics as _metrics
 from .base import FitDiagnostics, diagnostics_from, normal_quantile
 from .arima import (LM_MAX_ITER, _add_effects_one, _arma_normal_eqs,
                     _batched, _difference_rows, _log_likelihood_css_arma,
@@ -253,6 +254,7 @@ class ARIMAXModel(NamedTuple):
         return pred, pred - half, pred + half
 
 
+@_metrics.instrument_fit("arimax")
 def fit(p: int, d: int, q: int, ts: jnp.ndarray, xreg: jnp.ndarray,
         xreg_max_lag: int, include_original_xreg: bool = True,
         include_intercept: bool = True,
@@ -292,9 +294,9 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray, xreg: jnp.ndarray,
         bx = init_full[..., 1 + p + q:]
     else:
         # ARX on the raw series with differenced xreg (ref ARIMAX.scala:92-112)
-        arx = autoregression_x.fit(ts, dx_full, p, xreg_max_lag,
-                                   include_original_xreg,
-                                   no_intercept=not include_intercept)
+        arx = autoregression_x.fit.__wrapped__(
+            ts, dx_full, p, xreg_max_lag, include_original_xreg,
+            no_intercept=not include_intercept)
         c0 = jnp.asarray(arx.c)[..., None] if include_intercept \
             else jnp.zeros((*ts.shape[:-1], 1), ts.dtype)
         ar0 = arx.coefficients[..., :p]

@@ -21,6 +21,7 @@ from jax import lax
 
 from ..ops.lag import lag_matvec, lag_stack
 from ..ops.linalg import ols_gram
+from ..utils import metrics as _metrics
 from .base import scan_unroll
 
 
@@ -72,6 +73,7 @@ class ARModel(NamedTuple):
         return self.add_time_dependent_effects(noise)
 
 
+@_metrics.instrument_fit("ar")
 def fit(ts: jnp.ndarray, max_lag: int = 1, no_intercept: bool = False,
         n_valid: jnp.ndarray | None = None) -> ARModel:
     """Fit AR(max_lag) by OLS on the lag matrix
@@ -97,6 +99,7 @@ def fit(ts: jnp.ndarray, max_lag: int = 1, no_intercept: bool = False,
     return ARModel(res.beta[..., 0], res.beta[..., 1:])
 
 
+@_metrics.instrument_fit("ar", record=False)
 def fit_panel(panel, max_lag: int = 1, no_intercept: bool = False) -> ARModel:
     """Batched fit over a Panel — the ``mapValues(Autoregression.fitModel)``
     equivalent."""

@@ -13,6 +13,7 @@ from typing import NamedTuple
 import jax.numpy as jnp
 
 from ..ops.lag import lag_matrix, lag_matrix_multi
+from ..utils import metrics as _metrics
 from ..ops.linalg import ols
 
 
@@ -72,6 +73,7 @@ class ARXModel(NamedTuple):
         return out + (c[..., None] if c.ndim else c)
 
 
+@_metrics.instrument_fit("arx")
 def fit(y: jnp.ndarray, x: jnp.ndarray, y_max_lag: int, x_max_lag: int,
         include_original_x: bool = True, no_intercept: bool = False) -> ARXModel:
     """OLS fit (ref ``AutoregressionX.scala:48-68``).  ``y (..., n)``,

@@ -26,6 +26,7 @@ from ..ops.optimize import (minimize_bfgs, minimize_box,
                             minimize_least_squares)
 from ..ops.ragged import (apply_short_quarantine, ragged_view, short_lanes,
                           step_weights)
+from ..utils import metrics as _metrics
 from .base import (FitDiagnostics, diagnostics_from, normal_quantile,
                    scan_unroll)
 
@@ -160,6 +161,7 @@ def _ewma_normal_eqs(params: jnp.ndarray, series: jnp.ndarray,
     return (jtj.reshape(1, 1), jtr.reshape(1), sse + e0 * e0)
 
 
+@_metrics.instrument_fit("ewma")
 def fit(ts: jnp.ndarray, init: float = 0.94, tol: float = 1e-9,
         max_iter: int = 200, method: str = "lm") -> EWMAModel:
     """Fit EWMA by minimizing one-step SSE over the smoothing parameter
@@ -236,6 +238,7 @@ def fit(ts: jnp.ndarray, init: float = 0.94, tol: float = 1e-9,
     return EWMAModel(params[..., 0], diagnostics=conv)
 
 
+@_metrics.instrument_fit("ewma", record=False)
 def fit_panel(panel) -> EWMAModel:
     """Batched fit over a :class:`~spark_timeseries_tpu.panel.Panel` — the
     TPU equivalent of ``rdd.mapValues(EWMA.fitModel)``."""

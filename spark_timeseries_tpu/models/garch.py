@@ -26,6 +26,7 @@ from jax import lax
 
 from ..ops.optimize import minimize_bfgs, minimize_box, minimize_newton
 from . import autoregression
+from ..utils import metrics as _metrics
 from .base import FitDiagnostics, diagnostics_from, scan_unroll
 
 
@@ -212,6 +213,7 @@ def _constrain(params):
     return omega, total * frac, total * (1.0 - frac)
 
 
+@_metrics.instrument_fit("garch")
 def fit(ts: jnp.ndarray, init=(0.2, 0.2, 0.2), tol: float = 1e-6,
         max_iter: Optional[int] = None,
         method: str = "newton") -> GARCHModel:
@@ -260,6 +262,7 @@ def fit(ts: jnp.ndarray, init=(0.2, 0.2, 0.2), tol: float = 1e-6,
                       diagnostics=diagnostics_from(res, ok))
 
 
+@_metrics.instrument_fit("garch", record=False)
 def fit_panel(panel) -> GARCHModel:
     """Batched fit over a Panel — ``rdd.mapValues(GARCH.fitModel)``."""
     return fit(panel.values)
@@ -351,18 +354,21 @@ class ARGARCHModel(NamedTuple):
         return self.sample_with_variances(n, key, shape)[0]
 
 
+@_metrics.instrument_fit("argarch")
 def fit_ar_garch(ts: jnp.ndarray) -> ARGARCHModel:
     """Two-stage AR(1)+GARCH(1,1) fit (ref ``GARCH.scala:63-69``): AR(1) by
     OLS, then GARCH(1,1) on the residuals.  Batched over leading dims."""
     ts = jnp.asarray(ts)
-    ar = autoregression.fit(ts, 1)
+    # stage fits are machinery of THIS fit: record only the argarch bundle
+    ar = autoregression.fit.__wrapped__(ts, 1)
     residuals = ar.remove_time_dependent_effects(ts)
-    g = fit(residuals)
+    g = fit.__wrapped__(residuals)
     return ARGARCHModel(ar.c, jnp.asarray(ar.coefficients)[..., 0],
                         g.omega, g.alpha, g.beta,
                         diagnostics=g.diagnostics)
 
 
+@_metrics.instrument_fit("argarch", record=False)
 def fit_ar_garch_panel(panel) -> ARGARCHModel:
     return fit_ar_garch(panel.values)
 
@@ -490,6 +496,7 @@ def _eg_constrain(params):
             params[..., 3])
 
 
+@_metrics.instrument_fit("egarch")
 def fit_egarch(ts: jnp.ndarray, init=(0.2, 0.9, 0.0),
                tol: Optional[float] = None, max_iter: Optional[int] = None,
                method: str = "newton") -> EGARCHModel:
@@ -542,6 +549,7 @@ def fit_egarch(ts: jnp.ndarray, init=(0.2, 0.9, 0.0),
                        diagnostics=diagnostics_from(res, ok))
 
 
+@_metrics.instrument_fit("egarch", record=False)
 def fit_egarch_panel(panel) -> EGARCHModel:
     """Batched EGARCH fit over a Panel."""
     return fit_egarch(panel.values)

@@ -26,6 +26,7 @@ from ..ops.lag import lag_matrix
 from ..ops.optimize import MinimizeResult, minimize_box
 from ..ops.ragged import (apply_short_quarantine, ragged_view, short_lanes,
                           step_weights)
+from ..utils import metrics as _metrics
 from .base import (FitDiagnostics, diagnostics_from, normal_quantile,
                    on_accelerator,
                    scan_unroll)
@@ -343,6 +344,7 @@ def _hw_sse_value_and_grad(params: jnp.ndarray, series: jnp.ndarray,
     return out[6], out[7]
 
 
+@_metrics.instrument_fit("holt_winters")
 def fit(ts: jnp.ndarray, period: int, model_type: str = "additive",
         init=(0.3, 0.1, 0.1), tol: float = 1e-10,
         max_iter: int = 1000) -> HoltWintersModel:
@@ -410,6 +412,7 @@ def fit(ts: jnp.ndarray, period: int, model_type: str = "additive",
                             p[..., 2], diagnostics=conv)
 
 
+@_metrics.instrument_fit("holt_winters", record=False)
 def fit_panel(panel, period: int, model_type: str = "additive",
               **kwargs) -> HoltWintersModel:
     """Batched fit over a Panel — ``rdd.mapValues(HoltWinters.fitModel)``."""

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from ..ops.linalg import ols
 from ..stats import dwtest
+from ..utils import metrics as _metrics
 from .base import FitDiagnostics, normal_quantile
 
 DW_MARGIN = 0.05
@@ -124,6 +125,7 @@ class RegressionARIMAModel(NamedTuple):
         return point, point - half, point + half
 
 
+@_metrics.instrument_fit("regression_arima", record=False)
 def fit(ts: jnp.ndarray, regressors: jnp.ndarray, method: str,
         *optimization_args) -> RegressionARIMAModel:
     """Method dispatch (ref ``RegressionARIMA.scala:35-59``); currently
@@ -143,6 +145,7 @@ def fit(ts: jnp.ndarray, regressors: jnp.ndarray, method: str,
     return fit_cochrane_orcutt(ts, regressors, optimization_args[0])
 
 
+@_metrics.instrument_fit("regression_arima")
 def fit_cochrane_orcutt(ts: jnp.ndarray, regressors: jnp.ndarray,
                         max_iter: int = 10) -> RegressionARIMAModel:
     """Iterative Cochrane-Orcutt (ref ``RegressionARIMA.scala:83-160``).
@@ -228,6 +231,7 @@ def _co_loop(y: jnp.ndarray, X: jnp.ndarray, max_iter: int):
     return beta, resid, rho, finished, n_done
 
 
+@_metrics.instrument_fit("regression_arima", record=False)
 def fit_panel(panel, regressors, max_iter: int = 10) -> RegressionARIMAModel:
     """Batched Cochrane-Orcutt over a Panel against a shared regressor
     design."""

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils import metrics as _metrics
 from .linalg import spd_solve
 
 
@@ -62,7 +63,11 @@ def minimize_bfgs(fn: Callable, x0: jnp.ndarray, *args,
     batch_dims = x0.ndim - 1
     for _ in range(batch_dims):
         solve_one = jax.vmap(solve_one)
-    return solve_one(x0, *args)
+    with _metrics.span("optimize.bfgs"):
+        # the recorder's host reads block on the device work; keeping
+        # them inside the span attributes that wall-time to the solver
+        res = solve_one(x0, *args)
+        return _metrics.observe_minimize("bfgs", res)
 
 
 class _LMState(NamedTuple):
@@ -176,7 +181,11 @@ def minimize_least_squares(residual_fn: Callable | None, x0: jnp.ndarray,
     batch_dims = x0.ndim - 1
     for _ in range(batch_dims):
         solve_one = jax.vmap(solve_one)
-    return solve_one(x0, *args)
+    with _metrics.span("optimize.lm"):
+        # the recorder's host reads block on the device work; keeping
+        # them inside the span attributes that wall-time to the solver
+        res = solve_one(x0, *args)
+        return _metrics.observe_minimize("lm", res)
 
 
 class _NewtonState(NamedTuple):
@@ -263,7 +272,11 @@ def minimize_newton(fn: Callable, x0: jnp.ndarray, *args,
     batch_dims = x0.ndim - 1
     for _ in range(batch_dims):
         solve_one = jax.vmap(solve_one)
-    return solve_one(x0, *args)
+    with _metrics.span("optimize.newton"):
+        # the recorder's host reads block on the device work; keeping
+        # them inside the span attributes that wall-time to the solver
+        res = solve_one(x0, *args)
+        return _metrics.observe_minimize("newton", res)
 
 
 def _project(x, lower, upper):
@@ -365,4 +378,8 @@ def minimize_box(fn: Callable, x0: jnp.ndarray, lower, upper, *args,
     batch_dims = x0.ndim - 1
     for _ in range(batch_dims):
         solve_one = jax.vmap(solve_one)
-    return solve_one(x0, *args)
+    with _metrics.span("optimize.box"):
+        # the recorder's host reads block on the device work; keeping
+        # them inside the span attributes that wall-time to the solver
+        res = solve_one(x0, *args)
+        return _metrics.observe_minimize("box", res)

@@ -129,7 +129,7 @@ def short_lanes(obs_len: jnp.ndarray, min_n: int,
     warnings.warn(
         f"{count} have valid windows shorter than the "
         f"{min_n} observations the {what} needs; their parameters are NaN "
-        f"and diagnostics.converged is False", stacklevel=3)
+        f"and diagnostics.converged is False", stacklevel=4)
     return jnp.asarray(short)
 
 

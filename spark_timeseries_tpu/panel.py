@@ -34,6 +34,7 @@ from .ops.lag import lag_matrix
 from .ops.resample import resample as _resample_values
 from .time import DateTimeIndex, Frequency, IrregularDateTimeIndex, UniformDateTimeIndex
 from .time.rebase import rebaser as _rebaser
+from .utils import metrics as _metrics
 
 
 def lagged_string_key(key: str, lag_order: int) -> str:
@@ -105,7 +106,11 @@ class Panel:
         """
         from jax.sharding import NamedSharding, PartitionSpec as P
         sharding = NamedSharding(mesh, P(axis_name, None))
-        return self._with(values=jax.device_put(self.values, sharding))
+        with _metrics.span("panel.shard"):
+            placed = jax.device_put(self.values, sharding)
+        _metrics.inc("panel.h2d_bytes", int(self.values.nbytes))
+        _metrics.inc("panel.shards")
+        return self._with(values=placed)
 
     def to_row_matrix(self) -> jnp.ndarray:
         """Time-major ``(n_obs, n_series)`` matrix — the ``toRowMatrix``
@@ -351,9 +356,10 @@ class Panel:
                    default_value: float = np.nan) -> "Panel":
         """Rebase every series onto a new index, NaN-filling missing instants
         (ref ``TimeSeriesRDD.scala:657-666`` constructor rebase path)."""
-        rb = _rebaser(self.index, new_index, default_value)
-        return self._with(values=jnp.asarray(rb(np.asarray(self.values))),
-                          index=new_index)
+        with _metrics.span("panel.rebase"):
+            rb = _rebaser(self.index, new_index, default_value)
+            return self._with(values=jnp.asarray(rb(np.asarray(self.values))),
+                              index=new_index)
 
     # -- summary stats (ref TimeSeriesRDD.scala:265-267 seriesStats) ----------
 
@@ -419,7 +425,10 @@ class Panel:
     def collect(self) -> Tuple[List[Any], np.ndarray]:
         """Materialize (keys, values) on host
         (ref ``TimeSeriesRDD.scala:61-75`` collectAsTimeSeries)."""
-        return self.keys, np.asarray(self.values)
+        with _metrics.span("panel.collect"):
+            host = np.asarray(self.values)
+        _metrics.inc("panel.d2h_bytes", int(host.nbytes))
+        return self.keys, host
 
     # -- constructors --------------------------------------------------------
 
@@ -428,12 +437,14 @@ class Panel:
                     target_index: DateTimeIndex) -> "Panel":
         """Build from (key, index, values) triples, rebasing each onto
         ``target_index`` (ref ``TimeSeriesRDD.scala:657-666``)."""
-        keys, rows = [], []
-        for key, idx, vals in pairs:
-            rb = _rebaser(idx, target_index, np.nan)
-            keys.append(key)
-            rows.append(rb(np.asarray(vals, dtype=np.float64)))
-        return Panel(target_index, jnp.asarray(np.stack(rows)), keys)
+        with _metrics.span("panel.from_series"):
+            keys, rows = [], []
+            for key, idx, vals in pairs:
+                rb = _rebaser(idx, target_index, np.nan)
+                keys.append(key)
+                rows.append(rb(np.asarray(vals, dtype=np.float64)))
+            _metrics.inc("panel.ingested_series", len(keys))
+            return Panel(target_index, jnp.asarray(np.stack(rows)), keys)
 
     @staticmethod
     def from_observations(df, target_index: DateTimeIndex,
@@ -446,16 +457,19 @@ class Panel:
         index lookup becomes three vectorized host steps: factorize keys,
         bulk-resolve timestamp locations, one scatter into the dense panel.
         """
-        keys_arr = np.asarray(df[key_col])
-        uniq_keys, key_codes = np.unique(keys_arr, return_inverse=True)
-        ts = df[ts_col]
-        nanos = _timestamps_to_nanos(ts)
-        locs = target_index.locs_at(nanos)
-        vals = np.asarray(df[value_col], dtype=np.float64)
-        data = np.full((len(uniq_keys), len(target_index)), np.nan)
-        ok = locs >= 0
-        data[key_codes[ok], locs[ok]] = vals[ok]
-        return Panel(target_index, jnp.asarray(data), list(uniq_keys))
+        with _metrics.span("panel.from_observations"):
+            keys_arr = np.asarray(df[key_col])
+            uniq_keys, key_codes = np.unique(keys_arr, return_inverse=True)
+            ts = df[ts_col]
+            nanos = _timestamps_to_nanos(ts)
+            locs = target_index.locs_at(nanos)
+            vals = np.asarray(df[value_col], dtype=np.float64)
+            data = np.full((len(uniq_keys), len(target_index)), np.nan)
+            ok = locs >= 0
+            data[key_codes[ok], locs[ok]] = vals[ok]
+            _metrics.inc("panel.ingested_observations", int(len(vals)))
+            _metrics.inc("panel.ingested_series", int(len(uniq_keys)))
+            return Panel(target_index, jnp.asarray(data), list(uniq_keys))
 
     @staticmethod
     def from_pandas(df, target_index: Optional[DateTimeIndex] = None) -> "Panel":

@@ -1,11 +1,26 @@
-"""Auxiliary subsystems: checkpointing, observability, plotting.
+"""Auxiliary subsystems: checkpointing, observability, metrics, plotting.
 
 The reference has no tracing/metrics/checkpoint tier (SURVEY.md §5) — its
 fault tolerance is Spark lineage and its only observability is the Spark UI.
 Here the equivalents are explicit: pytree checkpoints (fits are idempotent
-and restartable), a profiler/timing harness, and convergence counters.
+and restartable), a profiler/timing harness plus convergence counters
+(``observability``), and the structured runtime-metrics spine —
+counters/gauges/histograms, nested spans, XLA recompile tracking —
+(``metrics``) that ``bench.py`` embeds into every benchmark artifact.
 """
 
-from . import checkpoint, observability, plot  # noqa: F401
+from . import checkpoint, metrics, observability  # noqa: F401
 
-__all__ = ["checkpoint", "observability", "plot"]
+__all__ = ["checkpoint", "metrics", "observability", "plot"]
+
+
+def __getattr__(name):
+    # plot pulls in the models tier, and the ops tier imports this package
+    # for metrics — loading plot lazily (PEP 562) keeps ops -> utils free
+    # of the ops -> utils -> plot -> models -> ops cycle
+    if name == "plot":
+        import importlib
+        mod = importlib.import_module(".plot", __name__)
+        globals()["plot"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

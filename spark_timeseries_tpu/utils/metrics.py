@@ -1,0 +1,686 @@
+"""Structured runtime metrics: registry, spans, and XLA recompile tracking.
+
+The reference's only telemetry is per-series ``println`` warnings on
+non-stationary fits (ref ``ARIMA.scala:248-256``); the Spark UI answers
+"where did the time go" for it.  This module is that tier for the TPU
+build — the production questions the ROADMAP north-star poses (how many
+times did XLA recompile this workload, where did wall-time go, which fit
+stage regressed between benches) are answered by three pieces, no new
+dependencies:
+
+- a process-local **registry** of counters / gauges / histograms with
+  explicit :meth:`MetricsRegistry.record` / :meth:`MetricsRegistry.snapshot`
+  / :meth:`MetricsRegistry.reset` and JSON + Prometheus-text export;
+- a **span** API (``with metrics.span("arima.fit_panel"): ...``) that
+  nests (paths join with ``/``), records wall-time histograms, and
+  forwards each scope to ``jax.profiler.TraceAnnotation`` so the same
+  names show up in xprof device traces;
+- **recompile / transfer tracking** off ``jax.monitoring``'s event hooks
+  (:func:`install_jax_hooks`): XLA backend compiles become the
+  ``jax.jit_compiles`` counter + ``jax.compile_s`` histogram, jaxpr
+  tracing becomes ``jax.trace_s``, compilation-cache and transfer events
+  are counted when the installed JAX emits them — with a graceful no-op
+  fallback (``install_jax_hooks() -> False``) when the hooks are absent.
+
+Everything here is **host-side only**: instrumented library code (model
+``fit`` entry points, the batched optimizers, panel/io choke points) adds
+no operations to traced graphs.  Values that may be tracers (a ``fit``
+called under ``jit``) are detected and counted as traced calls instead of
+being materialized — see :func:`record_fit` / :func:`observe_minimize`.
+
+``bench.py`` embeds :func:`snapshot` + :func:`jax_stats` into every
+``BENCH_*.json`` record, so the perf trajectory carries *why* (recompiles,
+compile seconds, per-span wall time) alongside *how fast*.
+
+``STS_METRICS=0`` disables all recording (spans still forward to the
+profiler); :func:`set_enabled` overrides at runtime.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "counter", "gauge", "histogram", "inc", "set_gauge", "record",
+    "snapshot", "reset", "to_json", "to_prometheus",
+    "span", "current_span_path",
+    "install_jax_hooks", "jax_hooks_installed", "jax_stats",
+    "record_fit", "record_fit_report", "observe_minimize",
+    "instrument_fit", "instrumented", "enabled", "set_enabled",
+    "get_registry",
+]
+
+# Percentile sample cap per histogram: count/sum/min/max stay exact past
+# it; p50/p95 come from a deterministic ring of the most recent samples.
+MAX_SAMPLES = 4096
+
+
+def _fmt(v) -> str:
+    """Deterministic number formatting shared by the text exports."""
+    if isinstance(v, bool):
+        return str(int(v))
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return format(f, ".10g")
+
+
+class Counter:
+    """Monotonically increasing integer.  Mutations hold the owning
+    registry's lock (standalone construction gets its own), so handles
+    obtained via ``registry.counter(name)`` increment safely across
+    threads."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self, lock: Optional[threading.RLock] = None):
+        self.value = 0
+        self._lock = lock if lock is not None else threading.RLock()
+
+    def inc(self, n: int = 1) -> None:
+        if n < 0:
+            raise ValueError(f"counter increment must be >= 0, got {n}")
+        with self._lock:
+            self.value += int(n)
+
+
+class Gauge:
+    """Last-write-wins float."""
+
+    __slots__ = ("value", "_lock")
+
+    def __init__(self, lock: Optional[threading.RLock] = None):
+        self.value = 0.0
+        self._lock = lock if lock is not None else threading.RLock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+
+class Histogram:
+    """Streaming count/sum/min/max plus a bounded sample ring for
+    percentiles (deterministic: the ring keeps the most recent
+    ``max_samples`` observations, overwritten in arrival order).
+    ``record`` holds the owning registry's lock so concurrent recorders
+    never tear the count/sum/ring triple."""
+
+    __slots__ = ("count", "sum", "min", "max", "_samples", "_cap", "_lock")
+
+    def __init__(self, max_samples: int = MAX_SAMPLES,
+                 lock: Optional[threading.RLock] = None):
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self._samples: list = []
+        self._cap = max_samples
+        self._lock = lock if lock is not None else threading.RLock()
+
+    def record(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            if len(self._samples) < self._cap:
+                self._samples.append(v)
+            else:
+                self._samples[self.count % self._cap] = v
+            self.count += 1
+            self.sum += v
+            self.min = min(self.min, v)
+            self.max = max(self.max, v)
+
+    def percentile(self, q: float) -> float:
+        with self._lock:
+            if not self._samples:
+                return float("nan")
+            samples = np.asarray(self._samples)
+        return float(np.percentile(samples, q))
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            if self.count == 0:
+                return {"count": 0, "sum": 0.0}
+            return {
+                "count": self.count,
+                "sum": self.sum,
+                "min": self.min,
+                "max": self.max,
+                "mean": self.sum / self.count,
+                "p50": self.percentile(50),
+                "p95": self.percentile(95),
+            }
+
+
+class MetricsRegistry:
+    """Process-local named metrics.  One reentrant lock is shared by the
+    registry and every metric object it creates, so both registry-level
+    calls (``inc``/``record``/``snapshot``) and direct handle mutations
+    (``registry.counter(n).inc()``) are safe across concurrent host
+    threads (e.g. a double-buffered pipeline's puller)."""
+
+    def __init__(self, max_samples: int = MAX_SAMPLES):
+        self._lock = threading.RLock()
+        self._max_samples = max_samples
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._spans: Dict[str, Histogram] = {}
+        self.enabled = os.environ.get("STS_METRICS", "1") != "0"
+
+    # -- get-or-create accessors -------------------------------------------
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(self._lock)
+            return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(self._lock)
+            return g
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(self._max_samples,
+                                                       self._lock)
+            return h
+
+    # -- explicit record / snapshot / reset --------------------------------
+
+    def inc(self, name: str, n: int = 1) -> None:
+        if self.enabled:
+            self.counter(name).inc(n)
+
+    def set_gauge(self, name: str, v: float) -> None:
+        if self.enabled:
+            self.gauge(name).set(v)
+
+    def record(self, name: str, value: float) -> None:
+        """Record one observation into the named histogram."""
+        if self.enabled:
+            self.histogram(name).record(value)
+
+    def record_span(self, path: str, seconds: float) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            h = self._spans.get(path)
+            if h is None:
+                h = self._spans[path] = Histogram(self._max_samples,
+                                                  self._lock)
+        h.record(seconds)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able view of every metric.  Span stats carry ``_s``
+        suffixes to make the unit unambiguous in bench artifacts."""
+        with self._lock:
+            counters = {k: v.value for k, v in sorted(self._counters.items())}
+            gauges = {k: v.value for k, v in sorted(self._gauges.items())}
+            hists = {k: v.stats() for k, v in sorted(self._histograms.items())}
+            spans = {
+                k: {
+                    "count": h.count,
+                    "total_s": h.sum,
+                    "mean_s": h.sum / h.count if h.count else 0.0,
+                    "min_s": h.min if h.count else 0.0,
+                    "max_s": h.max if h.count else 0.0,
+                    "p50_s": h.percentile(50) if h.count else 0.0,
+                    "p95_s": h.percentile(95) if h.count else 0.0,
+                }
+                for k, h in sorted(self._spans.items())
+            }
+        return {"counters": counters, "gauges": gauges,
+                "histograms": hists, "spans": spans}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+            self._spans.clear()
+
+    # -- export -------------------------------------------------------------
+
+    def to_json(self, indent: Optional[int] = None) -> str:
+        return json.dumps(self.snapshot(), indent=indent, sort_keys=True)
+
+    def to_prometheus(self, prefix: str = "sts") -> str:
+        """Prometheus text exposition.  Histograms and spans export as
+        summaries (quantiles + ``_sum``/``_count``); metric names are
+        sanitized to ``[a-zA-Z0-9_]`` with the given prefix."""
+
+        def sanitize(name: str) -> str:
+            return prefix + "_" + "".join(
+                ch if ch.isalnum() or ch == "_" else "_" for ch in name)
+
+        snap = self.snapshot()
+        lines = []
+        for name, value in snap["counters"].items():
+            m = sanitize(name)
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {_fmt(value)}")
+        for name, value in snap["gauges"].items():
+            m = sanitize(name)
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {_fmt(value)}")
+        for section, unit in (("histograms", ""), ("spans", "_seconds")):
+            for name, st in snap[section].items():
+                m = sanitize(name) + unit
+                lines.append(f"# TYPE {m} summary")
+                if st["count"]:
+                    p50 = st.get("p50", st.get("p50_s"))
+                    p95 = st.get("p95", st.get("p95_s"))
+                    lines.append(f'{m}{{quantile="0.5"}} {_fmt(p50)}')
+                    lines.append(f'{m}{{quantile="0.95"}} {_fmt(p95)}')
+                total = st.get("sum", st.get("total_s", 0.0))
+                lines.append(f"{m}_sum {_fmt(total)}")
+                lines.append(f"{m}_count {_fmt(st['count'])}")
+        return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Default registry + module-level convenience API
+# ---------------------------------------------------------------------------
+
+_default_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _default_registry
+
+
+def enabled() -> bool:
+    return _default_registry.enabled
+
+
+def set_enabled(on: bool) -> None:
+    _default_registry.enabled = bool(on)
+
+
+def counter(name: str) -> Counter:
+    return _default_registry.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return _default_registry.gauge(name)
+
+
+def histogram(name: str) -> Histogram:
+    return _default_registry.histogram(name)
+
+
+def inc(name: str, n: int = 1) -> None:
+    _default_registry.inc(name, n)
+
+
+def set_gauge(name: str, v: float) -> None:
+    _default_registry.set_gauge(name, v)
+
+
+def record(name: str, value: float) -> None:
+    _default_registry.record(name, value)
+
+
+def snapshot() -> Dict[str, Any]:
+    return _default_registry.snapshot()
+
+
+def reset() -> None:
+    _default_registry.reset()
+
+
+def to_json(indent: Optional[int] = None) -> str:
+    return _default_registry.to_json(indent)
+
+
+def to_prometheus(prefix: str = "sts") -> str:
+    return _default_registry.to_prometheus(prefix)
+
+
+# ---------------------------------------------------------------------------
+# Spans
+# ---------------------------------------------------------------------------
+
+_span_state = threading.local()
+
+
+def _trace_annotation(path: str):
+    """The xprof bridge: every span scope is also a profiler
+    TraceAnnotation, so span names line up between bench JSON and device
+    traces.  Falls back to a null scope if the profiler is unavailable."""
+    try:
+        from jax.profiler import TraceAnnotation
+        return TraceAnnotation(path)
+    except Exception:  # pragma: no cover — jax always present in-tree
+        return contextlib.nullcontext()
+
+
+def current_span_path() -> str:
+    """``/``-joined path of the active span stack ("" at top level)."""
+    return "/".join(getattr(_span_state, "stack", []))
+
+
+@contextlib.contextmanager
+def span(name: str, registry: Optional[MetricsRegistry] = None
+         ) -> Iterator[None]:
+    """Named wall-time scope.  Nesting joins paths with ``/``
+    (``arima.fit_panel/arima.fit``); each distinct path accumulates its
+    own wall-time histogram in the registry, and the scope forwards to
+    ``jax.profiler.TraceAnnotation`` so it shows up in xprof too.
+
+    Host-side only: wall time of a scope that merely *traces* jitted code
+    is trace+compile time, which is exactly what the recompile-tracking
+    story wants surfaced (the span's ``count`` then counts retraces).
+    """
+    reg = registry if registry is not None else _default_registry
+    stack = getattr(_span_state, "stack", None)
+    if stack is None:
+        stack = _span_state.stack = []
+    stack.append(name)
+    path = "/".join(stack)
+    t0 = time.perf_counter()
+    try:
+        with _trace_annotation(path):
+            yield
+    finally:
+        dt = time.perf_counter() - t0
+        stack.pop()
+        reg.record_span(path, dt)
+
+
+# ---------------------------------------------------------------------------
+# jax.monitoring bridge: recompiles, compile seconds, transfers
+# ---------------------------------------------------------------------------
+
+import weakref
+
+# Registries receiving jax.monitoring events.  Weakly referenced: the
+# module-lifetime listeners must not pin short-lived registries (and their
+# sample rings) in memory forever.
+_hooked_registries: "weakref.WeakSet" = weakref.WeakSet()
+_listeners_registered = False
+_install_lock = threading.Lock()
+
+
+def _is_tracer(x) -> bool:
+    try:
+        from jax.core import Tracer
+    except Exception:  # pragma: no cover
+        return False
+    return isinstance(x, Tracer)
+
+
+def install_jax_hooks(registry: Optional[MetricsRegistry] = None) -> bool:
+    """Register ``jax.monitoring`` listeners feeding the registry.
+
+    Counts/aggregates, per process since install:
+
+    - ``jax.jit_compiles`` (counter) + ``jax.compile_s`` (histogram) from
+      ``/jax/core/compile/backend_compile_duration`` — one event per XLA
+      backend compilation, i.e. the recompile question;
+    - ``jax.trace_s`` from ``jaxpr_trace_duration`` (Python tracing time);
+    - ``jax.cache_misses`` / ``jax.cache_hits`` from the persistent
+      compilation cache's events (when that cache is enabled);
+    - any event whose name mentions ``transfer`` as ``jax.transfers`` (+
+      ``jax.transfer_s`` when it carries a duration) — versions of JAX
+      that don't emit transfer events simply leave these at 0 (the panel
+      tier counts its own explicit H2D/D2H bytes independently).
+
+    Returns False (and records nothing, ever) when the installed JAX
+    lacks the hooks — the graceful no-op fallback.  Idempotent per
+    registry.  Exactly ONE listener pair is ever registered with JAX (the
+    hooks cannot be unregistered on this JAX version); it dispatches to a
+    weak set of hooked registries, so hooking a short-lived registry
+    neither leaks it nor stacks listeners (recording is further gated by
+    ``registry.enabled``).
+    """
+    global _listeners_registered
+    reg = registry if registry is not None else _default_registry
+    try:
+        from jax import monitoring
+        register_event = monitoring.register_event_listener
+        register_duration = monitoring.register_event_duration_secs_listener
+    except (ImportError, AttributeError):
+        return False
+    if not callable(register_event) or not callable(register_duration):
+        return False
+    with _install_lock:
+        # locked check-then-act: JAX listeners cannot be unregistered, so
+        # a concurrent double-install would double-count every compile
+        # event for the life of the process
+        if reg in _hooked_registries:
+            return True
+        if not _listeners_registered:
+            register_event(_on_jax_event)
+            register_duration(_on_jax_event_duration)
+            _listeners_registered = True
+        _hooked_registries.add(reg)
+    # eagerly materialize the headline keys so a snapshot taken before the
+    # first compile still carries them (bench artifacts stay uniform)
+    reg.counter("jax.jit_compiles")
+    reg.counter("jax.cache_misses")
+    reg.counter("jax.transfers")
+    reg.histogram("jax.compile_s")
+    return True
+
+
+def _on_jax_event(event: str, **kw) -> None:
+    for reg in list(_hooked_registries):
+        if not reg.enabled:
+            continue
+        if event.endswith("cache_misses"):
+            reg.counter("jax.cache_misses").inc()
+        elif event.endswith("cache_hits"):
+            reg.counter("jax.cache_hits").inc()
+        elif "transfer" in event:
+            reg.counter("jax.transfers").inc()
+
+
+def _on_jax_event_duration(event: str, duration_secs: float, **kw) -> None:
+    for reg in list(_hooked_registries):
+        if not reg.enabled:
+            continue
+        if event.endswith("backend_compile_duration"):
+            reg.counter("jax.jit_compiles").inc()
+            reg.histogram("jax.compile_s").record(duration_secs)
+        elif event.endswith("jaxpr_trace_duration"):
+            reg.histogram("jax.trace_s").record(duration_secs)
+        elif "transfer" in event:
+            reg.counter("jax.transfers").inc()
+            reg.histogram("jax.transfer_s").record(duration_secs)
+
+
+def jax_hooks_installed(registry: Optional[MetricsRegistry] = None) -> bool:
+    reg = registry if registry is not None else _default_registry
+    return reg in _hooked_registries
+
+
+def jax_stats(registry: Optional[MetricsRegistry] = None,
+              snap: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Compact recompile/transfer summary for bench artifacts.  Keys are
+    always present (0 when the hooks saw nothing or aren't installed).
+    Pass ``snap`` (a ``snapshot()`` already in hand) to avoid walking the
+    registry a second time."""
+    reg = registry if registry is not None else _default_registry
+    if snap is None:
+        snap = reg.snapshot()
+    c, h = snap["counters"], snap["histograms"]
+
+    def hist_sum(name):
+        return float(h.get(name, {}).get("sum", 0.0))
+
+    return {
+        "hooks_installed": jax_hooks_installed(reg),
+        "jit_compiles": int(c.get("jax.jit_compiles", 0)),
+        "compile_s_total": hist_sum("jax.compile_s"),
+        "trace_s_total": hist_sum("jax.trace_s"),
+        "cache_misses": int(c.get("jax.cache_misses", 0)),
+        "transfers": int(c.get("jax.transfers", 0)),
+        "transfer_s_total": hist_sum("jax.transfer_s"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Instrumentation helpers for the library's choke points
+# ---------------------------------------------------------------------------
+
+def record_fit(family: str, model,
+               registry: Optional[MetricsRegistry] = None) -> None:
+    """One fit-report counter bundle off a fitted model's diagnostics.
+
+    Host-side only: when the model's diagnostics are tracers (the fit ran
+    under ``jit``/``vmap`` tracing, where materializing would either fail
+    or bake host constants into the graph) the call counts a
+    ``fit.<family>.traced`` retrace instead — the concrete numbers for
+    such fits surface through the jit caller's own ``fit_report``.
+
+    Cost note: on an *eager* fit the ``np.asarray`` reads block until the
+    fit's device computation finishes, trading async-dispatch overlap for
+    exact counters.  The perf-critical paths are unaffected — jitted fits
+    (bench, production pipelines) hit the tracer branch above — and
+    ``STS_METRICS=0`` removes the reads entirely for eager-mode loops
+    that need maximal dispatch pipelining.
+    """
+    reg = registry if registry is not None else _default_registry
+    if not reg.enabled:
+        return
+    reg.counter(f"fit.{family}.calls").inc()
+    diag = getattr(model, "diagnostics", None)
+    if diag is None:
+        return
+    if any(_is_tracer(leaf) for leaf in
+           (diag.converged, diag.n_iter, diag.fun)):
+        reg.counter(f"fit.{family}.traced").inc()
+        return
+    try:
+        conv = np.asarray(diag.converged).reshape(-1)
+        n_iter = np.asarray(diag.n_iter).reshape(-1)
+        fun = np.asarray(diag.fun).reshape(-1)
+    except Exception:
+        # e.g. eval_shape's ShapeDtypeStruct leaves — nothing concrete
+        reg.counter(f"fit.{family}.traced").inc()
+        return
+    reg.counter(f"fit.{family}.series").inc(int(conv.size))
+    reg.counter(f"fit.{family}.converged").inc(int(np.sum(conv)))
+    reg.counter(f"fit.{family}.diverged").inc(int(np.sum(~np.isfinite(fun))))
+    if n_iter.size:
+        reg.histogram(f"fit.{family}.iters_mean").record(
+            float(np.mean(n_iter)))
+        reg.histogram(f"fit.{family}.iters_max").record(
+            float(np.max(n_iter)))
+
+
+def record_fit_report(family: str, report: Dict[str, Any],
+                      registry: Optional[MetricsRegistry] = None) -> None:
+    """Accumulate an ``observability.fit_report`` dict as a counter bundle
+    (``fit_report.<family>.*``), so repeated fits add up across a workload.
+    Kept in a separate namespace from :func:`record_fit`'s automatic
+    ``fit.<family>.*`` bundle — a user calling ``fit_report`` on an
+    already-instrumented model must not double-count the automatic one."""
+    reg = registry if registry is not None else _default_registry
+    if not reg.enabled:
+        return
+    pre = f"fit_report.{family}"
+    reg.counter(f"{pre}.reports").inc()
+    reg.counter(f"{pre}.n_series").inc(int(report.get("n_series", 0)))
+    reg.counter(f"{pre}.n_converged").inc(int(report.get("n_converged", 0)))
+    reg.counter(f"{pre}.n_diverged").inc(int(report.get("n_diverged", 0)))
+    if report.get("n_series"):
+        reg.histogram(f"{pre}.iters_mean").record(
+            float(report.get("iters_mean", 0.0)))
+        reg.histogram(f"{pre}.frac_converged").record(
+            float(report.get("frac_converged", 0.0)))
+
+
+def observe_minimize(solver: str, result,
+                     registry: Optional[MetricsRegistry] = None):
+    """Per-call iteration/convergence histograms off a ``MinimizeResult``.
+
+    Called at the tail of every public optimizer in ``ops.optimize``.
+    Host-side only: under tracing only ``optimize.<solver>.traced_calls``
+    increments (a retrace count in its own right).  Returns the result so
+    call sites can tail-call it.
+    """
+    reg = registry if registry is not None else _default_registry
+    if not reg.enabled:
+        return result
+    pre = f"optimize.{solver}"
+    reg.counter(f"{pre}.calls").inc()
+    if any(_is_tracer(leaf) for leaf in
+           (result.x, result.converged, result.n_iter)):
+        reg.counter(f"{pre}.traced_calls").inc()
+        return result
+    try:
+        conv = np.asarray(result.converged).reshape(-1)
+        n_iter = np.asarray(result.n_iter).reshape(-1)
+    except Exception:
+        reg.counter(f"{pre}.traced_calls").inc()
+        return result
+    reg.counter(f"{pre}.lanes").inc(int(conv.size))
+    reg.counter(f"{pre}.lanes_converged").inc(int(np.sum(conv)))
+    if n_iter.size:
+        reg.histogram(f"{pre}.iters_mean").record(float(np.mean(n_iter)))
+        reg.histogram(f"{pre}.iters_max").record(float(np.max(n_iter)))
+    return result
+
+
+def instrumented(span_name: str) -> Callable:
+    """Span-only decorator for non-fit choke points (io load/save paths,
+    panel conversions): wall-time histogram + xprof annotation, nothing
+    recorded off the return value."""
+
+    def deco(fn: Callable) -> Callable:
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with span(span_name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def instrument_fit(family: str, record: bool = True,
+                   name: Optional[str] = None) -> Callable:
+    """Decorator for model fit entry points: one span
+    (``<family>.<fn name>``, nesting under any active span) plus, when
+    ``record`` is True, one :func:`record_fit` counter bundle off the
+    returned model.  ``record=False`` is for wrappers (``fit_panel``,
+    ``auto_fit_panel``) whose inner ``fit`` already records — the wrapper
+    still gets its span so the nesting shows where panel time goes."""
+
+    def deco(fn: Callable) -> Callable:
+        span_name = name or f"{family}.{fn.__name__}"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            # record INSIDE the span: on an eager accelerator fit the
+            # recorder's np.asarray is what blocks until the device work
+            # finishes, so recording outside would attribute the compute
+            # wall-time to no span at all (dispatch-only spans)
+            with span(span_name):
+                out = fn(*args, **kwargs)
+                if record:
+                    record_fit(family, out)
+            return out
+
+        return wrapper
+
+    return deco

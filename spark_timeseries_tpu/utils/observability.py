@@ -4,9 +4,12 @@ The reference's only in-library telemetry is ``println`` warnings for
 non-stationary fits and ``seriesStats`` summaries
 (ref ``/root/reference/src/main/scala/com/cloudera/sparkts/models/ARIMA.scala:248-256``,
 ``TimeSeriesRDD.scala:265-267``); everything else is delegated to the Spark
-UI.  Here: ``jax.profiler`` traces, a ``block_until_ready`` timing harness,
-and structured convergence counters off the batched optimizers
-(SURVEY.md §5).
+UI.  Here: ``jax.profiler`` traces, the shared wall-timing harnesses
+(:func:`timed`, :func:`timed_min` — the one place the benchmark timing
+protocol lives), and structured convergence counters off the batched
+optimizers (SURVEY.md §5).  Structured counters/spans/recompile tracking
+live next door in :mod:`spark_timeseries_tpu.utils.metrics`;
+:func:`fit_report` feeds its registry so repeated fits accumulate.
 """
 
 from __future__ import annotations
@@ -15,18 +18,54 @@ import contextlib
 import json
 import logging
 import time
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import numpy as np
 
 logger = logging.getLogger("spark_timeseries_tpu")
 
+_configured_handler: Optional[logging.Handler] = None
+
+
+def configure_logging(level=logging.INFO, stream=None) -> logging.Handler:
+    """Opt-in console logging for the package logger.
+
+    The package attaches only a ``NullHandler`` (library-logging hygiene:
+    importing it never touches the root logger or prints anything), so
+    ``fit_report``'s ``logger.info`` lines are invisible by default.  This
+    helper makes them visible without the application configuring the
+    root logger::
+
+        observability.configure_logging("INFO")
+
+    ``level`` is a logging level name or constant; ``stream`` defaults to
+    stderr.  Idempotent — calling again replaces the previous handler
+    (e.g. to change level or stream) instead of stacking duplicates.
+    """
+    global _configured_handler
+    if isinstance(level, str):
+        level = logging.getLevelName(level.upper())
+    if _configured_handler is not None:
+        logger.removeHandler(_configured_handler)
+    handler = logging.StreamHandler(stream)
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(name)s %(levelname)s %(message)s"))
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    # while this handler is active the package logger must not also
+    # propagate to root, or an app with root logging configured would see
+    # every record twice
+    logger.propagate = False
+    _configured_handler = handler
+    return handler
+
 
 @contextlib.contextmanager
 def trace(name: str):
     """Named profiler scope; shows up in ``jax.profiler`` traces around the
-    fit kernels."""
+    fit kernels.  For a scope that also records wall time into the metrics
+    registry, use :func:`metrics.span`."""
     with jax.profiler.TraceAnnotation(name):
         yield
 
@@ -45,7 +84,9 @@ def profile(log_dir: str):
 def timed(fn: Callable, *args, warmup: int = 1, iters: int = 3,
           **kwargs) -> Dict[str, Any]:
     """Wall-time a jitted callable with ``block_until_ready`` fencing;
-    returns {mean_s, min_s, result}."""
+    returns {mean_s, min_s, result}.  For the benchmark tier's stricter
+    materializing protocol (min estimator, host round trip per rep), use
+    :func:`timed_min`."""
     result = None
     for _ in range(warmup):
         result = jax.block_until_ready(fn(*args, **kwargs))
@@ -58,7 +99,35 @@ def timed(fn: Callable, *args, warmup: int = 1, iters: int = 3,
             "result": result}
 
 
-def fit_report(result_or_model) -> Dict[str, Any]:
+def timed_min(fn, *args, reps: int = 3, want_out: bool = False):
+    """Wall-time ``fn(*args)`` (materializing every output on host), min
+    over ``reps`` after one warm call: the tunnel's per-call RTT jitter is
+    strictly additive noise, so the minimum is the cleanest estimator.
+    Materialization goes through ``np.asarray`` on every output leaf —
+    on the tunneled TPU platform ``block_until_ready`` alone does not
+    synchronize, so the host round trip is part of the protocol.
+
+    THE shared timing protocol for every benchmark entry point
+    (``bench.py``, ``benchmarks/roofline.py``, ``benchmarks/pallas_ab.py``,
+    ``benchmarks/bench_suite.py`` — all import it, directly or via
+    ``bench.timed_min``), so their numbers cannot drift apart.
+    ``want_out=True`` returns ``(seconds, out)`` with the last run's
+    materialized outputs.
+    """
+    def materialize():
+        return jax.tree_util.tree_map(np.asarray, fn(*args))
+
+    out = materialize()                                  # warm + sync
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = materialize()
+        best = min(best, time.perf_counter() - t0)
+    return (best, out) if want_out else best
+
+
+def fit_report(result_or_model, family: Optional[str] = None
+               ) -> Dict[str, Any]:
     """Convergence counters — the batched answer to the reference's
     per-series println warnings (ref ``ARIMA.scala:246-256``).
 
@@ -68,7 +137,18 @@ def fit_report(result_or_model) -> Dict[str, Any]:
 
         model = arima.fit_panel(panel, 2, 1, 2)
         report = fit_report(model)          # {"n_converged": ..., ...}
+
+    Besides the headline counts the report carries ``frac_converged`` and
+    the iteration distribution (``iters_mean``/``iters_p50``/``iters_p95``/
+    ``iters_max``) — under vmap every lane pays the slowest lane's
+    iterations, so the p95/max gap is the first thing to read when a fit
+    stage regresses.  Each report is also accumulated into the metrics
+    registry as a ``fit_report.<family>.*`` counter bundle
+    (:func:`metrics.record_fit_report`), so repeated fits add up across a
+    workload; ``family`` defaults to a name derived from the input's type
+    (``ARIMAModel`` -> ``arima``).
     """
+    source = result_or_model
     diag = getattr(result_or_model, "diagnostics", None)
     if diag is not None:
         result_or_model = diag
@@ -76,15 +156,35 @@ def fit_report(result_or_model) -> Dict[str, Any]:
         raise TypeError(
             f"{type(result_or_model).__name__} carries no fit diagnostics "
             "(was it produced by a fit()?)")
+    if family is None:
+        import re
+        name = type(source).__name__
+        for suffix in ("Model", "Result", "Diagnostics"):
+            if name.endswith(suffix):
+                name = name[:-len(suffix)]
+                break
+        # snake_case so the derived family matches the instrument_fit
+        # bundle spelling (HoltWintersModel -> holt_winters, matching
+        # fit.holt_winters.*; RegressionARIMAModel -> regression_arima)
+        name = re.sub(r"(?<=[a-z0-9])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])",
+                      "_", name)
+        family = name.lower() or "fit"
     converged = np.asarray(result_or_model.converged)
     n_iter = np.asarray(result_or_model.n_iter)
     fun = np.asarray(result_or_model.fun)
+    n_series = int(converged.size)
+    n_converged = int(np.sum(converged))
     report = {
-        "n_series": int(converged.size),
-        "n_converged": int(np.sum(converged)),
+        "n_series": n_series,
+        "n_converged": n_converged,
+        "frac_converged": (n_converged / n_series) if n_series else 0.0,
         "n_diverged": int(np.sum(~np.isfinite(fun))),
-        "iters_mean": float(np.mean(n_iter)),
+        "iters_mean": float(np.mean(n_iter)) if n_iter.size else 0.0,
+        "iters_p50": float(np.percentile(n_iter, 50)) if n_iter.size else 0.0,
+        "iters_p95": float(np.percentile(n_iter, 95)) if n_iter.size else 0.0,
         "iters_max": int(np.max(n_iter)) if n_iter.size else 0,
     }
-    logger.info("fit_report %s", json.dumps(report))
+    from . import metrics
+    metrics.record_fit_report(family, report)
+    logger.info("fit_report %s %s", family, json.dumps(report))
     return report

@@ -1,0 +1,341 @@
+"""The structured-metrics subsystem: registry semantics, span nesting,
+export golden output, jax.monitoring recompile tracking, and the no-op
+fallback when the hooks are absent (ISSUE 1 tentpole)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu.ops.optimize import MinimizeResult
+from spark_timeseries_tpu.utils import metrics, observability
+from spark_timeseries_tpu.utils.metrics import MetricsRegistry
+
+
+# ---------------------------------------------------------------------------
+# registry: counter / gauge / histogram semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("x")
+    c.inc()
+    c.inc(5)
+    assert reg.counter("x") is c                 # get-or-create
+    assert reg.snapshot()["counters"]["x"] == 6
+    with pytest.raises(ValueError):
+        c.inc(-1)                                # counters are monotone
+
+
+def test_gauge_semantics():
+    reg = MetricsRegistry()
+    reg.set_gauge("g", 2.5)
+    reg.set_gauge("g", 1.0)                      # last write wins
+    assert reg.snapshot()["gauges"]["g"] == 1.0
+
+
+def test_histogram_semantics():
+    reg = MetricsRegistry()
+    for v in [1.0, 2.0, 3.0, 4.0]:
+        reg.record("h", v)
+    st = reg.snapshot()["histograms"]["h"]
+    assert st["count"] == 4
+    assert st["sum"] == 10.0
+    assert st["min"] == 1.0 and st["max"] == 4.0
+    assert st["mean"] == 2.5
+    assert st["p50"] == 2.5
+    assert st["p95"] == pytest.approx(3.85)
+
+
+def test_histogram_sample_cap_keeps_exact_aggregates():
+    reg = MetricsRegistry(max_samples=8)
+    for v in range(100):
+        reg.record("h", float(v))
+    st = reg.snapshot()["histograms"]["h"]
+    assert st["count"] == 100                    # count/sum exact past cap
+    assert st["sum"] == float(sum(range(100)))
+    assert st["min"] == 0.0 and st["max"] == 99.0
+    # percentiles come from the ring of the most recent 8 samples
+    assert 92.0 <= st["p50"] <= 99.0
+
+
+def test_reset_clears_everything():
+    reg = MetricsRegistry()
+    reg.inc("c")
+    reg.record("h", 1.0)
+    reg.record_span("s", 0.1)
+    reg.reset()
+    snap = reg.snapshot()
+    assert snap == {"counters": {}, "gauges": {}, "histograms": {},
+                    "spans": {}}
+
+
+def test_disabled_registry_records_nothing():
+    reg = MetricsRegistry()
+    reg.enabled = False
+    reg.inc("c")
+    reg.record("h", 1.0)
+    reg.record_span("s", 0.1)
+    snap = reg.snapshot()
+    assert snap["counters"] == {} and snap["histograms"] == {}
+    assert snap["spans"] == {}
+
+
+# ---------------------------------------------------------------------------
+# spans: nesting + timing
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_timing():
+    reg = MetricsRegistry()
+    import time as _time
+    with metrics.span("outer", registry=reg):
+        assert metrics.current_span_path() == "outer"
+        with metrics.span("inner", registry=reg):
+            assert metrics.current_span_path() == "outer/inner"
+            _time.sleep(0.01)
+    assert metrics.current_span_path() == ""
+    spans = reg.snapshot()["spans"]
+    assert set(spans) == {"outer", "outer/inner"}
+    assert spans["outer"]["count"] == 1
+    assert spans["outer/inner"]["count"] == 1
+    # the outer span contains the inner one
+    assert spans["outer"]["total_s"] >= spans["outer/inner"]["total_s"]
+    assert spans["outer/inner"]["total_s"] >= 0.005
+
+
+def test_span_distinct_paths_accumulate_separately():
+    reg = MetricsRegistry()
+    for _ in range(3):
+        with metrics.span("a", registry=reg):
+            pass
+    with metrics.span("b", registry=reg):
+        pass
+    spans = reg.snapshot()["spans"]
+    assert spans["a"]["count"] == 3
+    assert spans["b"]["count"] == 1
+
+
+def test_span_pops_on_exception():
+    reg = MetricsRegistry()
+    with pytest.raises(RuntimeError):
+        with metrics.span("boom", registry=reg):
+            raise RuntimeError("x")
+    assert metrics.current_span_path() == ""
+    assert reg.snapshot()["spans"]["boom"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# export: JSON + Prometheus golden output
+# ---------------------------------------------------------------------------
+
+def _golden_registry() -> MetricsRegistry:
+    reg = MetricsRegistry()
+    reg.counter("fit.arima.series").inc(8)
+    reg.set_gauge("panel.n_series", 4)
+    for v in [1.0, 2.0, 3.0, 4.0]:
+        reg.record("optimize.lm.iters_mean", v)
+    reg.record_span("arima.fit_panel", 0.25)
+    reg.record_span("arima.fit_panel", 0.75)
+    return reg
+
+
+def test_json_export_round_trips():
+    reg = _golden_registry()
+    snap = json.loads(reg.to_json())
+    assert snap == reg.snapshot()
+    assert snap["counters"]["fit.arima.series"] == 8
+    assert snap["spans"]["arima.fit_panel"]["count"] == 2
+    assert snap["spans"]["arima.fit_panel"]["total_s"] == 1.0
+
+
+def test_prometheus_export_golden():
+    out = _golden_registry().to_prometheus()
+    assert out == (
+        "# TYPE sts_fit_arima_series counter\n"
+        "sts_fit_arima_series 8\n"
+        "# TYPE sts_panel_n_series gauge\n"
+        "sts_panel_n_series 4\n"
+        "# TYPE sts_optimize_lm_iters_mean summary\n"
+        'sts_optimize_lm_iters_mean{quantile="0.5"} 2.5\n'
+        'sts_optimize_lm_iters_mean{quantile="0.95"} 3.85\n'
+        "sts_optimize_lm_iters_mean_sum 10\n"
+        "sts_optimize_lm_iters_mean_count 4\n"
+        "# TYPE sts_arima_fit_panel_seconds summary\n"
+        'sts_arima_fit_panel_seconds{quantile="0.5"} 0.5\n'
+        'sts_arima_fit_panel_seconds{quantile="0.95"} 0.725\n'
+        "sts_arima_fit_panel_seconds_sum 1\n"
+        "sts_arima_fit_panel_seconds_count 2\n"
+    )
+
+
+# ---------------------------------------------------------------------------
+# jax.monitoring bridge
+# ---------------------------------------------------------------------------
+
+def test_recompile_counter_increments_across_forced_rejit():
+    reg = MetricsRegistry()
+    assert metrics.install_jax_hooks(reg) is True
+    assert metrics.install_jax_hooks(reg) is True     # idempotent
+    assert metrics.jax_hooks_installed(reg)
+
+    before = reg.snapshot()["counters"]["jax.jit_compiles"]
+
+    @jax.jit
+    def f(x):
+        return x * 2.0 + 1.0
+
+    f(jnp.ones(7)).block_until_ready()
+    after_first = reg.snapshot()["counters"]["jax.jit_compiles"]
+    assert after_first > before                       # first compile seen
+
+    f(jnp.ones(7)).block_until_ready()                # cache hit: no re-jit
+    assert reg.snapshot()["counters"]["jax.jit_compiles"] == after_first
+
+    f(jnp.ones(11)).block_until_ready()               # new shape: re-jit
+    after_second = reg.snapshot()["counters"]["jax.jit_compiles"]
+    assert after_second > after_first
+
+    stats = metrics.jax_stats(reg)
+    assert stats["hooks_installed"] is True
+    assert stats["jit_compiles"] == after_second
+    assert stats["compile_s_total"] > 0.0
+
+
+def test_jax_hooks_noop_fallback_when_absent(monkeypatch):
+    import jax.monitoring
+    monkeypatch.delattr(jax.monitoring, "register_event_listener")
+    reg = MetricsRegistry()
+    assert metrics.install_jax_hooks(reg) is False
+    assert not metrics.jax_hooks_installed(reg)
+    stats = metrics.jax_stats(reg)
+    assert stats["hooks_installed"] is False
+    assert stats["jit_compiles"] == 0
+    assert stats["compile_s_total"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# host-side instrumentation helpers
+# ---------------------------------------------------------------------------
+
+def test_observe_minimize_concrete():
+    reg = MetricsRegistry()
+    res = MinimizeResult(
+        x=jnp.ones((4, 2)),
+        fun=jnp.zeros(4),
+        converged=jnp.asarray([True, True, False, True]),
+        n_iter=jnp.asarray([3, 5, 50, 7]))
+    out = metrics.observe_minimize("lm", res, registry=reg)
+    assert out is res
+    c = reg.snapshot()["counters"]
+    assert c["optimize.lm.calls"] == 1
+    assert c["optimize.lm.lanes"] == 4
+    assert c["optimize.lm.lanes_converged"] == 3
+    h = reg.snapshot()["histograms"]
+    assert h["optimize.lm.iters_mean"]["count"] == 1
+    assert h["optimize.lm.iters_max"]["max"] == 50.0
+
+
+def test_record_fit_skips_tracers_under_jit():
+    """A fit traced under jit must count a retrace, not crash trying to
+    materialize tracer diagnostics."""
+    from spark_timeseries_tpu.models import ewma
+
+    rng = np.random.default_rng(3)
+    y = jnp.asarray(rng.normal(size=(3, 48)).cumsum(axis=1))
+    base = metrics.snapshot()["counters"]
+
+    jax.jit(lambda v: ewma.fit(v))(y)
+
+    c = metrics.snapshot()["counters"]
+    assert c["fit.ewma.traced"] == base.get("fit.ewma.traced", 0) + 1
+    # concrete lane counts did NOT move (nothing concrete was seen)
+    assert c.get("fit.ewma.series", 0) == base.get("fit.ewma.series", 0)
+
+
+def test_model_fit_records_counter_bundle_and_span():
+    from spark_timeseries_tpu.models import ewma
+
+    rng = np.random.default_rng(4)
+    y = jnp.asarray(rng.normal(size=(5, 64)).cumsum(axis=1))
+    base = metrics.snapshot()["counters"]
+    ewma.fit(y)
+    snap = metrics.snapshot()
+    c = snap["counters"]
+    assert c["fit.ewma.calls"] == base.get("fit.ewma.calls", 0) + 1
+    assert c["fit.ewma.series"] == base.get("fit.ewma.series", 0) + 5
+    assert snap["spans"]["ewma.fit"]["count"] >= 1
+
+
+def test_fit_report_extension_and_registry_bundle():
+    from spark_timeseries_tpu.models import ewma
+
+    rng = np.random.default_rng(5)
+    y = jnp.asarray(rng.normal(size=(6, 80)).cumsum(axis=1))
+    model = ewma.fit(y)
+    base = metrics.snapshot()["counters"].get(
+        "fit_report.ewma.n_series", 0)
+    report = observability.fit_report(model)
+    assert report["n_series"] == 6
+    assert 0.0 <= report["frac_converged"] <= 1.0
+    assert report["iters_p50"] <= report["iters_p95"] <= report["iters_max"]
+    after = metrics.snapshot()["counters"]["fit_report.ewma.n_series"]
+    assert after == base + 6
+    # repeated fits accumulate
+    observability.fit_report(model)
+    assert metrics.snapshot()["counters"][
+        "fit_report.ewma.n_series"] == base + 12
+
+
+def test_fit_report_family_matches_instrumented_bundle():
+    """The auto-derived fit_report family must use the same spelling as
+    the @instrument_fit bundle, or per-family dashboards correlate
+    nothing (HoltWintersModel -> holt_winters, not holtwinters)."""
+    from spark_timeseries_tpu.models import holt_winters
+
+    rng = np.random.default_rng(6)
+    t = np.arange(72)
+    y = jnp.asarray(10 + 0.1 * t + np.sin(2 * np.pi * t / 12)
+                    + 0.1 * rng.normal(size=(2, 72)))
+    model = holt_winters.fit(y, period=12, max_iter=50)
+    observability.fit_report(model)
+    c = metrics.snapshot()["counters"]
+    assert "fit.holt_winters.calls" in c
+    assert "fit_report.holt_winters.reports" in c
+    assert not any(k.startswith("fit_report.holtwinters") for k in c)
+
+
+def test_auto_fit_carries_diagnostics():
+    from spark_timeseries_tpu.models import arima
+
+    rng = np.random.default_rng(7)
+    y = jnp.asarray(rng.normal(size=160).cumsum())
+    model = arima.auto_fit(y, max_p=1, max_q=1)
+    assert model.diagnostics is not None
+    report = observability.fit_report(model)
+    assert report["n_series"] == 1
+
+
+def test_timed_min_shared_harness():
+    calls = []
+
+    def fn(x):
+        calls.append(1)
+        return {"y": x * 2}
+
+    best, out = observability.timed_min(fn, jnp.arange(4.0), reps=2,
+                                        want_out=True)
+    assert len(calls) == 3                       # 1 warm + 2 timed
+    assert best >= 0.0
+    assert isinstance(out["y"], np.ndarray)      # materialized on host
+    np.testing.assert_allclose(out["y"], [0.0, 2.0, 4.0, 6.0])
+    # bench re-exports the same protocol
+    import sys
+    from pathlib import Path
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    try:
+        import bench
+        assert bench.timed_min(fn, jnp.arange(4.0), reps=1) >= 0.0
+    finally:
+        sys.path.pop(0)
