@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: verify compileall tier1
+.PHONY: verify compileall tier1 verify-faults
 
 # byte-compile the whole package (catches syntax errors in files the test
 # sweep doesn't import) then run the tier-1 test sweep
@@ -16,3 +16,16 @@ tier1:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly
+
+# tier-1-adjacent CI: the same sweep with fault injection armed —
+# STS_FAULT_INJECT=1 makes every resilient fit force its primary stage's
+# first optimizer attempt to report non-convergence, so the retry path is
+# exercised on every resilient fit and still-failed lanes drive the
+# fallback chain, which runs clean (fallback stages must be able to
+# SUCCEED here, or a regression in them would be invisible).  Plain fits
+# are unaffected; the bit-for-bit equivalence tests skip themselves
+# under this flag.
+verify-faults:
+	STS_FAULT_INJECT=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
+		-m 'not slow' --continue-on-collection-errors \
+		-p no:cacheprovider -p no:xdist -p no:randomly

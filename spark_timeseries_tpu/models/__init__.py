@@ -7,6 +7,7 @@ every fit is a batched XLA program over the panel instead of a per-series
 Commons-Math loop.
 """
 
+from ..utils.resilience import FitOutcome, RetryPolicy
 from . import (arima, arimax, autoregression, autoregression_x, ewma, garch,
                holt_winters, regression_arima)
 from .arima import ARIMAModel
@@ -20,6 +21,7 @@ from .holt_winters import HoltWintersModel
 from .regression_arima import RegressionARIMAModel
 
 __all__ = ["TimeSeriesModel", "FitDiagnostics", "refit_unconverged",
+           "FitOutcome", "RetryPolicy",
            "ewma", "EWMAModel",
            "autoregression", "ARModel",
            "autoregression_x", "ARXModel",

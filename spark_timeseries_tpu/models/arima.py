@@ -45,6 +45,7 @@ from ..ops.univariate import (differences_of_order_d,
                               inverse_differences_of_order_d)
 from ..stats import KPSS_CONSTANT_CRITICAL_VALUES, kpsstest
 from ..utils import metrics as _metrics
+from ..utils import resilience as _resilience
 from . import autoregression
 from .base import (FitDiagnostics, diagnostics_from, normal_quantile,
                    scan_unroll)
@@ -782,7 +783,8 @@ def _use_pallas_lm(diffed: jnp.ndarray, nv) -> bool:
 def fit(p: int, d: int, q: int, ts: jnp.ndarray,
         include_intercept: bool = True, method: str = "css-lm",
         user_init_params: Optional[jnp.ndarray] = None,
-        warn: bool = True, max_iter: Optional[int] = None) -> ARIMAModel:
+        warn: bool = True, max_iter: Optional[int] = None,
+        retry: Optional[_resilience.RetryPolicy] = None) -> ARIMAModel:
     """Fit an ARIMA(p, d, q) by conditional-sum-of-squares maximum likelihood
     (ref ``ARIMA.scala:79-116``).
 
@@ -841,8 +843,19 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
     Lanes too short for the order get NaN coefficients and
     ``diagnostics.converged == False``.  Interior gaps still raise —
     impute those with ``fill`` first.
+
+    ``retry`` (a ``utils.resilience.RetryPolicy``) enables the optimizers'
+    multi-start path: non-converged / non-finite lanes re-solve from
+    jittered inits inside the batched computation, the per-lane attempt
+    count lands in ``diagnostics.attempts``, and ``retry.max_iter`` (when
+    set) becomes the per-attempt budget unless ``max_iter`` overrides it.
+    The css-lm method then takes the XLA solver path (the Pallas kernel
+    has no restart loop).
     """
     ts = jnp.asarray(ts)
+    rk = _resilience.retry_kwargs(retry)
+    if max_iter is None and retry is not None and retry.max_iter is not None:
+        max_iter = retry.max_iter
     ts, obs_len = ragged_view(ts)
     icpt = 1 if include_intercept else 0
     diffed = differences_of_order_d(ts, d)[..., d:]
@@ -920,7 +933,10 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
 
     if method == "css-lm":
         mi = max_iter if max_iter is not None else LM_MAX_ITER
-        lm_mode = _pallas_lm_mode(diffed, nv)
+        # retry and injected optimizer faults both live in the XLA solver
+        # (the Pallas kernel has neither a restart loop nor the fault hook)
+        lm_mode = "xla" if (rk or _resilience.forced_optimizer_failures()) \
+            else _pallas_lm_mode(diffed, nv)
         if lm_mode != "xla":
             from ..ops.pallas_arma import fit_css_lm, fit_css_lm_sharded
             x2 = init if init.ndim == 2 else init[None]
@@ -939,13 +955,16 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
             res = minimize_least_squares(
                 None, init, diffed, *extra, max_iter=mi,
                 normal_eqs_fn=lambda prm, y, *v: _arma_normal_eqs(
-                    prm, y, p, q, icpt, n_valid=v[0] if v else None))
+                    prm, y, p, q, icpt, n_valid=v[0] if v else None), **rk)
     elif method == "css-cgd":
         res = minimize_bfgs(neg_ll, init, diffed, *extra, tol=1e-7,
-                            max_iter=max_iter if max_iter is not None else 500)
+                            max_iter=max_iter if max_iter is not None else 500,
+                            **rk)
     elif method == "css-bobyqa":
         res = minimize_box(neg_ll, init, -jnp.inf, jnp.inf, diffed, *extra,
-                           tol=1e-10, max_iter=max_iter if max_iter is not None else 500)
+                           tol=1e-10,
+                           max_iter=max_iter if max_iter is not None else 500,
+                           **rk)
     else:
         raise ValueError(f"unknown method {method!r}")
 
@@ -1001,6 +1020,78 @@ def fit_panel(panel, p: int, d: int, q: int, **kwargs) -> ARIMAModel:
     """Batched fit over a Panel — the ``rdd.mapValues(ARIMA.fitModel(...))``
     equivalent (ref ``src/site/markdown/docs/users.md:107-118``)."""
     return fit(p, d, q, panel.values, **kwargs)
+
+
+def _pad_to_order(model: ARIMAModel, p: int, q: int) -> ARIMAModel:
+    """Re-express a lower-order fit as an ARIMA(p, d, q) model by
+    zero-filling the absent AR/MA slots — an AR(p') fit with θ = 0 *is* an
+    ARIMA(p, d, q) point, so fallback results merge into the primary
+    parameter layout exactly."""
+    icpt = model._icpt
+    coefs = jnp.asarray(model.coefficients)
+    parts = [coefs[..., :icpt + model.p],
+             jnp.zeros((*coefs.shape[:-1], p - model.p), coefs.dtype),
+             coefs[..., icpt + model.p:],
+             jnp.zeros((*coefs.shape[:-1], q - model.q), coefs.dtype)]
+    return ARIMAModel(p, model.d, q, jnp.concatenate(parts, axis=-1),
+                      model.has_intercept, diagnostics=model.diagnostics)
+
+
+@_metrics.instrument_fit("arima", record=False, name="arima.fit_resilient")
+def fit_resilient(ts: jnp.ndarray, p: int, d: int, q: int,
+                  include_intercept: bool = True,
+                  fallbacks: Sequence[str] = ("ar", "mean"),
+                  retry: Optional[_resilience.RetryPolicy] = None,
+                  **kwargs):
+    """Fail-soft batched ARIMA over a panel: health masking, multi-start
+    retry, and a declarative fallback chain — ARIMA(p, d, q) →
+    ``"ar"`` (AR(p) via the direct OLS fast path, θ = 0) → ``"mean"``
+    (intercept-only drift model on the d-differenced series).
+
+    ``ts (n_series, n)``.  Returns ``(model, outcome)``: an
+    :class:`ARIMAModel` in the full (p, d, q) layout whose per-lane
+    parameters come from the first stage that converged for that lane, and
+    a :class:`~spark_timeseries_tpu.utils.resilience.FitOutcome` with
+    per-series status / health / attempts / fallback indices.  Unfittable
+    lanes (all-NaN, inf, interior gaps, too short) are skipped with an
+    explicit status and NaN parameters instead of raising; healthy lanes
+    match :func:`fit` bit-for-bit.  ``kwargs`` pass through to the primary
+    :func:`fit` (``method``, ``max_iter``, ...).
+
+    One routing caveat for the bit-for-bit claim: a restart budget forces
+    css-lm onto the XLA solver, while a *plain* fit of a TPU panel large
+    enough for the Pallas gate routes through the kernel, whose iteration
+    trajectories differ in low-order bits.  Pass
+    ``retry=RetryPolicy(max_restarts=0)`` to keep the plain routing (and
+    exact equality) there; health masking and the fallback chain still
+    apply.
+    """
+    if retry is None:
+        retry = _resilience.RetryPolicy()
+    icpt = 1 if include_intercept else 0
+    max_lag = max(p, q)
+    # the Hannan-Rissanen floor (the binding one when q > 0), plus d
+    min_len = d + max(2 * max_lag + 2 + p + q + icpt, max_lag + 2, 3)
+
+    chain = [("arima", lambda v: fit.__wrapped__(
+        p, d, q, v, include_intercept=include_intercept, retry=retry,
+        warn=False, **kwargs))]
+    for fb in fallbacks:
+        if fb == "ar" and p > 0 and q > 0:
+            chain.append(("ar", lambda v: _pad_to_order(
+                _fit_unrecorded(p, d, 0, v,
+                                include_intercept=include_intercept,
+                                warn=False), p, q)))
+        elif fb == "mean":
+            chain.append(("mean", lambda v: _pad_to_order(
+                _fit_unrecorded(0, d, 0, v,
+                                include_intercept=include_intercept,
+                                warn=False), p, q)))
+        elif fb != "ar":
+            raise ValueError(f"unknown arima fallback {fb!r}; "
+                             f"expected 'ar' or 'mean'")
+    return _resilience.resilient_fit(ts, chain, min_len=min_len,
+                                     family="arima")
 
 
 @_metrics.instrument_fit("arima")

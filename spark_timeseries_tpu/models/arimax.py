@@ -48,6 +48,7 @@ from jax import lax
 from ..ops.univariate import differences_of_order_d
 from . import autoregression_x
 from ..utils import metrics as _metrics
+from ..utils import resilience as _resilience
 from .base import FitDiagnostics, diagnostics_from, normal_quantile
 from .arima import (LM_MAX_ITER, _add_effects_one, _arma_normal_eqs,
                     _batched, _difference_rows, _log_likelihood_css_arma,
@@ -260,7 +261,8 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray, xreg: jnp.ndarray,
         include_intercept: bool = True,
         user_init_params: Optional[jnp.ndarray] = None,
         method: str = "css-lm",
-        max_iter: Optional[int] = None) -> ARIMAXModel:
+        max_iter: Optional[int] = None,
+        retry: Optional[_resilience.RetryPolicy] = None) -> ARIMAXModel:
     """Fit an ARIMAX(p, d, q) (ref ``ARIMAX.scala:61-90``): initialize the
     ARX part by OLS on [y lags ‖ xreg lags ‖ xreg] (with the xreg columns
     differenced to order d, ref ``ARIMAX.scala:92-112``), the MA part by
@@ -319,6 +321,10 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray, xreg: jnp.ndarray,
         def neg_ll(prm, y):
             return -_log_likelihood_css_arma(prm, y, p, q, icpt)
 
+        rk = _resilience.retry_kwargs(retry)
+        if max_iter is None and retry is not None \
+                and retry.max_iter is not None:
+            max_iter = retry.max_iter
         if method == "css-lm":
             # the refinement runs on the xreg-adjusted series with pure
             # [c?, AR, MA] parameters — exactly arima's CSS residual, so
@@ -327,13 +333,16 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray, xreg: jnp.ndarray,
                 None, init, adjusted,
                 max_iter=max_iter if max_iter is not None else LM_MAX_ITER,
                 normal_eqs_fn=lambda prm, y: _arma_normal_eqs(
-                    prm, y, p, q, icpt))
+                    prm, y, p, q, icpt), **rk)
         elif method == "css-cgd":
             res = minimize_bfgs(neg_ll, init, adjusted, tol=1e-7,
-                                max_iter=max_iter if max_iter is not None else 500)
+                                max_iter=max_iter if max_iter is not None else 500,
+                                **rk)
         elif method == "css-bobyqa":
             res = minimize_box(neg_ll, init, -jnp.inf, jnp.inf, adjusted,
-                               tol=1e-10, max_iter=max_iter if max_iter is not None else 500)
+                               tol=1e-10,
+                               max_iter=max_iter if max_iter is not None else 500,
+                               **rk)
         else:
             raise ValueError(f"unknown method {method!r}")
         lane_ok = jnp.all(jnp.isfinite(res.x), axis=-1, keepdims=True)
@@ -355,3 +364,60 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray, xreg: jnp.ndarray,
         full = jnp.concatenate([zero_c, refined, bx], axis=-1)
     return ARIMAXModel(p, d, q, xreg_max_lag, full, include_original_xreg,
                        include_intercept, diagnostics=diag)
+
+
+def _pad_to_order(model: ARIMAXModel, p: int, q: int) -> ARIMAXModel:
+    """Re-express a lower-ARMA-order ARIMAX fit in the (p, q) layout by
+    zero-filling the absent AR/MA slots (the intercept slot is always
+    present in this family's layout, ref ``ARIMAX.scala:177-186``)."""
+    coefs = jnp.asarray(model.coefficients)
+    c = coefs[..., :1]
+    ar = coefs[..., 1:1 + model.p]
+    ma = coefs[..., 1 + model.p:1 + model.p + model.q]
+    bx = coefs[..., 1 + model.p + model.q:]
+    zero = lambda k: jnp.zeros((*coefs.shape[:-1], k), coefs.dtype)
+    full = jnp.concatenate([c, ar, zero(p - model.p),
+                            ma, zero(q - model.q), bx], axis=-1)
+    return ARIMAXModel(p, model.d, q, model.xreg_max_lag, full,
+                       model.include_original_xreg, model.has_intercept,
+                       diagnostics=model.diagnostics)
+
+
+@_metrics.instrument_fit("arimax", record=False, name="arimax.fit_resilient")
+def fit_resilient(ts: jnp.ndarray, xreg: jnp.ndarray, p: int, d: int, q: int,
+                  xreg_max_lag: int, include_original_xreg: bool = True,
+                  include_intercept: bool = True,
+                  retry=None, **kwargs):
+    """Fail-soft batched ARIMAX: css-lm (with multi-start retry) →
+    css-bobyqa → xreg-plus-intercept only (the ARMA slots zeroed, exogenous
+    effects kept).  ``ts (n_series, n)``; ``xreg`` must be a shared
+    unbatched ``(n, k)`` design (a per-series design cannot be compacted
+    alongside the panel).  Returns ``(model, FitOutcome)`` — see
+    ``utils.resilience.resilient_fit``."""
+    if retry is None:
+        retry = _resilience.RetryPolicy()
+    xreg = jnp.asarray(xreg)
+    if xreg.ndim != 2:
+        raise ValueError(
+            "fit_resilient needs a shared unbatched (n, k) design; got "
+            f"xreg shape {xreg.shape}")
+
+    def _fit(v, **kw):
+        return fit.__wrapped__(p, d, q, v, xreg, xreg_max_lag,
+                               include_original_xreg, include_intercept,
+                               **kw, **kwargs)
+
+    chain = [
+        ("css-lm", lambda v: _fit(v, retry=retry)),
+        ("css-bobyqa", lambda v: fit.__wrapped__(
+            p, d, q, v, xreg, xreg_max_lag, include_original_xreg,
+            include_intercept,
+            **_resilience.override_kwargs(kwargs, method="css-bobyqa"))),
+        ("xreg_only", lambda v: _pad_to_order(
+            fit.__wrapped__(0, d, 0, v, xreg, xreg_max_lag,
+                            include_original_xreg, include_intercept,
+                            **kwargs), p, q)),
+    ]
+    min_len = d + max(2 * max(p, q) + 3 + p + q, xreg_max_lag + 2, 3)
+    return _resilience.resilient_fit(ts, chain, min_len=min_len,
+                                     family="arimax")

@@ -13,7 +13,7 @@ recurrence is a ``lax.scan`` with a length-``p`` ring carry.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -22,15 +22,19 @@ from jax import lax
 from ..ops.lag import lag_matvec, lag_stack
 from ..ops.linalg import ols_gram
 from ..utils import metrics as _metrics
-from .base import scan_unroll
+from ..utils import resilience as _resilience
+from .base import FitDiagnostics, scan_unroll
 
 
 class ARModel(NamedTuple):
     """AR(p) parameters; ``c`` scalar or ``(batch,)``, ``coefficients``
     ``(p,)`` or ``(batch, p)`` in increasing lag order
-    (ref ``Autoregression.scala:58-60``)."""
+    (ref ``Autoregression.scala:58-60``).  ``diagnostics.converged`` marks
+    lanes whose OLS solve came back finite (the direct solve has no
+    iteration count — ``n_iter`` is 0 and ``fun`` a 0/NaN flag)."""
     c: jnp.ndarray
     coefficients: jnp.ndarray
+    diagnostics: Optional[FitDiagnostics] = None
 
     @property
     def order(self) -> int:
@@ -95,8 +99,15 @@ def fit(ts: jnp.ndarray, max_lag: int = 1, no_intercept: bool = False,
     res = ols_gram(X, y, add_intercept=not no_intercept, row_weights=w)
     if no_intercept:
         c = jnp.zeros(ts.shape[:-1], ts.dtype)
-        return ARModel(c, res.beta)
-    return ARModel(res.beta[..., 0], res.beta[..., 1:])
+        coefs = res.beta
+    else:
+        c, coefs = res.beta[..., 0], res.beta[..., 1:]
+    # direct solve: "converged" = finite solution, in 0 iterations (the
+    # resilient fallback chains key off this mask like any optimizer's)
+    ok = jnp.all(jnp.isfinite(res.beta), axis=-1)
+    diag = FitDiagnostics(ok, jnp.zeros(ok.shape, jnp.int32),
+                          jnp.where(ok, 0.0, jnp.nan).astype(ts.dtype))
+    return ARModel(c, coefs, diagnostics=diag)
 
 
 @_metrics.instrument_fit("ar", record=False)
@@ -104,3 +115,31 @@ def fit_panel(panel, max_lag: int = 1, no_intercept: bool = False) -> ARModel:
     """Batched fit over a Panel — the ``mapValues(Autoregression.fitModel)``
     equivalent."""
     return fit(panel.values, max_lag, no_intercept)
+
+
+def _mean_model(v: jnp.ndarray, max_lag: int) -> ARModel:
+    """Terminal fallback: intercept-only (all AR coefficients zero) — the
+    drift/mean model, defined for any lane with finite observations
+    (NaN padding on ragged lanes is ignored, like the primary fits)."""
+    c = jnp.nanmean(v, axis=-1)
+    ok = jnp.isfinite(c)
+    return ARModel(c, jnp.zeros((*v.shape[:-1], max_lag), v.dtype),
+                   diagnostics=FitDiagnostics(
+                       ok, jnp.zeros(ok.shape, jnp.int32),
+                       jnp.where(ok, 0.0, jnp.nan).astype(v.dtype)))
+
+
+@_metrics.instrument_fit("ar", record=False, name="ar.fit_resilient")
+def fit_resilient(ts: jnp.ndarray, max_lag: int = 1,
+                  no_intercept: bool = False,
+                  retry: Optional[_resilience.RetryPolicy] = None):
+    """Fail-soft batched AR(p): OLS → intercept-only mean model.  The OLS
+    solve is direct, so ``retry`` is accepted for interface uniformity but
+    unused.  ``ts (n_series, n)``; returns ``(model, FitOutcome)``."""
+    del retry
+    chain = [
+        ("ols", lambda v: fit.__wrapped__(v, max_lag, no_intercept)),
+        ("mean", lambda v: _mean_model(v, max_lag)),
+    ]
+    return _resilience.resilient_fit(ts, chain, min_len=2 * max_lag + 2,
+                                     family="ar")

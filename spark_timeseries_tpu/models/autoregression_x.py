@@ -8,13 +8,15 @@ ordering and trimming conventions.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax.numpy as jnp
 
 from ..ops.lag import lag_matrix, lag_matrix_multi
 from ..utils import metrics as _metrics
+from ..utils import resilience as _resilience
 from ..ops.linalg import ols
+from .base import FitDiagnostics
 
 
 def _empty_cols(x: jnp.ndarray, rows: int) -> jnp.ndarray:
@@ -62,6 +64,7 @@ class ARXModel(NamedTuple):
     y_max_lag: int
     x_max_lag: int
     includes_original_x: bool
+    diagnostics: Optional[FitDiagnostics] = None
 
     def predict(self, y: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
         """(ref ``AutoregressionX.scala:117-130``) — one batched matvec."""
@@ -90,4 +93,54 @@ def fit(y: jnp.ndarray, x: jnp.ndarray, y_max_lag: int, x_max_lag: int,
         coeffs = res.beta
     else:
         c, coeffs = res.beta[..., 0], res.beta[..., 1:]
-    return ARXModel(c, coeffs, y_max_lag, x_max_lag, include_original_x)
+    ok = jnp.all(jnp.isfinite(res.beta), axis=-1)
+    diag = FitDiagnostics(ok, jnp.zeros(ok.shape, jnp.int32),
+                          jnp.where(ok, 0.0, jnp.nan).astype(y.dtype))
+    return ARXModel(c, coeffs, y_max_lag, x_max_lag, include_original_x,
+                    diagnostics=diag)
+
+
+def _n_arx_coefs(k: int, y_max_lag: int, x_max_lag: int,
+                 include_original_x: bool) -> int:
+    return y_max_lag + k * x_max_lag + (k if include_original_x else 0)
+
+
+def _mean_model(v: jnp.ndarray, k: int, y_max_lag: int, x_max_lag: int,
+                include_original_x: bool) -> ARXModel:
+    """Terminal fallback: intercept-only (every AR and exogenous
+    coefficient zero); NaN padding on ragged lanes is ignored."""
+    c = jnp.nanmean(v, axis=-1)
+    ok = jnp.isfinite(c)
+    width = _n_arx_coefs(k, y_max_lag, x_max_lag, include_original_x)
+    return ARXModel(c, jnp.zeros((*v.shape[:-1], width), v.dtype),
+                    y_max_lag, x_max_lag, include_original_x,
+                    diagnostics=FitDiagnostics(
+                        ok, jnp.zeros(ok.shape, jnp.int32),
+                        jnp.where(ok, 0.0, jnp.nan).astype(v.dtype)))
+
+
+@_metrics.instrument_fit("arx", record=False, name="arx.fit_resilient")
+def fit_resilient(y: jnp.ndarray, x: jnp.ndarray, y_max_lag: int,
+                  x_max_lag: int, include_original_x: bool = True,
+                  no_intercept: bool = False,
+                  retry: Optional[_resilience.RetryPolicy] = None):
+    """Fail-soft batched ARX: OLS → intercept-only mean model.  ``y
+    (n_series, n)``; ``x`` must be a shared unbatched ``(n, k)`` design
+    (a per-series design cannot be compacted alongside the panel).
+    Returns ``(model, FitOutcome)``."""
+    del retry
+    x = jnp.asarray(x)
+    if x.ndim != 2:
+        raise ValueError(
+            "fit_resilient needs a shared unbatched (n, k) design; got "
+            f"xreg shape {x.shape}")
+    k = x.shape[-1]
+    chain = [
+        ("ols", lambda v: fit.__wrapped__(v, x, y_max_lag, x_max_lag,
+                                          include_original_x, no_intercept)),
+        ("mean", lambda v: _mean_model(v, k, y_max_lag, x_max_lag,
+                                       include_original_x)),
+    ]
+    min_len = max(y_max_lag, x_max_lag) \
+        + _n_arx_coefs(k, y_max_lag, x_max_lag, include_original_x) + 2
+    return _resilience.resilient_fit(y, chain, min_len=min_len, family="arx")

@@ -76,10 +76,13 @@ class FitDiagnostics(NamedTuple):
     ``converged`` is False both for lanes whose optimizer hit its iteration
     cap and for lanes that were quarantined back to their initial guess
     (non-finite result); ``fun`` is the objective at the returned parameters.
+    ``attempts`` is the per-lane multi-start solve count when the fit ran
+    with a retry policy (``utils.resilience.RetryPolicy``), else None.
     """
     converged: jnp.ndarray   # bool (...,)
     n_iter: jnp.ndarray      # (...,)
     fun: jnp.ndarray         # (...,)
+    attempts: Optional[jnp.ndarray] = None   # (...,) multi-start solves
 
 
 def diagnostics_from(res, lane_ok=None) -> FitDiagnostics:
@@ -94,7 +97,8 @@ def diagnostics_from(res, lane_ok=None) -> FitDiagnostics:
     # a lane whose objective is non-finite (e.g. an all-NaN series) may
     # still trip the optimizer's "pinned" exit; it has not converged
     return FitDiagnostics(converged & jnp.isfinite(fun),
-                          jnp.asarray(res.n_iter), fun)
+                          jnp.asarray(res.n_iter), fun,
+                          getattr(res, "attempts", None))
 
 
 def refit_unconverged(values, model, fit_fn, min_bucket: int = 256):

@@ -27,6 +27,7 @@ from ..ops.optimize import (minimize_bfgs, minimize_box,
 from ..ops.ragged import (apply_short_quarantine, ragged_view, short_lanes,
                           step_weights)
 from ..utils import metrics as _metrics
+from ..utils import resilience as _resilience
 from .base import (FitDiagnostics, diagnostics_from, normal_quantile,
                    scan_unroll)
 
@@ -163,7 +164,8 @@ def _ewma_normal_eqs(params: jnp.ndarray, series: jnp.ndarray,
 
 @_metrics.instrument_fit("ewma")
 def fit(ts: jnp.ndarray, init: float = 0.94, tol: float = 1e-9,
-        max_iter: int = 200, method: str = "lm") -> EWMAModel:
+        max_iter: Optional[int] = None, method: str = "lm",
+        retry: Optional[_resilience.RetryPolicy] = None) -> EWMAModel:
     """Fit EWMA by minimizing one-step SSE over the smoothing parameter
     (ref ``EWMA.scala:45-69``; same 0.94 initial guess).
 
@@ -189,6 +191,12 @@ def fit(ts: jnp.ndarray, init: float = 0.94, tol: float = 1e-9,
     ts = jnp.asarray(ts)
     ts, obs_len = ragged_view(ts)
     extra = () if obs_len is None else (obs_len,)
+    rk = _resilience.retry_kwargs(retry)
+    # explicit max_iter wins over the policy's per-attempt budget (the
+    # arima/garch precedence); 200 is the historical default
+    if max_iter is None:
+        max_iter = retry.max_iter if retry is not None \
+            and retry.max_iter is not None else 200
 
     def objective(params, series, *v):
         model = EWMAModel(params[0])
@@ -206,7 +214,7 @@ def fit(ts: jnp.ndarray, init: float = 0.94, tol: float = 1e-9,
         res = minimize_least_squares(
             None, x0, ts, *extra, tol=tol, max_iter=max_iter,
             normal_eqs_fn=lambda prm, y, *v: _ewma_normal_eqs(
-                prm, y, n_valid=v[0] if v else None))
+                prm, y, n_valid=v[0] if v else None), **rk)
         # LM is unconstrained but the model domain is (0, 1]: a lane that
         # converges outside it (possible on near-random-walk data, where
         # the SSE is flat past a=1) would silently yield an oscillating,
@@ -219,10 +227,10 @@ def fit(ts: jnp.ndarray, init: float = 0.94, tol: float = 1e-9,
                            converged=res.converged & in_domain)
     elif method == "box":
         res = minimize_box(objective, x0, 1e-4, 1.0, ts, *extra,
-                           tol=tol, max_iter=max_iter)
+                           tol=tol, max_iter=max_iter, **rk)
     elif method == "bfgs":
         res = minimize_bfgs(objective, x0, ts, *extra, tol=tol,
-                            max_iter=max_iter)
+                            max_iter=max_iter, **rk)
     else:
         raise ValueError(f"unknown method {method!r}")
     # per-lane quarantine: a diverged lane falls back to the initial guess
@@ -243,3 +251,31 @@ def fit_panel(panel) -> EWMAModel:
     """Batched fit over a :class:`~spark_timeseries_tpu.panel.Panel` — the
     TPU equivalent of ``rdd.mapValues(EWMA.fitModel)``."""
     return fit(panel.values)
+
+
+def _naive_model(v: jnp.ndarray) -> EWMAModel:
+    """Terminal fallback: ``a = 1`` (the naive last-value smoother) —
+    defined for any series with finite observations, including constants
+    (a ragged lane's NaN-padding steps drop out of the nansum)."""
+    sse = jnp.nansum((v[..., 1:] - v[..., :-1]) ** 2, axis=-1)
+    m = EWMAModel(jnp.ones(v.shape[:-1], v.dtype))
+    return m._replace(diagnostics=FitDiagnostics(
+        jnp.isfinite(sse), jnp.zeros(sse.shape, jnp.int32), sse))
+
+
+@_metrics.instrument_fit("ewma", record=False, name="ewma.fit_resilient")
+def fit_resilient(ts: jnp.ndarray,
+                  retry: Optional[_resilience.RetryPolicy] = None,
+                  **kwargs):
+    """Fail-soft batched EWMA: LM (with multi-start retry) → box-constrained
+    solve → naive ``a = 1`` smoother.  ``ts (n_series, n)``; returns
+    ``(model, FitOutcome)`` — see ``utils.resilience.resilient_fit``."""
+    if retry is None:
+        retry = _resilience.RetryPolicy()
+    chain = [
+        ("lm", lambda v: fit.__wrapped__(v, retry=retry, **kwargs)),
+        ("box", lambda v: fit.__wrapped__(
+            v, **_resilience.override_kwargs(kwargs, method="box"))),
+        ("naive", _naive_model),
+    ]
+    return _resilience.resilient_fit(ts, chain, min_len=3, family="ewma")

@@ -25,8 +25,10 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.optimize import minimize_bfgs, minimize_box, minimize_newton
+from ..ops.ragged import ragged_view, step_weights
 from . import autoregression
 from ..utils import metrics as _metrics
+from ..utils import resilience as _resilience
 from .base import FitDiagnostics, diagnostics_from, scan_unroll
 
 
@@ -216,7 +218,8 @@ def _constrain(params):
 @_metrics.instrument_fit("garch")
 def fit(ts: jnp.ndarray, init=(0.2, 0.2, 0.2), tol: float = 1e-6,
         max_iter: Optional[int] = None,
-        method: str = "newton") -> GARCHModel:
+        method: str = "newton",
+        retry: Optional[_resilience.RetryPolicy] = None) -> GARCHModel:
     """Fit GARCH(1,1) by maximum likelihood (ref ``GARCH.scala:33-53``; same
     (.2, .2, .2) initial guess).
 
@@ -248,12 +251,17 @@ def fit(ts: jnp.ndarray, init=(0.2, 0.2, 0.2), tol: float = 1e-6,
     o0, a0, b0 = (jnp.asarray(v, ts.dtype) for v in init)
     x0 = jnp.broadcast_to(jnp.stack(_unconstrain(o0, a0, b0), axis=-1),
                           (*ts.shape[:-1], 3))
+    rk = _resilience.retry_kwargs(retry)
+    if max_iter is None and retry is not None:
+        max_iter = retry.max_iter
     if method == "newton":
         res = minimize_newton(neg_ll, x0, ts, tol=tol,
-                              max_iter=100 if max_iter is None else max_iter)
+                              max_iter=100 if max_iter is None else max_iter,
+                              **rk)
     elif method == "bfgs":
         res = minimize_bfgs(neg_ll, x0, ts, tol=tol,
-                            max_iter=500 if max_iter is None else max_iter)
+                            max_iter=500 if max_iter is None else max_iter,
+                            **rk)
     else:
         raise ValueError(f"unknown method {method!r}")
     ok = jnp.all(jnp.isfinite(res.x), axis=-1, keepdims=True)
@@ -266,6 +274,45 @@ def fit(ts: jnp.ndarray, init=(0.2, 0.2, 0.2), tol: float = 1e-6,
 def fit_panel(panel) -> GARCHModel:
     """Batched fit over a Panel — ``rdd.mapValues(GARCH.fitModel)``."""
     return fit(panel.values)
+
+
+def _const_gaussian_neg_ll(v: jnp.ndarray, var: jnp.ndarray) -> jnp.ndarray:
+    """Constant-variance Gaussian negative log likelihood over the observed
+    (non-NaN) entries, in closed form — ragged lanes' padding drops out of
+    the nansum instead of poisoning the diagnostics."""
+    n_valid = jnp.sum(~jnp.isnan(v), axis=-1).astype(v.dtype)
+    return 0.5 * (jnp.nansum(v * v, axis=-1) / var
+                  + n_valid * (jnp.log(var) + jnp.log(2.0 * jnp.pi)))
+
+
+def _const_variance_model(v: jnp.ndarray) -> GARCHModel:
+    """Terminal fallback: constant conditional variance (α = β = 0,
+    ω = sample variance) — the volatility-model analogue of a mean fit;
+    NaN padding on ragged lanes is ignored."""
+    var = jnp.clip(jnp.nanvar(v, axis=-1), 1e-12, None)
+    zeros = jnp.zeros_like(var)
+    m = GARCHModel(var, zeros, zeros)
+    neg_ll = _const_gaussian_neg_ll(v, var)
+    return m._replace(diagnostics=FitDiagnostics(
+        jnp.isfinite(neg_ll), jnp.zeros(neg_ll.shape, jnp.int32), neg_ll))
+
+
+@_metrics.instrument_fit("garch", record=False, name="garch.fit_resilient")
+def fit_resilient(ts: jnp.ndarray,
+                  retry: Optional[_resilience.RetryPolicy] = None,
+                  **kwargs):
+    """Fail-soft batched GARCH(1,1): Newton (with multi-start retry) →
+    BFGS → constant-variance model.  ``ts (n_series, n)``; returns
+    ``(model, FitOutcome)`` — see ``utils.resilience.resilient_fit``."""
+    if retry is None:
+        retry = _resilience.RetryPolicy()
+    chain = [
+        ("newton", lambda v: fit.__wrapped__(v, retry=retry, **kwargs)),
+        ("bfgs", lambda v: fit.__wrapped__(
+            v, **_resilience.override_kwargs(kwargs, method="bfgs"))),
+        ("const", _const_variance_model),
+    ]
+    return _resilience.resilient_fit(ts, chain, min_len=3, family="garch")
 
 
 class ARGARCHModel(NamedTuple):
@@ -355,14 +402,16 @@ class ARGARCHModel(NamedTuple):
 
 
 @_metrics.instrument_fit("argarch")
-def fit_ar_garch(ts: jnp.ndarray) -> ARGARCHModel:
+def fit_ar_garch(ts: jnp.ndarray,
+                 retry: Optional[_resilience.RetryPolicy] = None
+                 ) -> ARGARCHModel:
     """Two-stage AR(1)+GARCH(1,1) fit (ref ``GARCH.scala:63-69``): AR(1) by
     OLS, then GARCH(1,1) on the residuals.  Batched over leading dims."""
     ts = jnp.asarray(ts)
     # stage fits are machinery of THIS fit: record only the argarch bundle
     ar = autoregression.fit.__wrapped__(ts, 1)
     residuals = ar.remove_time_dependent_effects(ts)
-    g = fit.__wrapped__(residuals)
+    g = fit.__wrapped__(residuals, retry=retry)
     return ARGARCHModel(ar.c, jnp.asarray(ar.coefficients)[..., 0],
                         g.omega, g.alpha, g.beta,
                         diagnostics=g.diagnostics)
@@ -371,6 +420,57 @@ def fit_ar_garch(ts: jnp.ndarray) -> ARGARCHModel:
 @_metrics.instrument_fit("argarch", record=False)
 def fit_ar_garch_panel(panel) -> ARGARCHModel:
     return fit_ar_garch(panel.values)
+
+
+def _const_variance_ar_model(v: jnp.ndarray) -> ARGARCHModel:
+    """Terminal AR(1)+GARCH fallback: AR(1) by OLS with constant residual
+    variance (α = β = 0).  Ragged lanes fit on their valid window like the
+    primary fits (``ops.ragged`` left-alignment + weighted moments), and a
+    lane whose AR solve is degenerate (e.g. a constant series, whose lag
+    regressor is collinear with the intercept) demotes per-lane to the
+    mean model (φ = 0) instead of failing the stage."""
+    aligned, nv = ragged_view(v)
+    if nv is None:
+        w = jnp.ones(aligned.shape, v.dtype)
+        n_val = jnp.full(aligned.shape[:-1], aligned.shape[-1], v.dtype)
+    else:
+        w = step_weights(aligned.shape[-1], jnp.asarray(nv)[..., None],
+                         dtype=v.dtype)
+        n_val = jnp.maximum(jnp.asarray(nv).astype(v.dtype), 1.0)
+    ar = autoregression.fit.__wrapped__(aligned, 1, n_valid=nv)
+    c = jnp.asarray(ar.c)
+    phi = jnp.asarray(ar.coefficients)[..., 0]
+    mean_v = jnp.sum(w * aligned, axis=-1) / n_val
+    ar_ok = jnp.isfinite(c) & jnp.isfinite(phi)
+    c = jnp.where(ar_ok, c, mean_v)
+    phi = jnp.where(ar_ok, phi, 0.0)
+    resid = autoregression.ARModel(c, phi[..., None]) \
+        .remove_time_dependent_effects(aligned)
+    mean_r = jnp.sum(w * resid, axis=-1) / n_val
+    var = jnp.sum(w * (resid - mean_r[..., None]) ** 2, axis=-1) / n_val
+    var = jnp.clip(var, 1e-12, None)
+    zeros = jnp.zeros_like(var)
+    ok = jnp.isfinite(var) & jnp.isfinite(phi) & jnp.isfinite(c)
+    return ARGARCHModel(c, phi, var, zeros, zeros,
+                        diagnostics=FitDiagnostics(
+                            ok, jnp.zeros(ok.shape, jnp.int32),
+                            jnp.where(ok, var, jnp.nan)))
+
+
+@_metrics.instrument_fit("argarch", record=False,
+                         name="argarch.fit_resilient")
+def fit_ar_garch_resilient(ts: jnp.ndarray,
+                           retry: Optional[_resilience.RetryPolicy] = None):
+    """Fail-soft batched AR(1)+GARCH(1,1): two-stage fit (with multi-start
+    retry on the GARCH stage) → AR(1) with constant residual variance.
+    ``ts (n_series, n)``; returns ``(model, FitOutcome)``."""
+    if retry is None:
+        retry = _resilience.RetryPolicy()
+    chain = [
+        ("argarch", lambda v: fit_ar_garch.__wrapped__(v, retry=retry)),
+        ("ar_const", _const_variance_ar_model),
+    ]
+    return _resilience.resilient_fit(ts, chain, min_len=3, family="argarch")
 
 
 _EGARCH_KAPPA = 0.7978845608028654     # E|z| = sqrt(2/pi) for Gaussian z
@@ -499,7 +599,9 @@ def _eg_constrain(params):
 @_metrics.instrument_fit("egarch")
 def fit_egarch(ts: jnp.ndarray, init=(0.2, 0.9, 0.0),
                tol: Optional[float] = None, max_iter: Optional[int] = None,
-               method: str = "newton") -> EGARCHModel:
+               method: str = "newton",
+               retry: Optional[_resilience.RetryPolicy] = None
+               ) -> EGARCHModel:
     """Fit EGARCH(1,1) by maximum likelihood, batched over leading dims.
 
     ``init = (alpha0, beta0, gamma0)``; ``omega0`` is implied by matching
@@ -534,13 +636,18 @@ def fit_egarch(ts: jnp.ndarray, init=(0.2, 0.9, 0.0),
     w0 = (1.0 - b0) * logvar
     x0 = jnp.stack(jnp.broadcast_arrays(
         w0, a0, jnp.arctanh(b0), g0), axis=-1).astype(ts.dtype)
+    rk = _resilience.retry_kwargs(retry)
+    if max_iter is None and retry is not None:
+        max_iter = retry.max_iter
     if method == "newton":
         res = minimize_newton(neg_ll, x0, ts, tol=tol,
-                              max_iter=200 if max_iter is None else max_iter)
+                              max_iter=200 if max_iter is None else max_iter,
+                              **rk)
     elif method == "descent":
         res = minimize_box(neg_ll, x0, -jnp.inf, jnp.inf, ts,
                            tol=1e-12 if tol is None else tol,
-                           max_iter=1000 if max_iter is None else max_iter)
+                           max_iter=1000 if max_iter is None else max_iter,
+                           **rk)
     else:
         raise ValueError(f"unknown method {method!r}")
     ok = jnp.all(jnp.isfinite(res.x), axis=-1, keepdims=True)
@@ -553,3 +660,35 @@ def fit_egarch(ts: jnp.ndarray, init=(0.2, 0.9, 0.0),
 def fit_egarch_panel(panel) -> EGARCHModel:
     """Batched EGARCH fit over a Panel."""
     return fit_egarch(panel.values)
+
+
+def _const_log_variance_model(v: jnp.ndarray) -> EGARCHModel:
+    """Terminal EGARCH fallback: constant log variance matched to the
+    sample variance (α = β = γ = 0); NaN padding on ragged lanes is
+    ignored."""
+    var = jnp.clip(jnp.nanvar(v, axis=-1), 1e-12, None)
+    w = jnp.log(var)
+    zeros = jnp.zeros_like(w)
+    m = EGARCHModel(w, zeros, zeros, zeros)
+    neg_ll = _const_gaussian_neg_ll(v, var)
+    return m._replace(diagnostics=FitDiagnostics(
+        jnp.isfinite(neg_ll), jnp.zeros(neg_ll.shape, jnp.int32), neg_ll))
+
+
+@_metrics.instrument_fit("egarch", record=False, name="egarch.fit_resilient")
+def fit_egarch_resilient(ts: jnp.ndarray,
+                         retry: Optional[_resilience.RetryPolicy] = None,
+                         **kwargs):
+    """Fail-soft batched EGARCH(1,1): Newton (with multi-start retry) →
+    Armijo descent → constant-log-variance model.  ``ts (n_series, n)``;
+    returns ``(model, FitOutcome)``."""
+    if retry is None:
+        retry = _resilience.RetryPolicy()
+    chain = [
+        ("newton", lambda v: fit_egarch.__wrapped__(v, retry=retry,
+                                                    **kwargs)),
+        ("descent", lambda v: fit_egarch.__wrapped__(
+            v, **_resilience.override_kwargs(kwargs, method="descent"))),
+        ("const", _const_log_variance_model),
+    ]
+    return _resilience.resilient_fit(ts, chain, min_len=3, family="egarch")

@@ -27,6 +27,7 @@ from ..ops.optimize import MinimizeResult, minimize_box
 from ..ops.ragged import (apply_short_quarantine, ragged_view, short_lanes,
                           step_weights)
 from ..utils import metrics as _metrics
+from ..utils import resilience as _resilience
 from .base import (FitDiagnostics, diagnostics_from, normal_quantile,
                    on_accelerator,
                    scan_unroll)
@@ -347,7 +348,8 @@ def _hw_sse_value_and_grad(params: jnp.ndarray, series: jnp.ndarray,
 @_metrics.instrument_fit("holt_winters")
 def fit(ts: jnp.ndarray, period: int, model_type: str = "additive",
         init=(0.3, 0.1, 0.1), tol: float = 1e-10,
-        max_iter: int = 1000) -> HoltWintersModel:
+        max_iter: Optional[int] = None,
+        retry: Optional[_resilience.RetryPolicy] = None) -> HoltWintersModel:
     """Fit (alpha, beta, gamma) by minimizing SSE over [0, 1]³
     (ref ``HoltWinters.scala:58-83``; same R-style (0.3, 0.1, 0.1) start;
     bounded BOBYQA → batched projected gradient).
@@ -398,8 +400,14 @@ def fit(ts: jnp.ndarray, period: int, model_type: str = "additive",
     # both ways, so it is archived with its revival recipe in
     # docs/experiments/hw_pallas.py and the measured XLA box fit is the
     # one shipped path.
+    rk = _resilience.retry_kwargs(retry)
+    # explicit max_iter wins over the policy's per-attempt budget (the
+    # arima/garch precedence); 1000 is the historical default
+    if max_iter is None:
+        max_iter = retry.max_iter if retry is not None \
+            and retry.max_iter is not None else 1000
     res = minimize_box(objective, x0, 0.0, 1.0, ts, *extra, tol=tol,
-                       max_iter=max_iter, value_and_grad_fn=vag)
+                       max_iter=max_iter, value_and_grad_fn=vag, **rk)
     ok = jnp.all(jnp.isfinite(res.x), axis=-1, keepdims=True)
     p = jnp.where(ok, res.x, x0)
     conv = diagnostics_from(res, ok)
@@ -417,3 +425,54 @@ def fit_panel(panel, period: int, model_type: str = "additive",
               **kwargs) -> HoltWintersModel:
     """Batched fit over a Panel — ``rdd.mapValues(HoltWinters.fitModel)``."""
     return fit(panel.values, period, model_type, **kwargs)
+
+
+def _naive_seasonal_model(v: jnp.ndarray, period: int,
+                          model_type: str) -> HoltWintersModel:
+    """Terminal fallback: α = 1, β = γ = 0 — level tracks the last
+    observation, trend and the initial seasonal pattern stay frozen.
+    Ragged lanes evaluate the SSE on their valid window (``ops.ragged``
+    left-alignment + step weights), like the primary fit."""
+    aligned, nv = ragged_view(v)
+    ones = jnp.ones(v.shape[:-1], v.dtype)
+    zeros = jnp.zeros_like(ones)
+    m = HoltWintersModel(model_type, period, ones, zeros, zeros)
+    fitted, _ = m._run(aligned)
+    err = aligned[..., period:] - fitted[..., period:]
+    if nv is None:
+        sse = jnp.sum(err * err, axis=-1)
+        ok = jnp.isfinite(sse)
+    else:
+        w = step_weights(err.shape[-1], jnp.asarray(nv)[..., None],
+                         offset=period, dtype=v.dtype)
+        # zero the tail BEFORE squaring: a multiplicative run over the
+        # zero-padded tail can emit inf, and 0 * inf is NaN
+        err = jnp.where(w > 0, err, 0.0)
+        sse = jnp.sum(err * err, axis=-1)
+        ok = jnp.isfinite(sse) & (jnp.asarray(nv) >= 2 * period + 1)
+    return m._replace(diagnostics=FitDiagnostics(
+        ok, jnp.zeros(sse.shape, jnp.int32), sse))
+
+
+@_metrics.instrument_fit("holt_winters", record=False,
+                         name="holt_winters.fit_resilient")
+def fit_resilient(ts: jnp.ndarray, period: int,
+                  model_type: str = "additive",
+                  retry: Optional[_resilience.RetryPolicy] = None,
+                  **kwargs):
+    """Fail-soft batched Holt-Winters: projected-gradient fit (with
+    multi-start retry) → a mid-box restart ``init=(0.5, 0.3, 0.3)`` →
+    naive ``α = 1`` model.  ``ts (n_series, n)``; returns
+    ``(model, FitOutcome)``."""
+    if retry is None:
+        retry = _resilience.RetryPolicy()
+    chain = [
+        ("box", lambda v: fit.__wrapped__(v, period, model_type,
+                                          retry=retry, **kwargs)),
+        ("box_midstart", lambda v: fit.__wrapped__(
+            v, period, model_type,
+            **_resilience.override_kwargs(kwargs, init=(0.5, 0.3, 0.3)))),
+        ("naive", lambda v: _naive_seasonal_model(v, period, model_type)),
+    ]
+    return _resilience.resilient_fit(ts, chain, min_len=2 * period + 1,
+                                     family="holt_winters")

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from ..ops.linalg import ols
 from ..stats import dwtest
 from ..utils import metrics as _metrics
+from ..utils import resilience as _resilience
 from .base import FitDiagnostics, normal_quantile
 
 DW_MARGIN = 0.05
@@ -236,3 +237,39 @@ def fit_panel(panel, regressors, max_iter: int = 10) -> RegressionARIMAModel:
     """Batched Cochrane-Orcutt over a Panel against a shared regressor
     design."""
     return fit_cochrane_orcutt(panel.values, regressors, max_iter)
+
+
+def _plain_ols_model(v: jnp.ndarray, X: jnp.ndarray) -> RegressionARIMAModel:
+    """Terminal fallback: the plain OLS regression with ρ = 0 (no error
+    autocorrelation modeled) — always defined where the design is."""
+    Xb = _broadcast_design(v, X)
+    res = ols(Xb, v, add_intercept=True)
+    ok = jnp.all(jnp.isfinite(res.beta), axis=-1)
+    diag = FitDiagnostics(ok, jnp.zeros(ok.shape, jnp.int32),
+                          jnp.sum(res.residuals * res.residuals, axis=-1))
+    return RegressionARIMAModel(res.beta, (1, 0, 0),
+                                jnp.zeros(v.shape[:-1], v.dtype),
+                                diagnostics=diag)
+
+
+@_metrics.instrument_fit("regression_arima", record=False,
+                         name="regression_arima.fit_resilient")
+def fit_resilient(ts: jnp.ndarray, regressors: jnp.ndarray,
+                  max_iter: int = 10, retry=None):
+    """Fail-soft batched Cochrane-Orcutt: the iterative fit → plain OLS
+    with ρ = 0 for lanes whose iteration never settled.  ``ts
+    (n_series, n)``; ``regressors`` must be a shared unbatched ``(n, k)``
+    design.  Returns ``(model, FitOutcome)``."""
+    del retry       # the CO iteration has its own per-lane stopping rules
+    X = jnp.asarray(regressors)
+    if X.ndim != 2:
+        raise ValueError(
+            "fit_resilient needs a shared unbatched (n, k) design; got "
+            f"regressors shape {X.shape}")
+    chain = [
+        ("cochrane_orcutt",
+         lambda v: fit_cochrane_orcutt.__wrapped__(v, X, max_iter)),
+        ("ols", lambda v: _plain_ols_model(v, X)),
+    ]
+    return _resilience.resilient_fit(ts, chain, min_len=X.shape[-1] + 3,
+                                     family="regression_arima")

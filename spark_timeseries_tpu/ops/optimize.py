@@ -27,7 +27,8 @@ Two solvers cover the reference's needs:
 
 from __future__ import annotations
 
-from typing import Callable, NamedTuple
+import math
+from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -38,20 +39,151 @@ from .linalg import spd_solve
 
 
 class MinimizeResult(NamedTuple):
-    """Batched optimization artifacts (leading dims ``...`` = batch)."""
+    """Batched optimization artifacts (leading dims ``...`` = batch).
+
+    ``attempts`` is populated only by the multi-start retry path
+    (``restarts > 0`` or an active fault injection): the number of solve
+    attempts each lane actually ran.  None on the plain single-start path.
+    """
     x: jnp.ndarray          # (..., p) optimal parameters
     fun: jnp.ndarray        # (...,)   objective at optimum
     converged: jnp.ndarray  # (...,)   bool per-lane convergence mask
     n_iter: jnp.ndarray     # (...,)   iterations taken
+    attempts: Optional[jnp.ndarray] = None  # (...,) multi-start solves run
+
+
+# ---------------------------------------------------------------------------
+# multi-start retry: re-solve non-converged / non-finite lanes from jittered
+# inits INSIDE the batched computation (a lax.while over restarts — no host
+# round-trips), instead of silently handing back NaN or cap-hit parameters
+# ---------------------------------------------------------------------------
+
+def _forced_failures() -> int:
+    """Attempt count an active ``force_nonconverge`` fault injection makes
+    the solvers report as non-converged (0 normally).  Read at call/trace
+    time; ``utils.resilience.fault_injection`` clears jit caches around its
+    scope so cached kernels never leak across regimes."""
+    from ..utils import resilience as _resilience
+    return _resilience.forced_optimizer_failures()
+
+
+class _RestartState(NamedTuple):
+    x: jnp.ndarray
+    fun: jnp.ndarray
+    converged: jnp.ndarray
+    n_iter: jnp.ndarray
+    attempt: jnp.ndarray
+
+
+def _with_restarts(solve_one: Callable, restarts: int, scale: float,
+                   fail_first: int) -> Callable:
+    """Wrap a single-lane solver in a multi-start loop (designed, like the
+    solvers themselves, to be vmapped).
+
+    Attempt 0 runs from the caller's init; each further attempt re-solves
+    from ``x0 + scale * (1 + |x0|) * N(0, 1)`` drawn from the lane's PRNG
+    key folded with the attempt index.  The loop exits the moment an
+    attempt converges with finite objective and parameters; otherwise the
+    best finite-objective attempt is kept (falling back to ``x0`` when
+    every attempt went non-finite — the quarantine-to-init policy the
+    model fits already apply per lane).  Under ``vmap`` converged lanes
+    hold position while the rest retry — every lane pays the slowest
+    lane's attempts, the same trade as the convergence-masked iteration
+    loops (SURVEY.md §7).
+
+    ``fail_first`` (static, from fault injection) forces attempts
+    ``< fail_first`` to report non-convergence — deterministic synthetic
+    divergence for testing the retry and fallback machinery.
+    """
+    total = restarts + 1
+
+    def wrapped(x0_i, key_i, *args_i):
+        def one_attempt(att):
+            jitter = jax.random.normal(jax.random.fold_in(key_i, att),
+                                       x0_i.shape, x0_i.dtype) \
+                * (scale * (1.0 + jnp.abs(x0_i)))
+            x_start = jnp.where(att == 0, x0_i, x0_i + jitter)
+            r = solve_one(x_start, *args_i)
+            conv = r.converged
+            if fail_first:
+                conv = jnp.logical_and(conv, att >= fail_first)
+            return r, conv
+
+        r0, conv0 = one_attempt(jnp.asarray(0))
+        fin0 = jnp.isfinite(r0.fun) & jnp.all(jnp.isfinite(r0.x))
+        ok0 = conv0 & fin0
+        state0 = _RestartState(
+            jnp.where(fin0, r0.x, x0_i),
+            jnp.where(fin0, r0.fun, jnp.asarray(jnp.inf, r0.fun.dtype)),
+            ok0, r0.n_iter, jnp.asarray(1))
+
+        def cond(s):
+            return jnp.logical_and(~s.converged, s.attempt < total)
+
+        def body(s):
+            r, conv = one_attempt(s.attempt)
+            fin = jnp.isfinite(r.fun) & jnp.all(jnp.isfinite(r.x))
+            ok = conv & fin
+            # frozen once converged (vmap runs every lane to the slowest
+            # lane's exit); otherwise keep the best finite attempt so far
+            better = (ok | (fin & (r.fun < s.fun))) & ~s.converged
+            return _RestartState(
+                jnp.where(better, r.x, s.x),
+                jnp.where(better, r.fun, s.fun),
+                s.converged | ok,
+                jnp.where(better, r.n_iter, s.n_iter),
+                s.attempt + (~s.converged).astype(s.attempt.dtype))
+
+        final = lax.while_loop(cond, body, state0)
+        return MinimizeResult(final.x, final.fun, final.converged,
+                              final.n_iter, final.attempt)
+
+    return wrapped
+
+
+def _lane_keys(restart_key, batch_shape):
+    """One PRNG key per lane (threaded through the vmap alongside x0)."""
+    key = restart_key if restart_key is not None else jax.random.PRNGKey(0)
+    if not batch_shape:
+        return key
+    keys = jax.random.split(key, math.prod(batch_shape))
+    return keys.reshape(*batch_shape, *keys.shape[1:])
+
+
+def _solve_with_policy(solve_one: Callable, x0: jnp.ndarray, args,
+                       restarts: int, restart_scale: float, restart_key):
+    """Shared driver: vmap ``solve_one`` over the batch dims, inserting the
+    multi-start wrapper when a retry budget or an injected fault is active.
+    ``restarts == 0`` with no fault takes the original path bit-for-bit."""
+    batch_dims = x0.ndim - 1
+    fail_first = _forced_failures()
+    if restarts or fail_first:
+        solve = _with_restarts(solve_one, restarts, restart_scale,
+                               fail_first)
+        keys = _lane_keys(restart_key, x0.shape[:-1])
+        for _ in range(batch_dims):
+            solve = jax.vmap(solve)
+        return solve(x0, keys, *args)
+    solve = solve_one
+    for _ in range(batch_dims):
+        solve = jax.vmap(solve)
+    return solve(x0, *args)
 
 
 def minimize_bfgs(fn: Callable, x0: jnp.ndarray, *args,
-                  tol: float = 1e-8, max_iter: int = 200) -> MinimizeResult:
+                  tol: float = 1e-8, max_iter: int = 200,
+                  restarts: int = 0, restart_scale: float = 0.25,
+                  restart_key=None) -> MinimizeResult:
     """Batched BFGS for smooth unconstrained objectives.
 
     ``fn(params, *args) -> scalar`` where ``params`` is ``(p,)``; ``x0`` may
     carry leading batch dims, in which case ``args`` entries must carry the
     same leading dims and the solve is vmapped over them.
+
+    ``restarts > 0`` enables the multi-start retry path (see
+    :func:`_with_restarts`): non-converged / non-finite lanes re-solve up
+    to ``restarts`` more times from inits jittered by ``restart_scale``
+    under per-lane keys split from ``restart_key``.
     """
     from jax.scipy.optimize import minimize as _jsp_minimize
 
@@ -60,13 +192,11 @@ def minimize_bfgs(fn: Callable, x0: jnp.ndarray, *args,
                             tol=tol, options={"maxiter": max_iter})
         return MinimizeResult(res.x, res.fun, res.success, res.nit)
 
-    batch_dims = x0.ndim - 1
-    for _ in range(batch_dims):
-        solve_one = jax.vmap(solve_one)
     with _metrics.span("optimize.bfgs"):
         # the recorder's host reads block on the device work; keeping
         # them inside the span attributes that wall-time to the solver
-        res = solve_one(x0, *args)
+        res = _solve_with_policy(solve_one, x0, args, restarts,
+                                 restart_scale, restart_key)
         return _metrics.observe_minimize("bfgs", res)
 
 
@@ -149,8 +279,9 @@ def _minimize_lm_one(residual_fn, x0, tol, max_iter, lam0=1e-3,
 def minimize_least_squares(residual_fn: Callable | None, x0: jnp.ndarray,
                            *args, tol: float | None = None,
                            max_iter: int = 100,
-                           normal_eqs_fn: Callable | None = None
-                           ) -> MinimizeResult:
+                           normal_eqs_fn: Callable | None = None,
+                           restarts: int = 0, restart_scale: float = 0.25,
+                           restart_key=None) -> MinimizeResult:
     """Batched Levenberg-Marquardt for residual objectives (minimizes
     ``sum(residual_fn(x)**2)``).
 
@@ -166,6 +297,11 @@ def minimize_least_squares(residual_fn: Callable | None, x0: jnp.ndarray,
     ``normal_eqs_fn(params, *args) -> (JᵀJ, Jᵀr, sse)``, when given,
     replaces the autodiff Jacobian pass with a hand-fused one (see
     ``_minimize_lm_one``); ``residual_fn`` is then unused and may be None.
+
+    ``restarts`` / ``restart_scale`` / ``restart_key`` enable the
+    multi-start retry path for non-converged or non-finite lanes (see
+    :func:`_with_restarts`); ``restarts=0`` (default) is the plain
+    single-start solve, bit-for-bit.
     """
     if tol is None:
         tol = 1e-10 if x0.dtype == jnp.float64 else 1e-6
@@ -178,13 +314,11 @@ def minimize_least_squares(residual_fn: Callable | None, x0: jnp.ndarray,
             if residual_fn is not None else None,
             x0_i, tol, max_iter, normal_eqs_fn=ne)
 
-    batch_dims = x0.ndim - 1
-    for _ in range(batch_dims):
-        solve_one = jax.vmap(solve_one)
     with _metrics.span("optimize.lm"):
         # the recorder's host reads block on the device work; keeping
         # them inside the span attributes that wall-time to the solver
-        res = solve_one(x0, *args)
+        res = _solve_with_policy(solve_one, x0, args, restarts,
+                                 restart_scale, restart_key)
         return _metrics.observe_minimize("lm", res)
 
 
@@ -254,13 +388,16 @@ def _minimize_newton_one(fn, x0, tol, max_iter, lam0=1e-3,
 
 def minimize_newton(fn: Callable, x0: jnp.ndarray, *args,
                     tol: float | None = None,
-                    max_iter: int = 100) -> MinimizeResult:
+                    max_iter: int = 100,
+                    restarts: int = 0, restart_scale: float = 0.25,
+                    restart_key=None) -> MinimizeResult:
     """Batched damped Newton for smooth scalar objectives with *small*
     parameter counts (p ≤ ~16, where the unrolled Cholesky solve applies).
 
     ``fn(params, *args) -> scalar``; ``x0 (..., p)`` with leading batch dims
     vmapped (matching ``args`` dims).  ``tol`` defaults dtype-aware like
-    :func:`minimize_least_squares`.
+    :func:`minimize_least_squares`.  ``restarts`` enables the multi-start
+    retry path (see :func:`_with_restarts`).
     """
     if tol is None:
         tol = 1e-10 if x0.dtype == jnp.float64 else 1e-6
@@ -269,13 +406,11 @@ def minimize_newton(fn: Callable, x0: jnp.ndarray, *args,
         return _minimize_newton_one(lambda x: fn(x, *args_i), x0_i,
                                     tol, max_iter)
 
-    batch_dims = x0.ndim - 1
-    for _ in range(batch_dims):
-        solve_one = jax.vmap(solve_one)
     with _metrics.span("optimize.newton"):
         # the recorder's host reads block on the device work; keeping
         # them inside the span attributes that wall-time to the solver
-        res = solve_one(x0, *args)
+        res = _solve_with_policy(solve_one, x0, args, restarts,
+                                 restart_scale, restart_key)
         return _metrics.observe_minimize("newton", res)
 
 
@@ -357,13 +492,18 @@ def _minimize_box_one(fn, x0, lower, upper, tol=1e-10, max_iter=500,
 
 def minimize_box(fn: Callable, x0: jnp.ndarray, lower, upper, *args,
                  tol: float = 1e-10, max_iter: int = 500,
-                 value_and_grad_fn: Callable | None = None) -> MinimizeResult:
+                 value_and_grad_fn: Callable | None = None,
+                 restarts: int = 0, restart_scale: float = 0.25,
+                 restart_key=None) -> MinimizeResult:
     """Batched box-constrained minimization (the BOBYQA replacement).
 
     ``fn(params, *args) -> scalar``; ``x0 (..., p)``; ``lower``/``upper``
     broadcastable to ``(p,)``.  Leading dims of ``x0`` (and of each ``args``
     entry) are vmapped.  ``value_and_grad_fn(params, *args) -> (f, g)``
     optionally replaces reverse-mode autodiff (see ``_minimize_box_one``).
+    ``restarts`` enables the multi-start retry path (see
+    :func:`_with_restarts`; jittered inits are re-projected into the box
+    by the solver's own initial projection).
     """
     lower = jnp.broadcast_to(jnp.asarray(lower, x0.dtype), x0.shape[-1:])
     upper = jnp.broadcast_to(jnp.asarray(upper, x0.dtype), x0.shape[-1:])
@@ -375,11 +515,9 @@ def minimize_box(fn: Callable, x0: jnp.ndarray, lower, upper, *args,
                                  tol=tol, max_iter=max_iter,
                                  value_and_grad_fn=vag)
 
-    batch_dims = x0.ndim - 1
-    for _ in range(batch_dims):
-        solve_one = jax.vmap(solve_one)
     with _metrics.span("optimize.box"):
         # the recorder's host reads block on the device work; keeping
         # them inside the span attributes that wall-time to the solver
-        res = solve_one(x0, *args)
+        res = _solve_with_policy(solve_one, x0, args, restarts,
+                                 restart_scale, restart_key)
         return _metrics.observe_minimize("box", res)

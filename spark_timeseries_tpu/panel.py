@@ -363,6 +363,46 @@ class Panel:
 
     # -- summary stats (ref TimeSeriesRDD.scala:265-267 seriesStats) ----------
 
+    def fit_resilient(self, family: str, *args, **kwargs):
+        """Fail-soft batched fit over the panel: per-series health masking,
+        multi-start retry, and a declarative fallback chain — one pathological
+        series (all-NaN, constant, too short, divergence-inducing) degrades
+        its own lane's status instead of poisoning the batch or raising.
+
+        ``family`` selects the model tier: ``"arima"`` (args: p, d, q),
+        ``"arimax"`` (args: xreg, p, d, q, xreg_max_lag), ``"ar"`` (args:
+        max_lag), ``"arx"`` (args: x, y_max_lag, x_max_lag), ``"ewma"``,
+        ``"garch"``, ``"argarch"``, ``"egarch"``, ``"holt_winters"`` (args:
+        period), ``"regression_arima"`` (args: regressors).  Extra args and
+        kwargs (including ``retry=RetryPolicy(...)`` and ``fallbacks=...``
+        where supported) pass through to the family's ``fit_resilient``.
+
+        Returns ``(model, outcome)`` where ``outcome`` is a
+        :class:`~spark_timeseries_tpu.utils.resilience.FitOutcome` with
+        per-series status / health / attempts / fallback indices; healthy
+        series match the family's plain ``fit`` bit-for-bit, and
+        ``resilience.*`` counters land in the metrics registry (surfaced in
+        bench JSON).
+        """
+        from . import models
+        dispatch = {
+            "arima": models.arima.fit_resilient,
+            "arimax": models.arimax.fit_resilient,
+            "ar": models.autoregression.fit_resilient,
+            "arx": models.autoregression_x.fit_resilient,
+            "ewma": models.ewma.fit_resilient,
+            "garch": models.garch.fit_resilient,
+            "argarch": models.garch.fit_ar_garch_resilient,
+            "egarch": models.garch.fit_egarch_resilient,
+            "holt_winters": models.holt_winters.fit_resilient,
+            "regression_arima": models.regression_arima.fit_resilient,
+        }
+        if family not in dispatch:
+            raise ValueError(f"unknown model family {family!r}; expected "
+                             f"one of {sorted(dispatch)}")
+        with _metrics.span("panel.fit_resilient"):
+            return dispatch[family](self.values, *args, **kwargs)
+
     def series_stats(self) -> dict:
         """Per-series count/mean/stdev/min/max, NaN-aware — the StatCounter
         equivalent.  Returns a dict of ``(n_series,)`` numpy arrays."""

@@ -9,9 +9,9 @@ counters/gauges/histograms, nested spans, XLA recompile tracking —
 (``metrics``) that ``bench.py`` embeds into every benchmark artifact.
 """
 
-from . import checkpoint, metrics, observability  # noqa: F401
+from . import checkpoint, metrics, observability, resilience  # noqa: F401
 
-__all__ = ["checkpoint", "metrics", "observability", "plot"]
+__all__ = ["checkpoint", "metrics", "observability", "plot", "resilience"]
 
 
 def __getattr__(name):

@@ -13,6 +13,13 @@ Supported node types: numpy/JAX arrays, Python scalars (int/float/bool/str/
 None), lists, tuples, dicts with string keys, and NamedTuples (recorded by
 import path and re-imported on load — which covers every model class in
 ``spark_timeseries_tpu.models``).
+
+Restore validates every array leaf against the shape/dtype the structure
+sidecar recorded at save time and raises :class:`CheckpointMismatchError`
+(a ``ValueError``) on any disagreement — a truncated ``.npz`` or a sidecar
+paired with the wrong array file surfaces as one clear error instead of a
+cryptic reshape failure mid-fit.  Sidecars written before the metadata was
+recorded restore unvalidated, as before.
 """
 
 from __future__ import annotations
@@ -26,19 +33,31 @@ import jax
 import numpy as np
 
 
+class CheckpointMismatchError(ValueError):
+    """A checkpoint's stored arrays disagree with its structure sidecar
+    (shape, dtype, or leaf count) — corruption or a stale re-save.  Raised
+    eagerly on restore so the mismatch surfaces as one clear error instead
+    of a cryptic reshape/broadcast failure mid-fit."""
+
+
 def _is_namedtuple(node: Any) -> bool:
     return isinstance(node, tuple) and hasattr(node, "_fields")
 
 
+def _arr_spec(arrays: list, a: np.ndarray) -> dict:
+    arrays.append(a)
+    return {"k": "arr", "i": len(arrays) - 1,
+            "shape": list(a.shape), "dtype": str(a.dtype)}
+
+
 def _encode(node: Any, arrays: list) -> Any:
     """Recursively encode a pytree into a JSON-able structure spec; array
-    leaves are appended to ``arrays`` and referenced by position."""
+    leaves are appended to ``arrays`` and referenced by position, with
+    shape/dtype recorded for restore-time validation."""
     if isinstance(node, (np.ndarray, jax.Array)):
-        arrays.append(np.asarray(node))
-        return {"k": "arr", "i": len(arrays) - 1}
+        return _arr_spec(arrays, np.asarray(node))
     if isinstance(node, np.generic):            # numpy scalar -> 0-d array
-        arrays.append(np.asarray(node))
-        return {"k": "arr", "i": len(arrays) - 1}
+        return _arr_spec(arrays, np.asarray(node))
     if node is None or isinstance(node, (bool, int, float, str)):
         return {"k": "py", "v": node}
     if _is_namedtuple(node):
@@ -60,7 +79,28 @@ def _encode(node: Any, arrays: list) -> Any:
 def _decode(spec: Any, arrays: dict) -> Any:
     kind = spec["k"]
     if kind == "arr":
-        return arrays[f"leaf_{spec['i']}"]
+        name = f"leaf_{spec['i']}"
+        if name not in arrays:
+            raise CheckpointMismatchError(
+                f"checkpoint structure references {name} but the .npz holds "
+                f"only {len(arrays)} leaves — the sidecar and array file "
+                f"are out of sync (re-save the checkpoint)")
+        arr = arrays[name]
+        # shape/dtype were recorded at save time (format >= 2 with metadata);
+        # older sidecars without them restore unvalidated as before
+        want_shape = spec.get("shape")
+        if want_shape is not None and list(arr.shape) != list(want_shape):
+            raise CheckpointMismatchError(
+                f"checkpoint leaf {name} has shape {tuple(arr.shape)} but "
+                f"the structure sidecar recorded {tuple(want_shape)} — the "
+                f".npz does not belong to this .tree.json")
+        want_dtype = spec.get("dtype")
+        if want_dtype is not None and str(arr.dtype) != want_dtype:
+            raise CheckpointMismatchError(
+                f"checkpoint leaf {name} has dtype {arr.dtype} but the "
+                f"structure sidecar recorded {want_dtype} — the .npz does "
+                f"not belong to this .tree.json")
+        return arr
     if kind == "py":
         return spec["v"]
     if kind == "nt":
@@ -106,6 +146,12 @@ def load_pytree(path: str) -> Any:
             "re-save it, or read the leaves directly with load_leaves()")
     with np.load(path + ".npz") as data:
         arrays = {name: data[name] for name in data.files}
+    n_expected = meta.get("n_leaves")
+    if n_expected is not None and len(arrays) != n_expected:
+        raise CheckpointMismatchError(
+            f"checkpoint {path!r} holds {len(arrays)} array leaves but its "
+            f"structure sidecar recorded {n_expected} — the .npz and "
+            f".tree.json are out of sync")
     return _decode(meta["spec"], arrays)
 
 
@@ -133,6 +179,6 @@ def load_model(path: str, model_cls: type | None = None) -> Any:
         with open(meta_path) as f:
             recorded = json.load(f).get("class")
         if recorded != model_cls.__name__:
-            raise ValueError(
+            raise CheckpointMismatchError(
                 f"checkpoint holds a {recorded}, not a {model_cls.__name__}")
     return load_pytree(path)

@@ -1,0 +1,592 @@
+"""Per-series failure isolation for batched fits: health classification,
+retry policies, fallback chains, and a fault-injection harness.
+
+The reference isolates failures per series for free — each ``mapValues``
+closure fits one series, and a throw kills one task (ref
+``/root/reference/src/main/scala/com/cloudera/sparkts/models/ARIMA.scala:315-319``
+wraps candidate fits in ``Try``).  A batched TPU fit has no such boundary:
+one all-NaN, constant, too-short, or divergence-inducing lane shares the
+compiled program with a million healthy ones, so isolation must be built
+from masks and explicit per-lane status instead of exceptions (SURVEY.md §7
+hard part #3; PAPERS.md "Distributed ARIMA Models for Ultra-long Time
+Series" and "ARIMA_PLUS" both treat per-series fallback as a prerequisite
+for production-scale forecasting).
+
+Three layers, composable and individually usable:
+
+- **health classification** (:func:`classify_series`) — one vectorized pass
+  labels every lane ok / all-NaN / constant / too-short / has-inf /
+  interior-gap before any optimizer runs; unfittable lanes are *skipped
+  with a status*, never raised on;
+- **multi-start retry** (:class:`RetryPolicy`, consumed by the
+  ``ops.optimize`` minimizers) — non-converged or non-finite lanes re-solve
+  from jittered inits inside the batched computation (a ``lax.while`` over
+  restarts with per-lane threaded PRNG keys; no host round-trips), and the
+  per-lane attempt count comes back in ``MinimizeResult.attempts``;
+- **fallback chains** (:func:`resilient_fit`, surfaced per model family as
+  ``fit_resilient`` and on :class:`~spark_timeseries_tpu.panel.Panel`) — a
+  declarative list of progressively simpler fits (e.g. ARIMA(p,d,q) →
+  AR(p) → drift/mean) applied only to still-failed lanes, gather/scatter
+  compacted so cost scales with the failed fraction, not the panel.
+
+Every disposition is counted into the PR-1 metrics registry under
+``resilience.*`` so bench artifacts record fraction-recovered,
+fraction-fallback, and fraction-abandoned alongside throughput.
+
+The :func:`fault_injection` context manager deterministically corrupts
+inputs or forces optimizer non-convergence so all of the above is testable
+without hunting for naturally pathological data; ``STS_FAULT_INJECT=1``
+(the ``make verify-faults`` CI mode) activates a default
+first-attempt-fails fault inside every ``resilient_fit`` call, driving the
+retry path on every resilient fit while leaving plain fits untouched.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import metrics as _metrics
+
+__all__ = [
+    "HEALTH_OK", "HEALTH_ALL_NAN", "HEALTH_CONSTANT", "HEALTH_TOO_SHORT",
+    "HEALTH_HAS_INF", "HEALTH_INTERIOR_GAP", "HEALTH_NAMES",
+    "STATUS_OK", "STATUS_RETRIED", "STATUS_FALLBACK", "STATUS_SKIPPED",
+    "STATUS_ABANDONED", "STATUS_NAMES",
+    "classify_series", "unfittable_mask",
+    "FitOutcome", "RetryPolicy", "retry_kwargs",
+    "FaultSpec", "fault_injection", "fault_spec",
+    "forced_optimizer_failures", "corrupt_values",
+    "resilient_fit",
+]
+
+# ---------------------------------------------------------------------------
+# health classification
+# ---------------------------------------------------------------------------
+
+HEALTH_OK = 0            # contiguous finite window, long enough, non-constant
+HEALTH_ALL_NAN = 1       # no finite observation at all
+HEALTH_CONSTANT = 2      # finite but a single repeated value (fittable by a
+#                          mean/drift fallback; degenerate for most solvers)
+HEALTH_TOO_SHORT = 3     # valid window shorter than the fit's requirement
+HEALTH_HAS_INF = 4       # an infinity anywhere — bad data, never padding
+HEALTH_INTERIOR_GAP = 5  # NaN strictly inside the observed window
+
+HEALTH_NAMES = {
+    HEALTH_OK: "ok", HEALTH_ALL_NAN: "all_nan",
+    HEALTH_CONSTANT: "constant", HEALTH_TOO_SHORT: "too_short",
+    HEALTH_HAS_INF: "has_inf", HEALTH_INTERIOR_GAP: "interior_gap",
+}
+
+# health codes that no fit stage can do anything with: skipped up front.
+# CONSTANT is *not* here — a constant lane legitimately fits a mean/drift
+# fallback, so it goes through the chain like any hard lane.
+_UNFITTABLE = (HEALTH_ALL_NAN, HEALTH_TOO_SHORT, HEALTH_HAS_INF,
+               HEALTH_INTERIOR_GAP)
+
+
+def classify_series(values: jnp.ndarray, min_len: int = 3) -> jnp.ndarray:
+    """Per-lane health codes, fully vectorized: ``values (..., n)`` →
+    int32 ``(...)``.
+
+    The valid window is the span from the first to the last finite
+    observation (leading/trailing NaN is padding, the ``ops.ragged``
+    convention); ``min_len`` is the fit-specific minimum window length.
+    Priority when several conditions hold:
+    all-NaN > has-inf > interior-gap > too-short > constant > ok.
+    """
+    v = jnp.asarray(values)
+    n = v.shape[-1]
+    if n == 0:
+        return jnp.full(v.shape[:-1], HEALTH_TOO_SHORT, jnp.int32)
+    finite = jnp.isfinite(v)
+    nan = jnp.isnan(v)
+    obs = ~nan                                    # inf counts as observed
+    n_obs = jnp.sum(obs, axis=-1)
+    any_obs = n_obs > 0
+    start = jnp.argmax(obs, axis=-1)
+    last = n - 1 - jnp.argmax(obs[..., ::-1], axis=-1)
+    window = jnp.where(any_obs, last - start + 1, 0)
+
+    has_inf = jnp.any(jnp.isinf(v), axis=-1)
+    # constant over the finite entries (big/-big sentinels never tie a real
+    # max/min pair unless the lane is inf-laden, which outranks anyway)
+    vmax = jnp.max(jnp.where(finite, v, -jnp.inf), axis=-1)
+    vmin = jnp.min(jnp.where(finite, v, jnp.inf), axis=-1)
+    constant = any_obs & (vmax == vmin)
+
+    status = jnp.full(v.shape[:-1], HEALTH_OK, jnp.int32)
+    status = jnp.where(constant, HEALTH_CONSTANT, status)
+    status = jnp.where(window < min_len, HEALTH_TOO_SHORT, status)
+    status = jnp.where(n_obs != window, HEALTH_INTERIOR_GAP, status)
+    status = jnp.where(has_inf, HEALTH_HAS_INF, status)
+    status = jnp.where(~any_obs, HEALTH_ALL_NAN, status)
+    return status
+
+
+def unfittable_mask(health: np.ndarray) -> np.ndarray:
+    """Boolean mask of lanes no fit stage can attempt (skipped with an
+    explicit status instead of poisoning the batch)."""
+    return np.isin(np.asarray(health), _UNFITTABLE)
+
+
+# ---------------------------------------------------------------------------
+# outcome / policy structures
+# ---------------------------------------------------------------------------
+
+STATUS_OK = 0          # primary fit converged on the first attempt
+STATUS_RETRIED = 1     # primary fit converged after >= 1 multi-start restart
+STATUS_FALLBACK = 2    # a fallback stage produced the lane's parameters
+STATUS_SKIPPED = 3     # unfittable (see classify_series); params are NaN
+STATUS_ABANDONED = 4   # every stage failed; params are the best-effort
+#                        primary result (quarantined init or cap-hit point)
+
+STATUS_NAMES = {
+    STATUS_OK: "ok", STATUS_RETRIED: "retried",
+    STATUS_FALLBACK: "fallback", STATUS_SKIPPED: "skipped",
+    STATUS_ABANDONED: "abandoned",
+}
+
+
+class FitOutcome(NamedTuple):
+    """Per-series disposition of a resilient batched fit.
+
+    ``params (n_series, k)`` is the final flattened parameter view (every
+    float array leaf of the merged model, trailing dims flattened and
+    concatenated — NaN for skipped lanes); ``status`` / ``health`` are the
+    ``STATUS_*`` / ``HEALTH_*`` codes; ``attempts`` counts optimizer starts
+    plus fallback stages actually run for the lane (0 for skipped);
+    ``fallback_used`` is the index into the fit chain that produced the
+    lane's parameters (-1 = the primary fit, or no stage at all).
+    """
+    params: Optional[np.ndarray]
+    status: np.ndarray
+    attempts: np.ndarray
+    fallback_used: np.ndarray
+    health: np.ndarray
+
+    def counts(self) -> Dict[str, int]:
+        """``{status_name: lane count}`` summary (only nonzero entries)."""
+        s = np.asarray(self.status)
+        return {name: int(np.sum(s == code))
+                for code, name in STATUS_NAMES.items()
+                if int(np.sum(s == code))}
+
+
+class RetryPolicy(NamedTuple):
+    """Multi-start retry knobs threaded from ``fit_resilient`` down to the
+    batched minimizers (``ops.optimize``).
+
+    ``max_restarts`` extra solves from jittered inits for lanes whose first
+    solve did not converge or went non-finite; ``perturb_scale`` scales the
+    Gaussian init jitter (relative: ``scale * (1 + |x0|)``) drawn from
+    per-lane PRNG keys folded from ``seed``; ``max_iter`` overrides the
+    fit's per-attempt iteration budget when set.
+    """
+    max_restarts: int = 2
+    perturb_scale: float = 0.25
+    seed: int = 0
+    max_iter: Optional[int] = None
+
+
+def retry_kwargs(retry: Optional[RetryPolicy]) -> Dict[str, Any]:
+    """The ``restarts``/``restart_scale``/``restart_key`` kwargs a
+    :class:`RetryPolicy` expands to for the ``ops.optimize`` minimizers.
+    Empty when ``retry`` is None OR carries no restart budget — a
+    zero-restart policy (e.g. one used only for its ``max_iter``) must
+    leave the plain single-start path, and its solver routing (the arima
+    css-lm Pallas gate keys off this dict's truthiness), bit-for-bit
+    untouched."""
+    if retry is None or retry.max_restarts <= 0:
+        return {}
+    return {"restarts": int(retry.max_restarts),
+            "restart_scale": float(retry.perturb_scale),
+            "restart_key": jax.random.PRNGKey(int(retry.seed))}
+
+
+def override_kwargs(kwargs: Dict[str, Any], **pinned) -> Dict[str, Any]:
+    """Merge a fallback stage's pinned arguments over user pass-through
+    kwargs (the pin wins — a user's ``method=`` must not collide with a
+    stage that exists precisely to try a different method)."""
+    out = dict(kwargs)
+    out.update(pinned)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+class FaultSpec(NamedTuple):
+    """One active fault.  ``mode``:
+
+    - ``"force_nonconverge"``: every batched minimizer reports its first
+      ``n_attempts`` solve attempts as non-converged (parameters intact) —
+      deterministic optimizer divergence, exercising retry and fallback;
+    - ``"corrupt_nan"``: every ``lane_stride``-th lane of a resilient fit's
+      input panel becomes all-NaN before classification;
+    - ``"corrupt_inf"``: every ``lane_stride``-th lane gets one interior
+      ``inf`` observation.
+    """
+    mode: str
+    n_attempts: int = 1
+    lane_stride: int = 2
+
+
+_VALID_MODES = ("force_nonconverge", "corrupt_nan", "corrupt_inf")
+_active_fault: List[FaultSpec] = []
+
+
+def fault_spec() -> Optional[FaultSpec]:
+    """The innermost active fault, or None."""
+    return _active_fault[-1] if _active_fault else None
+
+
+def forced_optimizer_failures() -> int:
+    """Static attempt count the minimizers must report non-converged (0
+    when no ``force_nonconverge`` fault is active).  Read at call/trace
+    time by ``ops.optimize``."""
+    spec = fault_spec()
+    if spec is not None and spec.mode == "force_nonconverge":
+        return int(spec.n_attempts)
+    return 0
+
+
+def _clear_jit_caches() -> None:
+    # the fault flag is read at trace time; a jitted fit kernel traced
+    # without the fault would silently serve the faulted call (and vice
+    # versa) from the executable cache
+    try:
+        jax.clear_caches()
+    except Exception:  # pragma: no cover — very old jax
+        pass
+
+
+@contextlib.contextmanager
+def fault_injection(mode: str, n_attempts: int = 1, lane_stride: int = 2,
+                    _clear_caches: Optional[bool] = None):
+    """Deterministically inject one fault for the scope's duration::
+
+        with resilience.fault_injection("force_nonconverge", n_attempts=1):
+            model = arima.fit(2, 1, 2, panel,
+                              retry=resilience.RetryPolicy(max_restarts=2))
+        assert bool(model.diagnostics.converged.all())   # retry recovered
+
+    Nesting is allowed (innermost wins).  For ``force_nonconverge`` —
+    whose flag is baked into optimizer traces — entering and leaving the
+    scope clears the jit executable cache so a fit jitted by the caller in
+    the other regime is never served stale (the corruption modes mutate
+    host inputs only and skip the flush; ``_clear_caches`` overrides).
+    """
+    if mode not in _VALID_MODES:
+        raise ValueError(
+            f"unknown fault mode {mode!r}; expected one of {_VALID_MODES}")
+    if n_attempts < 1 or lane_stride < 1:
+        raise ValueError("n_attempts and lane_stride must be >= 1")
+    clear = mode == "force_nonconverge" if _clear_caches is None \
+        else _clear_caches
+    spec = FaultSpec(mode, int(n_attempts), int(lane_stride))
+    _active_fault.append(spec)
+    if clear:
+        _clear_jit_caches()
+    try:
+        yield spec
+    finally:
+        _active_fault.pop()
+        if clear:
+            _clear_jit_caches()
+
+
+def _env_fault_enabled() -> bool:
+    return os.environ.get("STS_FAULT_INJECT") == "1"
+
+
+def corrupt_values(values: np.ndarray, spec: FaultSpec) -> np.ndarray:
+    """Apply a corruption-mode fault to a host panel copy (deterministic:
+    every ``lane_stride``-th lane, starting at lane 0).  Non-corruption
+    modes return the input untouched."""
+    if spec.mode not in ("corrupt_nan", "corrupt_inf"):
+        return values
+    out = np.array(values, copy=True)
+    lanes = np.arange(out.shape[0]) % spec.lane_stride == 0
+    if spec.mode == "corrupt_nan":
+        out[lanes, :] = np.nan
+    else:
+        out[lanes, out.shape[1] // 2] = np.inf
+    return out
+
+
+# ---------------------------------------------------------------------------
+# placeholder rows + pytree lane surgery
+# ---------------------------------------------------------------------------
+
+def _placeholder_rows(n_obs: int, dtype) -> np.ndarray:
+    """A benign stand-in series for unfittable lanes: the batched solve
+    needs *some* finite, non-degenerate values in every lane (results for
+    these lanes are discarded and NaN-ed, but NaN inputs would trip the
+    ragged-gap check and constants would singularize the shared OLS
+    stages).  Deterministic standard-normal draws."""
+    rng = np.random.default_rng(0)
+    return rng.standard_normal(n_obs).astype(dtype, copy=False)
+
+
+def _is_array(leaf: Any) -> bool:
+    return isinstance(leaf, (jnp.ndarray, np.ndarray, jax.Array))
+
+
+def _strip_attempts(model: Any):
+    """Normalize ``diagnostics.attempts`` to None so models from stages
+    with and without multi-start retry share one treedef (attempts are
+    tracked host-side by the engine and re-attached at the end)."""
+    diag = getattr(model, "diagnostics", None)
+    if diag is not None and getattr(diag, "attempts", None) is not None:
+        return model._replace(diagnostics=diag._replace(attempts=None))
+    return model
+
+
+def _merge_lanes(model: Any, sub: Any, rows: np.ndarray, n_series: int):
+    """Scatter ``sub``'s per-lane leaves (fitted on a compacted subset)
+    into ``model`` at panel rows ``rows``.  Leaves without a leading
+    ``n_series`` dim (static orders, flags) pass through from ``model``."""
+    rows_j = jnp.asarray(rows)
+
+    def merge(orig, new):
+        if not _is_array(orig):
+            return orig
+        arr = jnp.asarray(orig)
+        if arr.ndim >= 1 and arr.shape[0] == n_series:
+            return arr.at[rows_j].set(
+                jnp.asarray(new)[:rows.size].astype(arr.dtype))
+        return orig
+
+    return jax.tree_util.tree_map(merge, model, sub)
+
+
+def _nan_lanes(model: Any, rows: np.ndarray, n_series: int):
+    """NaN out the float parameter leaves of the given lanes (skipped
+    series must read as explicitly absent, not as placeholder fits)."""
+    if rows.size == 0:
+        return model
+    rows_j = jnp.asarray(rows)
+
+    def blank(leaf):
+        if not _is_array(leaf):
+            return leaf
+        arr = jnp.asarray(leaf)
+        if arr.ndim >= 1 and arr.shape[0] == n_series \
+                and arr.dtype.kind == "f":
+            return arr.at[rows_j].set(jnp.nan)
+        return leaf
+
+    return jax.tree_util.tree_map(blank, model)
+
+
+def _stack_params(model: Any, n_series: int) -> Optional[np.ndarray]:
+    """Flatten every per-lane float leaf (diagnostics excluded) into one
+    ``(n_series, k)`` parameter matrix for :class:`FitOutcome`."""
+    core = model._replace(diagnostics=None) \
+        if hasattr(model, "_replace") and hasattr(model, "diagnostics") \
+        else model
+    cols = []
+    for leaf in jax.tree_util.tree_leaves(core):
+        if not _is_array(leaf):
+            continue
+        arr = np.asarray(leaf)
+        if arr.ndim >= 1 and arr.shape[0] == n_series \
+                and arr.dtype.kind == "f":
+            cols.append(arr.reshape(n_series, -1))
+    if not cols:
+        return None
+    return np.concatenate(cols, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+def resilient_fit(values, fits: Sequence[Tuple[str, Callable]], *,
+                  min_len: int = 3, family: str = "model",
+                  registry: Optional["_metrics.MetricsRegistry"] = None
+                  ) -> Tuple[Any, FitOutcome]:
+    """Run a fallback chain of batched fits with per-lane failure isolation.
+
+    ``values (n_series, n)`` is the raw panel (NaN padding allowed);
+    ``fits`` is the declarative chain ``[(name, fit_fn), ...]`` — every
+    ``fit_fn(values) -> model`` must return the *same pytree structure*
+    (the model-family ``fit_resilient`` wrappers guarantee this by
+    re-expressing lower-order fallbacks in the primary parameter layout)
+    with a ``diagnostics.converged`` entry per lane.
+
+    Flow: classify lane health → replace unfittable lanes with a benign
+    placeholder (their results are NaN-ed afterwards; healthy lanes are
+    untouched, so per-lane results match the plain fit bit-for-bit) → run
+    the primary fit → for each fallback stage, gather the still-failed
+    lanes, fit just those, and scatter back the lanes the stage converged.
+    A stage that *raises* is recorded and skipped — the panel never dies on
+    a stage error as long as some stage returns.
+
+    Returns ``(model, outcome)``: the merged model (primary structure,
+    final diagnostics reflecting the per-lane disposition) and the
+    :class:`FitOutcome`.  Counts land in the registry as
+    ``resilience.<family>.*`` plus aggregate ``resilience.*`` counters and
+    ``frac_recovered`` / ``frac_fallback`` / ``frac_abandoned`` gauges.
+    """
+    if not fits:
+        raise ValueError("resilient_fit needs at least one fit stage")
+    reg = registry if registry is not None else _metrics.get_registry()
+    host = np.asarray(values)
+    if host.ndim != 2:
+        raise ValueError(
+            f"resilient_fit needs a (n_series, n) panel, got {host.shape}")
+    n_series, n_obs = host.shape
+
+    # env-armed CI fault (make verify-faults): scoped to the BASE-model
+    # stage only, so the primary fit's retry path is forced on every
+    # resilient fit while the fallback stages run clean — an optimizer
+    # fallback must be able to *succeed* under the CI fault, or a
+    # regression in it would be invisible there.  (An explicit
+    # fault_injection scope set by the caller applies everywhere, as
+    # asked.)  The env flag is constant for the process lifetime, so no
+    # cross-regime jit cache exists to flush.
+    env_armed = _env_fault_enabled() and fault_spec() is None
+    with _metrics.span(f"resilience.fit.{family}"):
+        spec = fault_spec()
+        if spec is not None:
+            host = corrupt_values(host, spec)
+
+        health = np.asarray(classify_series(jnp.asarray(host),
+                                            min_len=min_len))
+        skipped = unfittable_mask(health)
+        safe = host
+        if skipped.any():
+            safe = np.array(host, copy=True)
+            safe[skipped] = _placeholder_rows(n_obs, host.dtype)
+        safe_j = jnp.asarray(safe)
+
+        # the first stage that returns is the base model; earlier stages
+        # that raise are recorded (a primary that dies on static shape
+        # grounds must not kill the panel when a fallback can run)
+        errors: List[str] = []
+        model = None
+        base_idx = 0
+        base_ctx = fault_injection("force_nonconverge", n_attempts=1,
+                                   _clear_caches=False) \
+            if env_armed else contextlib.nullcontext()
+        with base_ctx:
+            for i, (name, fn) in enumerate(fits):
+                try:
+                    model = fn(safe_j)
+                    base_idx = i
+                    break
+                except Exception as e:  # noqa: BLE001 — stage isolation is
+                    # the contract; anything fatal for the whole panel
+                    # surfaces below when every stage has failed
+                    errors.append(f"{name}: {type(e).__name__}: {e}")
+                    reg.inc(f"resilience.{family}.stage_errors")
+        if model is None:
+            raise RuntimeError(
+                f"resilient_fit({family}): every fit stage raised — "
+                + "; ".join(errors))
+
+        diag = getattr(model, "diagnostics", None)
+        if diag is None:
+            raise ValueError(
+                f"resilient_fit({family}): stage {fits[base_idx][0]!r} "
+                "returned a model without diagnostics")
+        conv = np.asarray(diag.converged).reshape(-1).astype(bool)
+        d_att = getattr(diag, "attempts", None)
+        attempts = (np.asarray(d_att).reshape(-1).astype(np.int64)
+                    if d_att is not None else np.ones(n_series, np.int64))
+        model = _strip_attempts(model)
+
+        status = np.full(n_series, STATUS_ABANDONED, np.int32)
+        fallback_used = np.full(n_series, -1, np.int32)
+        if base_idx == 0:
+            status[conv & (attempts <= 1)] = STATUS_OK
+            status[conv & (attempts > 1)] = STATUS_RETRIED
+        else:
+            status[conv] = STATUS_FALLBACK
+            fallback_used[conv] = base_idx
+        status[skipped] = STATUS_SKIPPED
+        attempts[skipped] = 0
+
+        pending = ~conv & ~skipped
+        for j in range(base_idx + 1, len(fits)):
+            if not pending.any():
+                break
+            name, fn = fits[j]
+            rows = np.flatnonzero(pending)
+            try:
+                sub = fn(jnp.asarray(safe[rows]))
+            except Exception as e:  # noqa: BLE001 — see above
+                errors.append(f"{name}: {type(e).__name__}: {e}")
+                reg.inc(f"resilience.{family}.stage_errors")
+                continue
+            sub_diag = getattr(sub, "diagnostics", None)
+            if sub_diag is None:
+                errors.append(f"{name}: returned model without diagnostics")
+                reg.inc(f"resilience.{family}.stage_errors")
+                continue
+            sub_conv = np.asarray(sub_diag.converged).reshape(-1).astype(bool)
+            sub = _strip_attempts(sub)
+            attempts[rows] += 1
+            took = rows[sub_conv]
+            if took.size:
+                # scatter only the lanes this stage actually fixed
+                conv_rows = jnp.asarray(np.flatnonzero(sub_conv))
+
+                def _take_conv(leaf, n_sub=rows.size, idx=conv_rows):
+                    if _is_array(leaf):
+                        arr = jnp.asarray(leaf)
+                        if arr.ndim >= 1 and arr.shape[0] == n_sub:
+                            return arr[idx]
+                    return leaf
+
+                sub_took = jax.tree_util.tree_map(_take_conv, sub)
+                model = _merge_lanes(model, sub_took, took, n_series)
+                status[took] = STATUS_FALLBACK
+                fallback_used[took] = j
+                pending[took] = False
+
+        model = _nan_lanes(model, np.flatnonzero(skipped), n_series)
+
+        ok_mask = np.isin(status,
+                          (STATUS_OK, STATUS_RETRIED, STATUS_FALLBACK))
+        diag = getattr(model, "diagnostics", None)
+        try:
+            final_diag = type(diag)(jnp.asarray(ok_mask),
+                                    jnp.asarray(diag.n_iter),
+                                    jnp.asarray(diag.fun),
+                                    jnp.asarray(attempts))
+        except TypeError:       # a diagnostics type without an attempts slot
+            final_diag = type(diag)(jnp.asarray(ok_mask),
+                                    jnp.asarray(diag.n_iter),
+                                    jnp.asarray(diag.fun))
+        model = model._replace(diagnostics=final_diag)
+
+        outcome = FitOutcome(_stack_params(model, n_series), status,
+                             attempts, fallback_used, health)
+
+        n_skip = int(skipped.sum())
+        n_retr = int(np.sum(status == STATUS_RETRIED))
+        n_fb = int(np.sum(status == STATUS_FALLBACK))
+        n_aband = int(np.sum(status == STATUS_ABANDONED))
+        for prefix in (f"resilience.{family}", "resilience"):
+            reg.inc(f"{prefix}.series", n_series)
+            reg.inc(f"{prefix}.skipped", n_skip)
+            reg.inc(f"{prefix}.retried", n_retr)
+            reg.inc(f"{prefix}.fallback", n_fb)
+            reg.inc(f"{prefix}.abandoned", n_aband)
+        if n_series:
+            reg.set_gauge(f"resilience.{family}.frac_recovered",
+                          (n_retr + n_fb) / n_series)
+            reg.set_gauge(f"resilience.{family}.frac_fallback",
+                          n_fb / n_series)
+            reg.set_gauge(f"resilience.{family}.frac_abandoned",
+                          n_aband / n_series)
+        return model, outcome

@@ -197,6 +197,9 @@ def test_checkpoint_round_trips_all_model_types(tmp_path):
             got = getattr(back, field)
             if hasattr(orig, "_fields"):     # nested FitDiagnostics
                 for sub_orig, sub_got in zip(orig, got):
+                    if sub_orig is None:     # e.g. attempts without retry
+                        assert sub_got is None
+                        continue
                     np.testing.assert_allclose(np.asarray(sub_got),
                                                np.asarray(sub_orig))
             elif orig is None or (isinstance(orig, (str, bool, int, tuple))
