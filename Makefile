@@ -3,7 +3,15 @@
 
 PY ?= python
 
-.PHONY: verify compileall tier1 verify-faults
+.PHONY: help verify compileall tier1 verify-faults verify-perf gate trace
+
+help:
+	@echo "Targets:"
+	@echo "  verify        byte-compile the package + tier-1 test sweep"
+	@echo "  verify-faults tier-1 sweep with STS_FAULT_INJECT=1 (retry/fallback paths forced)"
+	@echo "  verify-perf   perf gate: newest BENCH_r*.json vs trailing-median baseline"
+	@echo "  gate          same as verify-perf (tools/bench_gate.py; exit 1 on regression)"
+	@echo "  trace         run a small demo workload, write trace.json (open in ui.perfetto.dev)"
 
 # byte-compile the whole package (catches syntax errors in files the test
 # sweep doesn't import) then run the tier-1 test sweep
@@ -29,3 +37,25 @@ verify-faults:
 	STS_FAULT_INJECT=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 		-m 'not slow' --continue-on-collection-errors \
 		-p no:cacheprovider -p no:xdist -p no:randomly
+
+# perf regression gate over the recorded BENCH_r*.json trajectory: the
+# newest round is compared per headline metric (throughput, fit wall
+# time, compile seconds, recompiles) against the trailing median of
+# comparable prior rounds; exits nonzero past the thresholds (see
+# tools/bench_gate.py --help; BENCH_GATE_THRESHOLD overrides).
+verify-perf: gate
+
+gate:
+	$(PY) tools/bench_gate.py
+
+# demo timeline: a small panel fit with STS_TRACE armed — writes
+# ./trace.json (Chrome trace-event format; load in https://ui.perfetto.dev
+# or chrome://tracing to see the span/recompile timeline)
+trace:
+	STS_TRACE=trace.json JAX_PLATFORMS=cpu $(PY) -c "import numpy as np; \
+	from spark_timeseries_tpu.models import arima; \
+	from spark_timeseries_tpu.utils import metrics; \
+	metrics.install_jax_hooks(); \
+	v = np.cumsum(np.random.default_rng(0).normal(size=(64, 96)), 1); \
+	arima.fit(1, 1, 1, v.astype(np.float32), warn=False); \
+	print('demo fit done; trace.json written at interpreter exit')"

@@ -288,22 +288,29 @@ def main():
     import jax
     import jax.numpy as jnp
     from spark_timeseries_tpu.models import arima
-    from spark_timeseries_tpu.utils import metrics
+    from spark_timeseries_tpu.utils import costs, metrics, tracing
 
     # recompile/compile-seconds tracking rides jax.monitoring; when the
     # installed JAX lacks the hooks the stats stay 0 and hooks_installed
     # says so in the artifact (graceful no-op fallback)
     metrics.install_jax_hooks()
+    # device-memory watermark at span boundaries (device.mem.* gauges);
+    # self-disarms after one probe on platforms with no memory stats
+    costs.install_device_memory_sampler()
 
     def _metrics_block() -> dict:
         """Why-block for every record: recompiles + compile seconds from
         the jax.monitoring hooks, per-span wall-time stats for every
         instrumented stage (the model fits' spans fire at trace time under
-        the jitted fit, so each model family fitted shows up), and the
-        accumulated fit counter bundles."""
+        the jitted fit, so each model family fitted shows up), the
+        accumulated fit counter bundles, the top-N slowest individual
+        span scopes from the trace ring (the aggregate histograms can't
+        say WHICH round/chunk was slow — these can), and the device
+        memory gauges when the platform reports them."""
         snap = metrics.snapshot()
         block = dict(metrics.jax_stats(snap=snap))
         block["spans"] = snap["spans"]
+        block["slowest_spans"] = tracing.slowest_spans(8)
         fit_counters = {k: v for k, v in snap["counters"].items()
                         if k.startswith(("fit.", "optimize.",
                                          "resilience."))}
@@ -313,6 +320,10 @@ def main():
                         if k.startswith("resilience.")}
         if resil_gauges:
             block["resilience_gauges"] = resil_gauges
+        mem_gauges = {k: v for k, v in snap["gauges"].items()
+                      if k.startswith("device.mem.")}
+        if mem_gauges:
+            block["device_memory"] = mem_gauges
         return block
 
     def emit(obj: dict) -> None:
@@ -622,6 +633,24 @@ def main():
             # failure must not void the already-measured curve
             resilience_demo = {"error": f"{type(e).__name__}: {e}"}
 
+    # compiled-program cost accounting (ISSUE 3): ask XLA what one
+    # compiled fit of the benched chunk shape costs — FLOPs, bytes, peak
+    # memory, HLO op mix — per family in BENCH_COST_FAMILIES (default:
+    # the headline's own family).  Shape-only lowering: each block costs
+    # one compile, no fitting; the blocks let the perf trajectory
+    # correlate measured regressions with what the compiler emitted.
+    cost_reports = {}
+    cost_fams = [f for f in os.environ.get("BENCH_COST_FAMILIES",
+                                           "arima").split(",") if f]
+    for fam in cost_fams:
+        try:
+            with metrics.span("bench.cost_report"):
+                cost_reports[fam] = costs.fit_cost_report(
+                    fam, min(chunk, n_target), n_obs, dtype=dtype)
+        except Exception as e:  # noqa: BLE001 — optional accounting; its
+            # failure must not void the measured curve
+            cost_reports[fam] = {"error": f"{type(e).__name__}: {e}"}
+
     if not curve:
         # nothing measured at all (first fit died): the run is still not
         # empty — the CPU-baseline emulation above always completes
@@ -713,6 +742,7 @@ def main():
         "peak_device_memory_mb": peak_mb,
         "refit_demo": refit_demo,
         "resilience_demo": resilience_demo,
+        "cost_reports": cost_reports,
         "baseline_emulation": {
             "kind": "per-series scipy Powell on the same CSS objective",
             "sample": BASELINE_SAMPLE,

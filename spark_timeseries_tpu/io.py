@@ -163,8 +163,14 @@ def load_csv(path: str) -> Panel:
             raw = f.read()
         if not raw.strip():
             return Panel(index, jnp.zeros((0, len(index))), [])
-        first = raw.split(b"\n", 1)[0].decode()
-        _, first_rest = _split_key(first.rstrip("\r"))
+        # width comes from the first NON-blank line, mirroring the C
+        # parser's and the Python fallback's blank-line skip — a leading
+        # blank/CR-only line (hand-edited or concatenated files) must not
+        # make the codecs disagree on the same file (ADVICE.md round 5)
+        first = next(line for line in
+                     (b.decode().rstrip("\r") for b in raw.split(b"\n"))
+                     if line)
+        _, first_rest = _split_key(first)
         width = first_rest.count(",") + 1
         rows_cap = raw.count(b"\n") + 1
         values = np.empty((rows_cap, width), np.float64)

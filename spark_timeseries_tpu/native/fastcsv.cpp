@@ -77,18 +77,24 @@ extern "C" {
 // Build the whole data.csv: keys are pre-escaped, '\n'-joined (rows of
 // them); values row-major (rows x cols).  out must hold at least
 // keys_len + rows * (cols * (kMaxNum + 1) + 2) bytes.  Returns bytes
-// written, or -1 on malformed keys blob / formatting failure.
+// written, or -1 on a keys/rows count mismatch (fewer keys than rows) /
+// formatting failure — the C ABI fails loudly even if a future caller
+// drops save_csv's Python-side shape check.
 long long sts_format_csv(const char* keys, long long keys_len,
                          const double* values, long long rows,
                          long long cols, char* out) {
     const char* kp = keys;
     const char* kend = keys + keys_len;
+    bool keys_exhausted = false;
     char* o = out;
     for (long long r = 0; r < rows; ++r) {
+        // the previous row consumed the blob's last key (no newline
+        // followed it), so this row would silently get an empty key
+        if (keys_exhausted) return -1;
         const char* knl = find_newline(kp, kend);
-        if (kp > kend) return -1;
         memcpy(o, kp, static_cast<size_t>(knl - kp));
         o += knl - kp;
+        if (knl == kend) keys_exhausted = true;
         kp = knl < kend ? knl + 1 : kend;
         const double* row = values + r * cols;
         for (long long c = 0; c < cols; ++c) {

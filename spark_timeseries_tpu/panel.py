@@ -403,6 +403,18 @@ class Panel:
         with _metrics.span("panel.fit_resilient"):
             return dispatch[family](self.values, *args, **kwargs)
 
+    def describe_costs(self, family: str = "arima") -> dict:
+        """What would one compiled ``family`` fit of this panel cost?
+        Asks XLA directly (``utils.costs.fit_cost_report`` at this
+        panel's exact ``(n_series, n_obs)`` shape and dtype): FLOPs,
+        bytes accessed, argument/output/temp/peak bytes, and HLO op
+        counts — one compile, no data fitted.  Sections a backend does
+        not expose come back as ``None`` markers (see the report's
+        ``available`` block)."""
+        from .utils import costs as _costs
+        return _costs.fit_cost_report(family, self.n_series, self.n_obs,
+                                      dtype=self.values.dtype)
+
     def series_stats(self) -> dict:
         """Per-series count/mean/stdev/min/max, NaN-aware — the StatCounter
         equivalent.  Returns a dict of ``(n_series,)`` numpy arrays."""

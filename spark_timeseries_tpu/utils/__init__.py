@@ -4,14 +4,18 @@ The reference has no tracing/metrics/checkpoint tier (SURVEY.md §5) — its
 fault tolerance is Spark lineage and its only observability is the Spark UI.
 Here the equivalents are explicit: pytree checkpoints (fits are idempotent
 and restartable), a profiler/timing harness plus convergence counters
-(``observability``), and the structured runtime-metrics spine —
+(``observability``), the structured runtime-metrics spine —
 counters/gauges/histograms, nested spans, XLA recompile tracking —
-(``metrics``) that ``bench.py`` embeds into every benchmark artifact.
+(``metrics``) that ``bench.py`` embeds into every benchmark artifact,
+the Perfetto timeline export over the span ring buffer (``tracing``),
+and the compiled-program cost/memory analysis tier (``costs``).
 """
 
-from . import checkpoint, metrics, observability, resilience  # noqa: F401
+from . import (checkpoint, costs, metrics, observability,  # noqa: F401
+               resilience, tracing)
 
-__all__ = ["checkpoint", "metrics", "observability", "plot", "resilience"]
+__all__ = ["checkpoint", "costs", "metrics", "observability", "plot",
+           "resilience", "tracing"]
 
 
 def __getattr__(name):

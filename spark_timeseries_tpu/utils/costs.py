@@ -1,0 +1,305 @@
+"""Compiled-program cost & memory analysis per fit family.
+
+ARIMA_PLUS (PAPERS.md) treats per-model cost accounting as a first-class
+product feature for in-database forecasting at scale; the Spark
+reference's analogue is the per-executor memory page.  This module is
+that tier for the TPU build: *before* running a workload, ask XLA what
+one compiled fit program actually does —
+
+- :func:`fit_cost_report` lowers and compiles a representative batched
+  fit for any model family at a given ``(n_series, n_obs)`` shape
+  (``jax.jit(...).lower(...).compile()``) and reads the compiler's own
+  accounting: ``cost_analysis()`` (FLOPs, bytes accessed,
+  transcendentals) and ``memory_analysis()`` (argument / output /
+  temp / generated-code bytes, whose sum is the peak-footprint
+  estimate), plus HLO op counts parsed from the optimized module text.
+  Backends that don't expose a section (CPU lacks ``memory_analysis``
+  on some jaxlib versions) yield ``None`` markers, never an exception —
+  the report's ``available`` block says which sections are real.
+- :func:`device_memory_stats` / :func:`sample_device_memory` read live
+  allocator state (``device.memory_stats()``) into ``device.mem.*``
+  gauges — a graceful no-op on platforms that expose nothing (CPU).
+- :func:`install_device_memory_sampler` hooks the sampler onto span
+  exits (``metrics.add_span_listener``), so any instrumented workload
+  tracks its HBM watermark with no per-call-site code.
+
+Shapes only, never data: lowering takes ``jax.ShapeDtypeStruct`` specs,
+so a cost report for a 1M-series panel costs one compile, not one fit.
+``bench.py`` embeds a per-family block in every ``BENCH_*.json`` so the
+perf trajectory records what the compiler thought the program costs
+alongside what it measured.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from . import metrics as _metrics
+
+__all__ = ["fit_cost_report", "representative_fit", "hlo_op_counts",
+           "device_memory_stats", "sample_device_memory",
+           "install_device_memory_sampler", "COST_FAMILIES"]
+
+# Exogenous-regressor column count used by the representative fits of
+# the x-carrying families (arimax/arx/regression_arima).
+N_XREG = 2
+
+COST_FAMILIES = ("arima", "arimax", "ar", "arx", "ewma", "garch",
+                 "argarch", "egarch", "holt_winters", "regression_arima")
+
+
+def representative_fit(family: str, n_series: int, n_obs: int,
+                       dtype=None) -> Tuple[Callable, Tuple]:
+    """A representative batched fit closure + abstract args for one
+    family, at canonical small orders (the orders every family's tests
+    and the bench exercise: ARIMA(2,1,2), AR(2), period-12 HW, ...).
+
+    Returns ``(fn, abstract_args)`` where each arg is a
+    ``jax.ShapeDtypeStruct`` — suitable for ``jax.jit(fn).lower(*args)``
+    with no data materialized."""
+    import jax
+    import jax.numpy as jnp
+
+    if dtype is None:
+        dtype = jnp.float32
+    v = jax.ShapeDtypeStruct((n_series, n_obs), dtype)
+    x = jax.ShapeDtypeStruct((n_obs, N_XREG), dtype)
+
+    from .. import models as m
+
+    def arrays_only(fit_fn):
+        # a fitted model pytree may carry non-JAX leaves (Holt-Winters'
+        # model_type string) that cannot cross the jit boundary; the
+        # compiled program is identical either way, so return just the
+        # array leaves
+        def fn(*a):
+            model = fit_fn(*a)
+            return [leaf for leaf in jax.tree_util.tree_leaves(model)
+                    if isinstance(leaf, (jax.Array, jnp.ndarray))
+                    or hasattr(leaf, "dtype")]
+        return fn
+
+    table: Dict[str, Tuple[Callable, Tuple]] = {
+        "arima": (lambda ts: m.arima.fit(2, 1, 2, ts, warn=False), (v,)),
+        "arimax": (lambda ts, xr: m.arimax.fit(1, 1, 1, ts, xr, 1), (v, x)),
+        "ar": (lambda ts: m.autoregression.fit(ts, max_lag=2), (v,)),
+        "arx": (lambda ts, xr: m.autoregression_x.fit(ts, xr, 2, 1), (v, x)),
+        "ewma": (lambda ts: m.ewma.fit(ts), (v,)),
+        "garch": (lambda ts: m.garch.fit(ts), (v,)),
+        "argarch": (lambda ts: m.garch.fit_ar_garch(ts), (v,)),
+        "egarch": (lambda ts: m.garch.fit_egarch(ts), (v,)),
+        "holt_winters": (
+            lambda ts: m.holt_winters.fit(ts, period=12), (v,)),
+        "regression_arima": (
+            lambda ts, xr: m.regression_arima.fit(
+                ts, xr, "cochrane-orcutt"), (v, x)),
+    }
+    if family not in table:
+        raise ValueError(f"unknown model family {family!r}; expected one "
+                         f"of {sorted(table)}")
+    fit_fn, args = table[family]
+    return arrays_only(fit_fn), args
+
+
+_HLO_OP_RE = re.compile(r"=\s*\S+\s+([a-zA-Z][\w-]*)\(")
+
+
+def hlo_op_counts(hlo_text: str, top: int = 15) -> Dict[str, int]:
+    """Occurrence counts of the ``top`` most frequent HLO opcodes in an
+    (optimized) HLO module dump — a compact fingerprint of what the
+    compiled program is made of (how many fusions, while loops,
+    dots, ...)."""
+    counts: Dict[str, int] = {}
+    for mo in _HLO_OP_RE.finditer(hlo_text):
+        op = mo.group(1)
+        counts[op] = counts.get(op, 0) + 1
+    ranked = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+    return dict(ranked[:top])
+
+
+def _first(obj):
+    """cost_analysis() returns a dict on current JAX, a list of per-
+    computation dicts on older versions; normalize to one dict."""
+    if isinstance(obj, (list, tuple)):
+        return obj[0] if obj else None
+    return obj
+
+
+def fit_cost_report(family: str, n_series: int, n_obs: int,
+                    dtype=None, backend: Optional[str] = None
+                    ) -> Dict[str, Any]:
+    """What does one compiled ``family`` fit at ``(n_series, n_obs)``
+    cost?  Lowers + compiles the representative fit and reports:
+
+    - ``flops``, ``bytes_accessed``, ``transcendentals`` from XLA's
+      ``cost_analysis`` (``None`` when the backend exposes none);
+    - ``argument_bytes`` / ``output_bytes`` / ``temp_bytes`` /
+      ``generated_code_bytes`` and their sum ``peak_bytes`` from
+      ``memory_analysis`` (``None`` markers likewise — CPU jaxlibs
+      often expose no memory analysis);
+    - ``hlo_op_counts`` from the optimized module text, ``hlo_ops_total``
+      over all opcodes;
+    - ``lower_s`` / ``compile_s`` wall times, and flop/byte intensity
+      when both numerator and denominator are real.
+
+    The ``available`` sub-dict says which sections came from the
+    compiler and which are absent markers, so a consumer never has to
+    guess whether ``None`` means "zero" or "not exposed here".
+    Shape-only: no panel data is materialized or fitted.
+    """
+    import jax
+
+    fn, args = representative_fit(family, n_series, n_obs, dtype)
+    with _metrics.span(f"costs.{family}"):
+        t0 = time.perf_counter()
+        lowered = jax.jit(fn, backend=backend).lower(*args)
+        lower_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        compiled = lowered.compile()
+        compile_s = time.perf_counter() - t0
+
+    cost = None
+    try:
+        cost = _first(compiled.cost_analysis())
+    except Exception:           # noqa: BLE001 — backend-dependent API
+        pass
+    mem = None
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:           # noqa: BLE001 — backend-dependent API
+        pass
+    hlo = ""
+    try:
+        hlo = compiled.as_text()
+    except Exception:           # noqa: BLE001 — backend-dependent API
+        pass
+
+    def c_get(key):
+        if not cost:
+            return None
+        val = cost.get(key)
+        return float(val) if val is not None else None
+
+    def m_get(attr):
+        val = getattr(mem, attr, None) if mem is not None else None
+        try:
+            return int(val) if val is not None else None
+        except (TypeError, ValueError):
+            return None
+
+    arg_b = m_get("argument_size_in_bytes")
+    out_b = m_get("output_size_in_bytes")
+    tmp_b = m_get("temp_size_in_bytes")
+    code_b = m_get("generated_code_size_in_bytes")
+    alias_b = m_get("alias_size_in_bytes")
+    parts = [b for b in (arg_b, out_b, tmp_b, code_b) if b is not None]
+    # arguments + outputs + temps + code live simultaneously at peak;
+    # aliased buffers are counted once (they overlap arguments)
+    peak = sum(parts) - (alias_b or 0) if parts else None
+
+    flops = c_get("flops")
+    bytes_accessed = c_get("bytes accessed")
+    report: Dict[str, Any] = {
+        "family": family,
+        "n_series": int(n_series),
+        "n_obs": int(n_obs),
+        "platform": jax.devices(backend)[0].platform,
+        "flops": flops,
+        "bytes_accessed": bytes_accessed,
+        "transcendentals": c_get("transcendentals"),
+        "flops_per_byte": (round(flops / bytes_accessed, 3)
+                           if flops and bytes_accessed else None),
+        "flops_per_series": (round(flops / n_series, 1)
+                             if flops and n_series else None),
+        "argument_bytes": arg_b,
+        "output_bytes": out_b,
+        "temp_bytes": tmp_b,
+        "generated_code_bytes": code_b,
+        "peak_bytes": peak,
+        "hlo_op_counts": hlo_op_counts(hlo),
+        "hlo_ops_total": len(_HLO_OP_RE.findall(hlo)),
+        "lower_s": round(lower_s, 4),
+        "compile_s": round(compile_s, 4),
+        "available": {
+            "cost_analysis": cost is not None,
+            "memory_analysis": mem is not None,
+            "hlo_text": bool(hlo),
+        },
+    }
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Live device-memory telemetry
+# ---------------------------------------------------------------------------
+
+def device_memory_stats() -> Dict[str, Dict[str, int]]:
+    """``memory_stats()`` per local device, keyed ``"d<i>"``.  Devices
+    (or whole platforms — CPU) that expose nothing are simply absent;
+    an empty dict means no device reports memory here."""
+    import jax
+
+    out: Dict[str, Dict[str, int]] = {}
+    try:
+        devices = jax.local_devices()
+    except Exception:           # noqa: BLE001 — uninitializable backend
+        return out
+    for i, d in enumerate(devices):
+        try:
+            stats = d.memory_stats()
+        except Exception:       # noqa: BLE001 — platform-dependent API
+            continue
+        if stats:
+            out[f"d{i}"] = {k: int(v) for k, v in stats.items()
+                            if isinstance(v, (int, float))}
+    return out
+
+
+def sample_device_memory(registry: Optional["_metrics.MetricsRegistry"]
+                         = None) -> bool:
+    """One sample of live device memory into ``device.mem.*`` gauges
+    (``device.mem.d0.bytes_in_use``, ``...peak_bytes_in_use``, ...).
+    Returns False (recording nothing) when no device exposes stats —
+    the CPU no-op."""
+    reg = registry if registry is not None else _metrics.get_registry()
+    if not reg.enabled:
+        return False
+    stats = device_memory_stats()
+    for dev, kv in stats.items():
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                    "largest_alloc_size"):
+            if key in kv:
+                reg.set_gauge(f"device.mem.{dev}.{key}", kv[key])
+    return bool(stats)
+
+
+_sampler_state = {"installed": False, "dead": False}
+
+
+def _span_memory_sampler(path: str, dt: float) -> None:
+    # one failed/empty probe disarms the sampler for the process: a
+    # platform that reports nothing now will report nothing per-span
+    # forever, and span exit is a hot path.  A merely *disabled*
+    # registry is NOT evidence about the platform — skip without
+    # disarming so re-enabling resumes sampling.
+    if _sampler_state["dead"]:
+        return
+    reg = _metrics.get_registry()
+    if not reg.enabled:
+        return
+    if not sample_device_memory(reg):
+        _sampler_state["dead"] = True
+
+
+def install_device_memory_sampler() -> bool:
+    """Sample device memory at every span boundary (gauges are
+    last-write-wins; the ``peak_bytes_in_use`` gauge is the workload's
+    HBM watermark).  Idempotent; self-disarms permanently after the
+    first probe on a platform with no memory stats, so CPU runs pay one
+    probe total."""
+    if not _sampler_state["installed"]:
+        _metrics.add_span_listener(_span_memory_sampler)
+        _sampler_state["installed"] = True
+    return not _sampler_state["dead"]

@@ -34,6 +34,16 @@ compile seconds, per-span wall time) alongside *how fast*.
 
 ``STS_METRICS=0`` disables all recording (spans still forward to the
 profiler); :func:`set_enabled` overrides at runtime.
+
+Besides the aggregate histograms, every span scope also records a
+**timeline event** (begin timestamp + duration + thread) into a bounded
+process-global ring buffer, and recompiles / resilience fallback stages
+record **instant events** — the raw material ``utils.tracing`` exports as
+a Chrome trace-event file loadable in Perfetto (``STS_TRACE=/path.json``
+dumps it atexit).  The ring holds the most recent
+``STS_TRACE_CAPACITY`` events (default 65536, ~100 bytes each) so the
+timeline tier is always-on without unbounded growth; ``STS_METRICS=0``
+disables it together with everything else.
 """
 
 from __future__ import annotations
@@ -53,6 +63,9 @@ __all__ = [
     "counter", "gauge", "histogram", "inc", "set_gauge", "record",
     "snapshot", "reset", "to_json", "to_prometheus",
     "span", "current_span_path",
+    "TraceBuffer", "trace_buffer", "trace_events", "trace_instant",
+    "clear_trace", "set_trace_capacity", "add_span_listener",
+    "remove_span_listener",
     "install_jax_hooks", "jax_hooks_installed", "jax_stats",
     "record_fit", "record_fit_report", "observe_minimize",
     "instrument_fit", "instrumented", "enabled", "set_enabled",
@@ -355,6 +368,182 @@ def to_prometheus(prefix: str = "sts") -> str:
 
 
 # ---------------------------------------------------------------------------
+# Trace timeline: bounded ring buffer of span / instant events
+# ---------------------------------------------------------------------------
+
+# Default event capacity; overridable via STS_TRACE_CAPACITY or
+# set_trace_capacity().  Each event is a small dict (~100 bytes), so the
+# default ring tops out around ~6 MB — cheap enough to leave always-on.
+TRACE_CAPACITY = 65536
+
+# perf_counter <-> wall-clock anchor taken at import, so the exporter can
+# stamp the trace with an absolute start time without every event paying
+# for a time.time() call.
+_TRACE_EPOCH = (time.time(), time.perf_counter())
+
+
+class TraceBuffer:
+    """Bounded ring of timeline events (most recent ``capacity`` kept).
+
+    Two event kinds, both JSON-able dicts:
+
+    - ``span``: one per completed :func:`span` scope — ``name`` is the
+      nested ``/``-joined path, ``ts`` the scope's *begin* on the
+      ``perf_counter`` clock (seconds), ``dur`` its duration (seconds),
+      ``tid``/``tname`` the recording thread.  Begin + duration is the
+      begin/end pair in one record (Chrome trace "complete" events).
+    - ``instant``: a zero-duration marker (recompiles, resilience
+      fallback stages) with optional ``args``.
+
+    Appends hold a private lock (never the registry's: an event append
+    must not contend with snapshot walks); overwrite order is arrival
+    order, exactly like :class:`Histogram`'s sample ring.
+    """
+
+    def __init__(self, capacity: int = TRACE_CAPACITY):
+        self._lock = threading.Lock()
+        self._cap = int(capacity)
+        self._events: list = []
+        self._head = 0          # next overwrite slot once full
+        self.dropped = 0        # events overwritten since last clear
+
+    def append(self, event: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(self._events) < self._cap:
+                self._events.append(event)
+            else:
+                self._events[self._head] = event
+                self._head = (self._head + 1) % self._cap
+                self.dropped += 1
+
+    def events(self) -> list:
+        """Copy of the buffered events, oldest first."""
+        with self._lock:
+            return self._events[self._head:] + self._events[:self._head]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events = []
+            self._head = 0
+            self.dropped = 0
+
+    def set_capacity(self, capacity: int) -> None:
+        """Resize, keeping the newest events that still fit."""
+        capacity = int(capacity)
+        if capacity < 1:
+            raise ValueError(f"trace capacity must be >= 1, got {capacity}")
+        with self._lock:
+            ordered = self._events[self._head:] + self._events[:self._head]
+            self._events = ordered[-capacity:]
+            self._head = 0
+            self._cap = capacity
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    @property
+    def capacity(self) -> int:
+        return self._cap
+
+
+_trace_buffer = TraceBuffer(
+    int(os.environ.get("STS_TRACE_CAPACITY", str(TRACE_CAPACITY))))
+
+
+def trace_buffer() -> TraceBuffer:
+    return _trace_buffer
+
+
+def trace_events() -> list:
+    """Buffered timeline events, oldest first.  Note spans land at scope
+    *exit*, so a nested child precedes its parent here; sort by ``ts``
+    for begin-time order (``utils.tracing`` does)."""
+    return _trace_buffer.events()
+
+
+def clear_trace() -> None:
+    _trace_buffer.clear()
+
+
+def set_trace_capacity(capacity: int) -> None:
+    _trace_buffer.set_capacity(capacity)
+
+
+def _thread_ids():
+    t = threading.current_thread()
+    return t.ident or 0, t.name
+
+
+def trace_instant(name: str, args: Optional[Dict[str, Any]] = None) -> None:
+    """Record a zero-duration timeline marker (shown as an instant arrow
+    in Perfetto).  Used for recompiles and resilience fallback stages;
+    library code is free to add its own."""
+    if not _default_registry.enabled:
+        return
+    tid, tname = _thread_ids()
+    ev = {"kind": "instant", "name": name, "ts": time.perf_counter(),
+          "tid": tid, "tname": tname}
+    if args:
+        ev["args"] = args
+    _trace_buffer.append(ev)
+
+
+def _trace_span_event(reg: "MetricsRegistry", path: str, t0: float,
+                      dur: float) -> None:
+    # the ring is the DEFAULT registry's timeline: spans recorded against
+    # a private registry (test isolation) must not leak phantom events
+    # into STS_TRACE dumps or bench slowest-spans blocks
+    if reg is not _default_registry or not reg.enabled:
+        return
+    tid, tname = _thread_ids()
+    _trace_buffer.append({"kind": "span", "name": path, "ts": t0,
+                          "dur": dur, "tid": tid, "tname": tname})
+
+
+# Span-exit listeners: callables ``fn(path, seconds)`` invoked after each
+# scope records (utils.costs registers the device-memory sampler here).
+# A listener that raises is dropped — observability must never take the
+# instrumented code down with it.
+_span_listeners: list = []
+
+
+def add_span_listener(fn: Callable[[str, float], None]) -> None:
+    if fn not in _span_listeners:
+        _span_listeners.append(fn)
+
+
+def remove_span_listener(fn: Callable[[str, float], None]) -> None:
+    if fn in _span_listeners:
+        _span_listeners.remove(fn)
+
+
+def _notify_span_listeners(path: str, dt: float) -> None:
+    for fn in list(_span_listeners):
+        try:
+            fn(path, dt)
+        except Exception:       # noqa: BLE001 — see note above
+            remove_span_listener(fn)
+
+
+# STS_TRACE=/path.json: dump the Chrome trace at interpreter exit.  The
+# tracing module imports this one, so the import happens lazily inside
+# the handler (registered here because metrics is the module everything
+# else already pulls in).
+if os.environ.get("STS_TRACE"):
+    import atexit
+
+    def _dump_trace_atexit(_path=os.environ["STS_TRACE"]) -> None:
+        try:
+            from . import tracing
+            tracing.write_trace(_path)
+        except Exception:       # noqa: BLE001 — exit paths must not raise
+            pass
+
+    atexit.register(_dump_trace_atexit)
+
+
+# ---------------------------------------------------------------------------
 # Spans
 # ---------------------------------------------------------------------------
 
@@ -388,6 +577,10 @@ def span(name: str, registry: Optional[MetricsRegistry] = None
     Host-side only: wall time of a scope that merely *traces* jitted code
     is trace+compile time, which is exactly what the recompile-tracking
     story wants surfaced (the span's ``count`` then counts retraces).
+
+    Each completed scope additionally lands one timeline event in the
+    trace ring buffer (begin + duration — the Perfetto export's raw
+    material) and fires the registered span-exit listeners.
     """
     reg = registry if registry is not None else _default_registry
     stack = getattr(_span_state, "stack", None)
@@ -403,6 +596,8 @@ def span(name: str, registry: Optional[MetricsRegistry] = None
         dt = time.perf_counter() - t0
         stack.pop()
         reg.record_span(path, dt)
+        _trace_span_event(reg, path, t0, dt)
+        _notify_span_listeners(path, dt)
 
 
 # ---------------------------------------------------------------------------
@@ -500,6 +695,12 @@ def _on_jax_event_duration(event: str, duration_secs: float, **kw) -> None:
         if event.endswith("backend_compile_duration"):
             reg.counter("jax.jit_compiles").inc()
             reg.histogram("jax.compile_s").record(duration_secs)
+            if reg is _default_registry:
+                # a recompile is a point-in-time story the timeline view
+                # wants marked (one instant arrow per XLA backend compile)
+                trace_instant("jax.compile",
+                              {"duration_s": round(duration_secs, 6),
+                               "span": current_span_path()})
         elif event.endswith("jaxpr_trace_duration"):
             reg.histogram("jax.trace_s").record(duration_secs)
         elif "transfer" in event:

@@ -488,6 +488,9 @@ def resilient_fit(values, fits: Sequence[Tuple[str, Callable]], *,
                     # surfaces below when every stage has failed
                     errors.append(f"{name}: {type(e).__name__}: {e}")
                     reg.inc(f"resilience.{family}.stage_errors")
+                    _metrics.trace_instant(
+                        f"resilience.{family}.stage_error",
+                        {"stage": name, "error": type(e).__name__})
         if model is None:
             raise RuntimeError(
                 f"resilient_fit({family}): every fit stage raised — "
@@ -521,11 +524,20 @@ def resilient_fit(values, fits: Sequence[Tuple[str, Callable]], *,
                 break
             name, fn = fits[j]
             rows = np.flatnonzero(pending)
+            # timeline marker per fallback stage actually run: the trace
+            # view then shows WHEN the chain escalated and for how many
+            # lanes, not just the end-of-run counters
+            _metrics.trace_instant(
+                f"resilience.{family}.fallback",
+                {"stage": name, "pending_lanes": int(rows.size)})
             try:
                 sub = fn(jnp.asarray(safe[rows]))
             except Exception as e:  # noqa: BLE001 — see above
                 errors.append(f"{name}: {type(e).__name__}: {e}")
                 reg.inc(f"resilience.{family}.stage_errors")
+                _metrics.trace_instant(
+                    f"resilience.{family}.stage_error",
+                    {"stage": name, "error": type(e).__name__})
                 continue
             sub_diag = getattr(sub, "diagnostics", None)
             if sub_diag is None:

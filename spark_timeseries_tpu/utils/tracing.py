@@ -1,0 +1,140 @@
+"""Chrome trace-event export: the span timeline as a Perfetto file.
+
+The reference answers "where did the time go" with the Spark UI's
+stage/task timeline; ``utils.metrics`` already aggregates span wall time
+into histograms, but an aggregate can't show *when* — which fits
+overlapped, where a recompile landed inside a round, which fallback
+stage the resilient path took.  This module exports the trace ring
+buffer (``metrics.trace_events()``) in the Chrome trace-event JSON
+format, loadable in Perfetto (https://ui.perfetto.dev) or
+``chrome://tracing``:
+
+- each completed span scope is a **complete event** (``"ph": "X"``)
+  whose name is the nested ``/``-joined path, laid out per thread;
+- recompiles and resilience fallback stages are **instant events**
+  (``"ph": "i"``) — the point-in-time arrows over the timeline;
+- process/thread **metadata events** (``"ph": "M"``) label the rows.
+
+Two entry points:
+
+- ``STS_TRACE=/path.json`` (environment) dumps the buffer at interpreter
+  exit — zero code changes, the opt-in for ad-hoc runs (registered by
+  ``utils.metrics`` at import so any entry point that touches the
+  package gets it);
+- :func:`write_trace` / :func:`to_chrome_trace` for explicit dumps, and
+  :func:`span_events` / :func:`slowest_spans` for embedding the top-N
+  slowest scopes into bench artifacts (``bench.py`` does, per round).
+
+Timestamps ride the ``perf_counter`` clock (µs in the export, as the
+format requires); the absolute wall-clock anchor of the trace is carried
+in ``otherData.trace_start_walltime`` so a timeline can be correlated
+with log lines.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from . import metrics as _metrics
+
+__all__ = ["to_chrome_trace", "write_trace", "span_events",
+           "slowest_spans"]
+
+_S_TO_US = 1e6
+
+
+def span_events(events: Optional[List[Dict[str, Any]]] = None
+                ) -> List[Dict[str, Any]]:
+    """The buffered span events (kind ``"span"``), begin-time order.
+
+    The ring appends at scope *exit* (a nested child precedes its parent
+    in arrival order); sorting by ``ts`` restores begin-time order, which
+    is what both the exporter and a "what ran when" reader want."""
+    if events is None:
+        events = _metrics.trace_events()
+    spans = [e for e in events if e.get("kind") == "span"]
+    spans.sort(key=lambda e: e["ts"])
+    return spans
+
+
+def slowest_spans(n: int = 10,
+                  events: Optional[List[Dict[str, Any]]] = None
+                  ) -> List[Dict[str, Any]]:
+    """Top-``n`` slowest span scopes still in the buffer, as compact
+    JSON-able rows — the per-round "where did this round's time go"
+    block ``bench.py`` embeds next to the aggregate span histograms."""
+    spans = span_events(events)
+    spans.sort(key=lambda e: e["dur"], reverse=True)
+    return [{"name": e["name"], "dur_s": round(e["dur"], 6),
+             "thread": e.get("tname", "")} for e in spans[:n]]
+
+
+def to_chrome_trace(events: Optional[List[Dict[str, Any]]] = None
+                    ) -> Dict[str, Any]:
+    """Render the trace buffer as a Chrome trace-event JSON object.
+
+    Uses the object form (``{"traceEvents": [...]}``) so the file can
+    carry ``otherData``; the array inside follows the trace-event spec:
+    ``X`` (complete) events for spans with ``ts``/``dur`` in µs, ``i``
+    (instant, thread scope) events for markers, and ``M`` metadata
+    events naming the process and each thread row."""
+    if events is None:
+        events = _metrics.trace_events()
+    pid = os.getpid()
+    out: List[Dict[str, Any]] = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": "spark_timeseries_tpu"},
+    }]
+    threads: Dict[int, str] = {}
+    body: List[Dict[str, Any]] = []
+    for e in sorted(events, key=lambda e: e["ts"]):
+        tid = e.get("tid", 0)
+        if tid not in threads:
+            threads[tid] = e.get("tname", str(tid))
+        ev: Dict[str, Any] = {
+            "name": e["name"],
+            "cat": e["kind"],
+            "pid": pid,
+            "tid": tid,
+            "ts": e["ts"] * _S_TO_US,
+        }
+        if e["kind"] == "span":
+            ev["ph"] = "X"
+            ev["dur"] = e["dur"] * _S_TO_US
+        else:
+            ev["ph"] = "i"
+            ev["s"] = "t"
+        if e.get("args"):
+            ev["args"] = e["args"]
+        body.append(ev)
+    for tid, tname in sorted(threads.items()):
+        out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": tid, "args": {"name": tname}})
+    out.extend(body)
+    wall0, perf0 = _metrics._TRACE_EPOCH
+    buf = _metrics.trace_buffer()
+    return {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "trace_start_walltime": wall0,
+            "perf_counter_at_start": perf0,
+            "events_dropped": buf.dropped,
+            "capacity": buf.capacity,
+        },
+    }
+
+
+def write_trace(path: str,
+                events: Optional[List[Dict[str, Any]]] = None) -> str:
+    """Write the Chrome trace JSON to ``path`` (parent dirs created);
+    returns the path.  Load the file in https://ui.perfetto.dev or
+    ``chrome://tracing``."""
+    doc = to_chrome_trace(events)
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path

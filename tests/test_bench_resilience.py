@@ -30,7 +30,7 @@ def _run_degraded(script, env_extra, timeout):
         # force the probe to fail instantly: the fallback path itself is
         # the thing under test (works whether or not a TPU is reachable).
         # WINDOW=0 selects the single-pass tries mode — the production
-        # default waits out a 45-minute wedge window, which is exactly
+        # default waits out a 30-minute wedge window, which is exactly
         # what a fallback-contract test must not do
         "BENCH_PROBE_WINDOW": "0",
         "BENCH_PROBE_TRIES": "1",

@@ -1,0 +1,293 @@
+"""Bench regression gate: is the newest BENCH round worse than its past?
+
+The repo's perf evidence is the ordered ``BENCH_r*.json`` trajectory;
+until now nothing *checked* it — a silent 2x wall-time regression would
+ride along unnoticed until a human read the numbers.  This tool is the
+automated check (``make gate``):
+
+- loads every ``BENCH_r*.json`` in round order (each is either the
+  driver's wrapper ``{"n", "cmd", "rc", "tail", "parsed"}`` or a raw
+  bench JSON-lines dump; the headline record is the last parseable JSON
+  line);
+- keeps only rounds *comparable* to the newest one — same ``platform``
+  (a degraded CPU fallback must never be gated against a TPU round),
+  ``rc == 0``, a non-null headline value;
+- per headline metric, compares the newest round against the median of
+  the trailing ``--window`` comparable rounds and fails past the
+  metric's threshold:
+
+  ===================  ========================================  =======
+  metric               source                                    worse
+  ===================  ========================================  =======
+  throughput           headline ``value`` (series/sec)           lower
+  fit_wall_s           ``metrics.spans["bench.fit_panel"]`` p50  higher
+  compile_s_total      ``metrics.compile_s_total``               higher
+  jit_compiles         ``metrics.jit_compiles``                  higher
+  ===================  ========================================  =======
+
+- prints a pass/fail table with signed percentage deltas and exits 1 on
+  any regression, 0 otherwise.  A newest round that crashed (``rc != 0``)
+  or carries no measured headline value fails outright — a broken bench
+  is the regression, not a reason to skip.  Fewer than ``--min-history``
+  comparable prior rounds passes with an ``insufficient history`` note
+  (``--strict`` turns that into a failure) — a fresh repo must not be
+  red by default.
+
+Thresholds: throughput/fit wall default 25%, compile metrics 50%
+(compiles are coarser-grained and noisier); ``--threshold PCT``
+overrides all four, ``BENCH_GATE_THRESHOLD`` likewise from the
+environment.  Rounds whose artifacts predate a metric (the metrics
+block landed in PR 1) simply don't contribute baseline samples for it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+from typing import Any, Dict, List, Optional
+
+DEFAULT_GLOB = "BENCH_r*.json"
+
+#                 name            direction      default threshold %
+METRICS = [
+    ("throughput", "higher_better", 25.0),
+    ("fit_wall_s", "lower_better", 25.0),
+    ("compile_s_total", "lower_better", 50.0),
+    ("jit_compiles", "lower_better", 50.0),
+]
+
+
+def _round_number(path: str) -> int:
+    m = re.search(r"r(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def _headline_from_lines(text: str) -> Optional[dict]:
+    """Last parseable JSON object line — bench.py's contract is that
+    consumers read the LAST line (earlier lines are partial records)."""
+    headline = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            headline = obj
+    return headline
+
+
+def load_round(path: str) -> Dict[str, Any]:
+    """One round's ``{"round", "rc", "headline"}`` from either artifact
+    shape (driver wrapper or raw JSON-lines dump)."""
+    with open(path) as f:
+        text = f.read()
+    rc: Optional[int] = None
+    headline: Optional[dict] = None
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        obj = None
+    if isinstance(obj, dict) and ("parsed" in obj or "tail" in obj):
+        rc = obj.get("rc")
+        headline = obj.get("parsed")
+        if headline is None and isinstance(obj.get("tail"), str):
+            headline = _headline_from_lines(obj["tail"])
+    elif isinstance(obj, dict) and "metric" in obj:
+        headline = obj
+    else:
+        headline = _headline_from_lines(text)
+    return {"round": _round_number(path), "path": path, "rc": rc,
+            "headline": headline}
+
+
+def load_history(directory: str, pattern: str = DEFAULT_GLOB
+                 ) -> List[Dict[str, Any]]:
+    paths = sorted(glob.glob(os.path.join(directory, pattern)),
+                   key=_round_number)
+    return [load_round(p) for p in paths]
+
+
+def extract_metrics(headline: Optional[dict]) -> Dict[str, float]:
+    """The gated metric values present in one headline record.  Absent
+    sources (pre-PR-1 artifacts without a metrics block) are simply
+    missing keys — never fabricated zeros."""
+    out: Dict[str, float] = {}
+    if not isinstance(headline, dict):
+        return out
+    if isinstance(headline.get("value"), (int, float)):
+        out["throughput"] = float(headline["value"])
+    m = headline.get("metrics")
+    if isinstance(m, dict):
+        spans = m.get("spans")
+        if isinstance(spans, dict):
+            fit = spans.get("bench.fit_panel")
+            if isinstance(fit, dict) and fit.get("count"):
+                out["fit_wall_s"] = float(fit.get("p50_s",
+                                                  fit.get("mean_s", 0.0)))
+        if isinstance(m.get("compile_s_total"), (int, float)):
+            out["compile_s_total"] = float(m["compile_s_total"])
+        if isinstance(m.get("jit_compiles"), (int, float)):
+            out["jit_compiles"] = float(m["jit_compiles"])
+    return out
+
+
+def comparable(r: Dict[str, Any], platform) -> bool:
+    h = r["headline"]
+    return (isinstance(h, dict)
+            and isinstance(h.get("value"), (int, float))
+            and r.get("rc") in (0, None)
+            and h.get("platform") == platform)
+
+
+def evaluate(history: List[Dict[str, Any]], *, window: int = 4,
+             min_history: int = 2,
+             threshold_override: Optional[float] = None
+             ) -> Dict[str, Any]:
+    """Compare the newest round against the trailing median of its
+    comparable predecessors.  Returns the verdict structure the CLI
+    renders; ``status`` is ``"pass"``, ``"regressed"``, or
+    ``"insufficient-history"``."""
+    if not history:
+        return {"status": "insufficient-history", "rows": [],
+                "note": "no BENCH_r*.json rounds found"}
+    newest = history[-1]
+    h = newest["headline"]
+    # a crashed or valueless newest round is itself the regression the
+    # gate exists to catch — it must never slide through as "nothing to
+    # compare" (bench.py emits value=null when the first fit dies)
+    if newest.get("rc") not in (0, None) \
+            or not isinstance(h, dict) \
+            or not isinstance(h.get("value"), (int, float)):
+        return {"status": "regressed", "rows": [],
+                "round": newest["round"],
+                "note": f"newest round r{newest['round']:02d} crashed or "
+                        f"has no measured headline value "
+                        f"(rc={newest.get('rc')})"}
+    platform = h.get("platform")
+    prior = [r for r in history[:-1] if comparable(r, platform)]
+    if len(prior) < min_history:
+        return {"status": "insufficient-history", "rows": [],
+                "note": f"{len(prior)} comparable prior round(s) on "
+                        f"platform {platform!r}, need {min_history}"}
+    baseline_rounds = prior[-window:]
+    new_vals = extract_metrics(h)
+    base_metrics = [extract_metrics(r["headline"]) for r in baseline_rounds]
+
+    rows = []
+    regressed = False
+    for name, direction, default_thr in METRICS:
+        thr = threshold_override if threshold_override is not None \
+            else default_thr
+        base_samples = [m[name] for m in base_metrics if name in m]
+        row: Dict[str, Any] = {"metric": name, "threshold_pct": thr,
+                               "n_baseline": len(base_samples)}
+        if name not in new_vals:
+            row.update(status="skipped", note="absent in newest round")
+            rows.append(row)
+            continue
+        if len(base_samples) < min_history:
+            row.update(status="skipped", value=new_vals[name],
+                       note=f"{len(base_samples)} baseline sample(s), "
+                            f"need {min_history}")
+            rows.append(row)
+            continue
+        base = statistics.median(base_samples)
+        value = new_vals[name]
+        row.update(value=value, baseline=base)
+        if base == 0:
+            # a 0 baseline admits no percentage; only flag a lower-better
+            # metric that became nonzero from an all-zero history
+            worse = direction == "lower_better" and value > 0
+            row["delta_pct"] = None
+        else:
+            delta = 100.0 * (value - base) / base
+            row["delta_pct"] = round(delta, 1)
+            worse = (delta < -thr if direction == "higher_better"
+                     else delta > thr)
+        row["status"] = "REGRESSED" if worse else "ok"
+        regressed = regressed or worse
+        rows.append(row)
+    return {"status": "regressed" if regressed else "pass",
+            "rows": rows, "round": newest["round"], "platform": platform,
+            "baseline_rounds": [r["round"] for r in baseline_rounds]}
+
+
+def render(verdict: Dict[str, Any]) -> str:
+    lines = []
+    if verdict["status"] == "insufficient-history":
+        lines.append(f"bench gate: PASS (insufficient history: "
+                     f"{verdict['note']})")
+        return "\n".join(lines)
+    if verdict["status"] == "regressed" and not verdict["rows"]:
+        lines.append(f"bench gate: REGRESSED ({verdict['note']})")
+        return "\n".join(lines)
+    lines.append(f"bench gate: round r{verdict['round']:02d} "
+                 f"(platform={verdict['platform']}) vs median of rounds "
+                 f"{['r%02d' % r for r in verdict['baseline_rounds']]}")
+    hdr = (f"{'metric':<17} {'newest':>12} {'baseline':>12} "
+           f"{'delta%':>8} {'thr%':>6}  status")
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+    for row in verdict["rows"]:
+        if row["status"] == "skipped":
+            lines.append(f"{row['metric']:<17} {'-':>12} {'-':>12} "
+                         f"{'-':>8} {row['threshold_pct']:>6.0f}  "
+                         f"skipped ({row['note']})")
+            continue
+        delta = row.get("delta_pct")
+        lines.append(
+            f"{row['metric']:<17} {row['value']:>12.2f} "
+            f"{row['baseline']:>12.2f} "
+            f"{('%+.1f' % delta) if delta is not None else '-':>8} "
+            f"{row['threshold_pct']:>6.0f}  {row['status']}")
+    lines.append(f"bench gate: {verdict['status'].upper()}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=(
+        "Gate the newest BENCH_r*.json round against the trailing median "
+        "of comparable prior rounds; exit 1 on regression."))
+    ap.add_argument("--dir", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), help="directory holding BENCH_r*.json "
+        "(default: repo root)")
+    ap.add_argument("--glob", default=DEFAULT_GLOB,
+                    help=f"artifact glob (default {DEFAULT_GLOB})")
+    ap.add_argument("--window", type=int, default=4,
+                    help="trailing rounds in the baseline median (default 4)")
+    ap.add_argument("--min-history", type=int, default=2,
+                    help="comparable prior rounds required before gating "
+                         "(default 2)")
+    ap.add_argument("--threshold", type=float,
+                    default=(float(os.environ["BENCH_GATE_THRESHOLD"])
+                             if os.environ.get("BENCH_GATE_THRESHOLD")
+                             else None),
+                    help="override every metric's regression threshold "
+                         "(percent; default: per-metric 25/25/50/50)")
+    ap.add_argument("--strict", action="store_true",
+                    help="fail (exit 2) on insufficient history instead of "
+                         "passing")
+    args = ap.parse_args(argv)
+
+    history = load_history(args.dir, args.glob)
+    verdict = evaluate(history, window=args.window,
+                       min_history=args.min_history,
+                       threshold_override=args.threshold)
+    print(render(verdict))
+    if verdict["status"] == "regressed":
+        return 1
+    if verdict["status"] == "insufficient-history" and args.strict:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
