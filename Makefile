@@ -3,19 +3,41 @@
 
 PY ?= python
 
-.PHONY: help verify compileall tier1 verify-faults verify-perf gate trace
+.PHONY: help verify compileall tier1 verify-faults verify-perf gate trace \
+	lint lint-baseline contracts verify-static
 
 help:
 	@echo "Targets:"
-	@echo "  verify        byte-compile the package + tier-1 test sweep"
+	@echo "  verify        byte-compile + sts-lint + tier-1 test sweep"
+	@echo "  lint          sts-lint static analysis (tracer safety, dtype, recompiles)"
+	@echo "  lint-baseline regenerate tools/sts_lint/baseline.json (the debt ledger)"
+	@echo "  contracts     jaxpr/HLO contract checks for all ten fit families"
+	@echo "  verify-static lint + contracts (the full static-analysis gate)"
 	@echo "  verify-faults tier-1 sweep with STS_FAULT_INJECT=1 (retry/fallback paths forced)"
 	@echo "  verify-perf   perf gate: newest BENCH_r*.json vs trailing-median baseline"
 	@echo "  gate          same as verify-perf (tools/bench_gate.py; exit 1 on regression)"
 	@echo "  trace         run a small demo workload, write trace.json (open in ui.perfetto.dev)"
 
 # byte-compile the whole package (catches syntax errors in files the test
-# sweep doesn't import) then run the tier-1 test sweep
-verify: compileall tier1
+# sweep doesn't import), lint it (fast, pure-AST — fails on any new
+# STS0xx finding), then run the tier-1 test sweep
+verify: compileall lint tier1
+
+# Level 1: AST rules over the package (tools/sts_lint; see docs/design.md
+# §6d).  Exit 1 on any finding that is neither suppressed in-source
+# (# sts: noqa[STS0xx]) nor recorded in the checked-in baseline.
+lint:
+	$(PY) -m tools.sts_lint spark_timeseries_tpu
+
+lint-baseline:
+	$(PY) -m tools.sts_lint spark_timeseries_tpu --write-baseline
+
+# Level 2: trace + lower every fit family from ShapeDtypeStructs and
+# assert the no-f64 / no-host-callback / stable-jaxpr contracts.
+contracts:
+	JAX_PLATFORMS=cpu $(PY) -m spark_timeseries_tpu.utils.contracts
+
+verify-static: lint contracts
 
 compileall:
 	$(PY) -m compileall -q spark_timeseries_tpu

@@ -288,7 +288,8 @@ def main():
     import jax
     import jax.numpy as jnp
     from spark_timeseries_tpu.models import arima
-    from spark_timeseries_tpu.utils import costs, metrics, tracing
+    from spark_timeseries_tpu.utils import contracts, costs, metrics, \
+        tracing
 
     # recompile/compile-seconds tracking rides jax.monitoring; when the
     # installed JAX lacks the hooks the stats stay 0 and hooks_installed
@@ -298,6 +299,55 @@ def main():
     # self-disarms after one probe on platforms with no memory stats
     costs.install_device_memory_sampler()
 
+    # static-analysis summary (ISSUE 4): every BENCH record also says
+    # whether the tree it measured was invariant-clean — sts-lint
+    # finding counts plus the jaxpr/HLO contract results.  Lint is a
+    # fast pure-AST pass over the package; contracts trace+lower one
+    # family by default (BENCH_CONTRACT_FAMILIES widens it; "all" =
+    # every family, "" skips).  Computed once, embedded in every record.
+    _static_cache: dict = {}
+
+    def _static_analysis_block() -> dict:
+        if _static_cache:
+            return _static_cache
+        repo = os.path.dirname(os.path.abspath(__file__))
+        block: dict = {}
+        try:
+            if repo not in sys.path:
+                sys.path.insert(0, repo)
+            from tools.sts_lint import (DEFAULT_BASELINE, lint_paths,
+                                        load_baseline)
+            res, _ = lint_paths(
+                [os.path.join(repo, "spark_timeseries_tpu")], root=repo,
+                baseline=load_baseline(DEFAULT_BASELINE))
+            s = res.summary()
+            block["findings"] = s["findings"]
+            block["suppressed"] = s["suppressed"]
+            block["baselined"] = s["baselined"]
+            if s["by_code"]:
+                block["by_code"] = s["by_code"]
+        except Exception as e:      # noqa: BLE001 — optional accounting
+            block["lint_error"] = f"{type(e).__name__}: {e}"
+        fams_env = os.environ.get("BENCH_CONTRACT_FAMILIES", "arima")
+        fams = list(contracts.CONTRACT_FAMILIES) if fams_env == "all" \
+            else [f for f in fams_env.split(",") if f]
+        if fams:
+            try:
+                with metrics.span("bench.contracts"):
+                    rep = contracts.check_all(fams)
+                block["contracts_checked"] = rep["contracts_checked"]
+                block["contracts_failed"] = rep["contracts_failed"]
+                block["contract_families"] = rep["families"]
+                if rep["contracts_failed"]:
+                    block["contract_failures"] = rep["failures"]
+            except Exception as e:  # noqa: BLE001 — optional accounting
+                block["contracts_error"] = f"{type(e).__name__}: {e}"
+        else:
+            block["contracts_checked"] = 0
+            block["contracts_failed"] = 0
+        _static_cache.update(block)
+        return _static_cache
+
     def _metrics_block() -> dict:
         """Why-block for every record: recompiles + compile seconds from
         the jax.monitoring hooks, per-span wall-time stats for every
@@ -305,8 +355,9 @@ def main():
         the jitted fit, so each model family fitted shows up), the
         accumulated fit counter bundles, the top-N slowest individual
         span scopes from the trace ring (the aggregate histograms can't
-        say WHICH round/chunk was slow — these can), and the device
-        memory gauges when the platform reports them."""
+        say WHICH round/chunk was slow — these can), the device
+        memory gauges when the platform reports them, and the
+        static-analysis (lint + contract) summary."""
         snap = metrics.snapshot()
         block = dict(metrics.jax_stats(snap=snap))
         block["spans"] = snap["spans"]
@@ -324,6 +375,7 @@ def main():
                       if k.startswith("device.mem.")}
         if mem_gauges:
             block["device_memory"] = mem_gauges
+        block["static_analysis"] = _static_analysis_block()
         return block
 
     def emit(obj: dict) -> None:

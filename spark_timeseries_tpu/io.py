@@ -224,10 +224,24 @@ def load_csv(path: str) -> Panel:
         data = pd.read_csv(_io.StringIO("\n".join(rests)), header=None,
                            dtype=np.float64,
                            float_precision="round_trip").to_numpy()
-    except ValueError as e:
-        raise ValueError(
-            f"corrupt data.csv: a numeric field failed to parse ({e})"
-        ) from e
+    except (ValueError, TypeError):
+        # tokens beyond double range: pandas round_trip maps "-1e400" to
+        # -inf and "1e-400" to 0, but leaves POSITIVE overflow ("1e400")
+        # as a string in an object column, which the pinned-dtype parse
+        # rejects.  Re-parse unpinned and let numpy's str->f64 cast
+        # finish the job — overflow to +/-inf, underflow to (+/-)0 —
+        # matching java.lang.Double.parseDouble in the reference and the
+        # native codec's strtod fallback (ADVICE r5).  Genuinely
+        # malformed tokens still raise here and fail loudly.
+        try:
+            data = np.asarray(
+                pd.read_csv(_io.StringIO("\n".join(rests)), header=None,
+                            float_precision="round_trip").to_numpy(),
+                dtype=np.float64)
+        except (ValueError, TypeError) as e:
+            raise ValueError(
+                f"corrupt data.csv: a numeric field failed to parse ({e})"
+            ) from e
     _metrics.inc("io.csv_series_loaded", len(keys))
     _metrics.inc("io.csv_bytes_read",
                  os.path.getsize(os.path.join(path, CSV_DATA_FILE)))

@@ -465,10 +465,12 @@ def find_roots(coefficients: Sequence[float]) -> np.ndarray:
     n = coefficients.shape[-1] - 1
     if n < 1:
         return np.zeros((0,), dtype=np.complex128)
-    companion = np.zeros((n, n))
+    # deliberate f64: companion-matrix eigenvalues want full precision
+    # for the |root|<=1 screen; host-only, never enters traced code
+    companion = np.zeros((n, n))                  # sts: noqa[STS004]
     companion[n - 1, :] = -coefficients[:n] / coefficients[n]
     if n > 1:
-        companion[:n - 1, 1:] = np.eye(n - 1)
+        companion[:n - 1, 1:] = np.eye(n - 1)     # sts: noqa[STS004]
     return np.linalg.eigvals(companion)
 
 
@@ -541,10 +543,11 @@ def _all_roots_outside_unit_circle(polys: np.ndarray) -> np.ndarray:
         process = remaining & lead
         if np.any(process):
             sub = flat[process]
-            comp = np.zeros((sub.shape[0], deg, deg))
+            # deliberate f64 (see find_roots): host-side eig screen
+            comp = np.zeros((sub.shape[0], deg, deg))  # sts: noqa[STS004]
             comp[:, deg - 1, :] = -sub[:, :deg] / sub[:, deg:deg + 1]
             if deg > 1:
-                comp[:, :deg - 1, 1:] = np.eye(deg - 1)
+                comp[:, :deg - 1, 1:] = np.eye(deg - 1)  # sts: noqa[STS004]
             roots = np.linalg.eigvals(comp)             # (b, deg)
             ok[process] &= ~np.any(np.abs(roots) <= 1.0, axis=-1)
         remaining &= ~lead
@@ -692,7 +695,8 @@ class ARIMAModel(NamedTuple):
             shape = coefs.shape[:-1]
             return np.ones(shape, bool) if shape else True
         phi = np.asarray(self.ar_coefficients)
-        ones = np.ones((*phi.shape[:-1], 1))
+        # leading 1.0 of the f64 host-side characteristic polynomial
+        ones = np.ones((*phi.shape[:-1], 1))      # sts: noqa[STS004]
         return _all_roots_outside_unit_circle(
             np.concatenate([ones, -phi], axis=-1))
 
@@ -704,7 +708,8 @@ class ARIMAModel(NamedTuple):
             shape = coefs.shape[:-1]
             return np.ones(shape, bool) if shape else True
         theta = np.asarray(self.ma_coefficients)
-        ones = np.ones((*theta.shape[:-1], 1))
+        # leading 1.0 of the f64 host-side characteristic polynomial
+        ones = np.ones((*theta.shape[:-1], 1))    # sts: noqa[STS004]
         return _all_roots_outside_unit_circle(
             np.concatenate([ones, theta], axis=-1))
 

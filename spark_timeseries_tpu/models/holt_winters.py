@@ -36,10 +36,12 @@ from .base import (FitDiagnostics, diagnostics_from, normal_quantile,
 def _kernel(period: int) -> np.ndarray:
     """Centered moving-average weights (ref ``HoltWinters.scala:228-237``)."""
     if period % 2 == 0:
-        k = np.full(period + 1, 1.0 / period)
+        # host-built constant; the only caller converts with
+        # jnp.asarray(_kernel(period), ts.dtype), so f64 never leaks
+        k = np.full(period + 1, 1.0 / period)    # sts: noqa[STS004]
         k[0] = k[-1] = 0.5 / period
         return k
-    return np.full(period, 1.0 / period)
+    return np.full(period, 1.0 / period)         # sts: noqa[STS004]
 
 
 class HoltWintersModel(NamedTuple):

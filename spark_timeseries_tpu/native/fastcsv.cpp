@@ -31,6 +31,7 @@
 
 #include <charconv>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 
 namespace {
@@ -112,7 +113,10 @@ long long sts_format_csv(const char* keys, long long keys_len,
 // key_spans (rows_cap x 2, [start, end) byte offsets of each raw key
 // token).  Empty lines are skipped; a trailing '\r' per line is
 // tolerated.  Returns the number of rows parsed, or a negative code:
-//   -1  field is not a well-formed double (empty fields included)
+//   -1  field is not a well-formed double (empty fields included);
+//       well-formed tokens beyond double range do NOT error: overflow
+//       parses as +/-inf and underflow as (+/-)0, matching the pandas
+//       round_trip fallback codec (ADVICE r5)
 //   -2  a row's field count differs from `cols`
 //   -4  more than rows_cap data rows
 // On error, err_row receives the offending 0-based data-row index.
@@ -140,6 +144,31 @@ long long sts_parse_csv(const char* text, long long len, long long rows_cap,
             const char* fe = cm ? static_cast<const char*>(cm) : le;
             if (c >= cols) { *err_row = r; return -2; }
             auto res = std::from_chars(f, fe, row[c]);
+            if (res.ec == std::errc::result_out_of_range &&
+                res.ptr == fe) {
+                // ADVICE r5: a well-formed token whose magnitude escapes
+                // double range ("1e400", "-4e-400") must match the pandas
+                // round_trip fallback — overflow parses as +/-inf,
+                // underflow as (+/-)0 — not abort the row.  from_chars
+                // leaves the value unset on out_of_range, so re-parse
+                // with strtod, whose C-standard mapping is exactly that
+                // (+/-HUGE_VAL on overflow, magnitude <= DBL_MIN on
+                // underflow).  Bounded stack copy keeps this path
+                // allocation-free; a pathological >511-char token (or a
+                // non-C decimal locale) falls through to the loud -1.
+                char buf[512];
+                size_t tok_len = static_cast<size_t>(fe - f);
+                if (tok_len < sizeof(buf)) {
+                    memcpy(buf, f, tok_len);
+                    buf[tok_len] = '\0';
+                    char* endp = nullptr;
+                    double v = strtod(buf, &endp);
+                    if (endp == buf + tok_len) {
+                        row[c] = v;
+                        res.ec = std::errc();
+                    }
+                }
+            }
             if (res.ec != std::errc() || res.ptr != fe) {
                 *err_row = r;
                 return -1;

@@ -95,7 +95,12 @@ def resample(values, source_index: DateTimeIndex, target_index: DateTimeIndex,
         # host fallback: contiguous bucket ranges, arbitrary aggregator
         arr = np.asarray(values)
         m = tgt.size
-        out = np.full((*arr.shape[:-1], m), np.nan)
+        # preserve a float input's width: the device path (_seg_reduce)
+        # keeps f32 panels f32, and the host fallback must agree rather
+        # than silently widening to numpy's f64 default (sts-lint STS004)
+        out_dtype = arr.dtype if np.issubdtype(arr.dtype, np.floating) \
+            else np.float64
+        out = np.full((*arr.shape[:-1], m), np.nan, dtype=out_dtype)
         flat = arr.reshape(-1, arr.shape[-1])
         out_flat = out.reshape(-1, m)
         valid = bucket >= 0

@@ -1,0 +1,212 @@
+"""Level-2 static analysis: jaxpr/HLO contract checks (ISSUE 4).
+
+Per-family no-f64 / no-host-callback / stable-jaxpr assertions on CPU,
+plus unit tests of the detectors themselves on hand-built programs (the
+positive cases a healthy tree can't provide).  The conftest enables x64
+for reference parity, so the real no-f64 sweep runs under
+``jax.experimental.disable_x64`` — the production (default) config the
+contract is defined against.
+
+The three GARCH-family fits trace slowly (~5-6 s each); their sweeps
+carry the ``slow`` marker and run outside tier-1 via
+``make verify-static`` / ``python -m spark_timeseries_tpu.utils.contracts``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu.utils import contracts
+
+FAST_FAMILIES = ("arima", "arimax", "ar", "arx", "ewma", "holt_winters",
+                 "regression_arima")
+SLOW_FAMILIES = ("garch", "argarch", "egarch")
+
+
+def _assert_all_ok(results):
+    bad = [r for r in results if not r.ok]
+    assert not bad, [f"{r.contract}/{r.family}: {r.detail}" for r in bad]
+
+
+# ---------------------------------------------------------------------------
+# padding buckets
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("raw,expected", [
+    ((1, 1), (8, 32)),
+    ((8, 64), (8, 64)),          # already bucketed: identity
+    ((9, 65), (16, 96)),
+    ((5, 50), (8, 64)),          # the stability check's shape_a
+    ((8, 61), (8, 64)),          # ...and shape_b: same bucket by design
+    ((1000, 128), (1024, 128)),
+])
+def test_pad_bucket(raw, expected):
+    assert contracts.pad_bucket(*raw) == expected
+
+
+def test_pad_bucket_monotone_and_idempotent():
+    for s, t in [(3, 17), (70, 999), (129, 32)]:
+        ps, pt = contracts.pad_bucket(s, t)
+        assert ps >= s and pt >= t
+        assert contracts.pad_bucket(ps, pt) == (ps, pt)
+
+
+# ---------------------------------------------------------------------------
+# fingerprints
+# ---------------------------------------------------------------------------
+
+def test_fingerprint_masks_object_addresses():
+    """Regression for the garch/argarch false instability: jax embeds
+    `jvp_jaxpr_thunk=<function ... at 0x...>` reprs in custom_jvp_call
+    params; two traces of the same program must fingerprint equally."""
+    class FakeJaxpr:
+        def __init__(self, addr):
+            self.addr = addr
+
+        def __str__(self):
+            return ("{ lambda ; a. let b = custom_jvp_call["
+                    f"jvp_jaxpr_thunk=<function _memoize.<locals>."
+                    f"memoized at {self.addr}>] a in (b,) }}")
+
+    fp1 = contracts.jaxpr_fingerprint(FakeJaxpr("0x7f0000001000"))
+    fp2 = contracts.jaxpr_fingerprint(FakeJaxpr("0x7f0000002abc"))
+    assert fp1 == fp2
+
+
+def test_fingerprint_distinguishes_programs():
+    a = contracts.trace_family("ewma", 8, 64)
+    b = contracts.trace_family("ewma", 16, 64)
+    assert contracts.jaxpr_fingerprint(a) != contracts.jaxpr_fingerprint(b)
+
+
+# ---------------------------------------------------------------------------
+# detector unit tests on hand-built programs (seeded positives)
+# ---------------------------------------------------------------------------
+
+def test_wide_dtype_detector_fires():
+    # conftest has x64 on, so a f64 convert is buildable in-process
+    def leaky(x):
+        return x.astype(jnp.float64) * 2.0
+
+    closed = jax.make_jaxpr(leaky)(
+        jax.ShapeDtypeStruct((4,), jnp.float32))
+    hits = contracts._wide_vars(closed.jaxpr)
+    assert hits and any("float64" in h for h in hits)
+
+
+def test_callback_detector_fires_on_debug_print():
+    def chatty(x):
+        jax.debug.print("x={x}", x=x)
+        return x * 2
+
+    closed = jax.make_jaxpr(chatty)(
+        jax.ShapeDtypeStruct((4,), jnp.float32))
+    prim_hits = [eqn.primitive.name
+                 for eqn in contracts._iter_eqns(closed.jaxpr)
+                 if any(m in eqn.primitive.name
+                        for m in contracts._CALLBACK_PRIMITIVES)]
+    assert prim_hits, "debug_callback not detected in jaxpr"
+
+
+def test_callback_detector_recurses_into_scan_body():
+    def chatty_scan(xs):
+        def step(c, x):
+            jax.debug.print("c={c}", c=c)
+            return c + x, c
+        return jax.lax.scan(step, jnp.float32(0), xs)
+
+    closed = jax.make_jaxpr(chatty_scan)(
+        jax.ShapeDtypeStruct((4,), jnp.float32))
+    prim_hits = [eqn.primitive.name
+                 for eqn in contracts._iter_eqns(closed.jaxpr)
+                 if "callback" in eqn.primitive.name
+                 or "debug" in eqn.primitive.name]
+    assert prim_hits, "callback inside scan body not detected"
+
+
+def test_no_f64_skips_under_x64():
+    # the conftest config: deliberately x64-on — the contract must
+    # report itself not-applicable rather than fail
+    assert jax.config.jax_enable_x64
+    r = contracts.check_no_float64("ewma")
+    assert r.ok and "skipped" in r.detail
+
+
+def test_stability_rejects_cross_bucket_shapes():
+    r = contracts.check_jaxpr_stability("ewma", shape_a=(5, 50),
+                                        shape_b=(100, 50))
+    assert not r.ok and "different buckets" in r.detail
+
+
+def test_unknown_family_fails_all_contracts_with_reason():
+    results = contracts.check_family("no_such_family")
+    assert len(results) == 3
+    assert all(not r.ok for r in results)
+    assert all("trace failed" in r.detail for r in results)
+
+
+# ---------------------------------------------------------------------------
+# the real sweep, fast families (slow GARCH trio runs via make
+# verify-static / the slow marker)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("family", FAST_FAMILIES)
+def test_contracts_hold(family):
+    from jax.experimental import disable_x64
+    with disable_x64():          # the default config the contract names
+        _assert_all_ok(contracts.check_family(family))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family", SLOW_FAMILIES)
+def test_contracts_hold_slow(family):
+    from jax.experimental import disable_x64
+    with disable_x64():
+        _assert_all_ok(contracts.check_family(family))
+
+
+def test_check_all_summary_schema():
+    rep = contracts.check_all(["ewma"], n_series=8, n_obs=64)
+    assert rep["contracts_checked"] == 3
+    assert rep["contracts_failed"] == 0
+    assert rep["families"] == ["ewma"]
+    assert rep["platform"] == "cpu"
+    assert isinstance(rep["x64"], bool)
+    assert len(rep["results"]) == 3
+    for r in rep["results"]:
+        assert {"contract", "family", "ok", "detail"} <= set(r)
+
+
+def test_check_all_counts_failures():
+    rep = contracts.check_all(["no_such_family", "ewma"])
+    assert rep["contracts_checked"] == 6
+    assert rep["contracts_failed"] == 3
+    assert len(rep["failures"]) == 3
+
+
+# ---------------------------------------------------------------------------
+# regression: the resample host-fallback dtype fix (the first violation
+# sts-lint surfaced in the existing tree, ISSUE 4 acceptance criterion)
+# ---------------------------------------------------------------------------
+
+def test_resample_host_fallback_preserves_float32():
+    """STS004 catch: the callable-aggregator host path built its output
+    with numpy's f64 default while the device path preserves f32 — the
+    two codepaths disagreed on dtype for the same inputs."""
+    from spark_timeseries_tpu.ops import resample
+    from spark_timeseries_tpu.time import (DayFrequency, datetime_to_nanos,
+                                           uniform)
+    import datetime as dt
+    t0 = datetime_to_nanos(dt.datetime(2015, 4, 10,
+                                       tzinfo=dt.timezone.utc))
+    src_ix = uniform(t0, 4, DayFrequency(1))
+    tgt_ix = uniform(t0, 2, DayFrequency(2))
+    vals = np.array([1.0, 2.0, 3.0, 4.0], dtype=np.float32)
+
+    host = resample(vals, src_ix, tgt_ix,
+                    lambda arr, s, e: float(arr[s:e].mean()))
+    device = resample(vals, src_ix, tgt_ix, "mean")
+    assert np.asarray(host).dtype == np.float32
+    assert np.asarray(host).dtype == np.asarray(device).dtype
+    np.testing.assert_allclose(np.asarray(host), np.asarray(device))

@@ -288,6 +288,27 @@ def test_load_csv_handles_nan_and_scale(tmp_path, csv_path_mode):
     np.testing.assert_allclose(np.asarray(back.values), vals)
 
 
+def test_load_csv_out_of_range_tokens(tmp_path, csv_path_mode):
+    # ADVICE r5: well-formed tokens beyond double range must parse like
+    # the pandas round_trip codec — overflow to +/-inf, underflow to
+    # (+/-)0 — through BOTH codecs, not abort the row.  (The native
+    # parser maps std::from_chars result_out_of_range via strtod; this
+    # runs wherever the toolchain can build the .so and documents the
+    # shared contract meanwhile.)
+    from spark_timeseries_tpu.time import uniform
+    from spark_timeseries_tpu.time.frequency import DayFrequency
+
+    d = tmp_path / "p"
+    d.mkdir()
+    (d / "timeIndex").write_text(
+        uniform("2020-01-01T00:00Z", 4, DayFrequency(1)).to_string())
+    (d / "data.csv").write_text("a,1e400,-1e400,1e-400,-4e-400\n")
+    back = stio.load_csv(str(d))
+    got = np.asarray(back.values, np.float64)[0]
+    assert got[0] == np.inf and got[1] == -np.inf
+    assert got[2] == 0.0 and got[3] == 0.0
+
+
 def test_load_csv_rejects_corruption(tmp_path, csv_path_mode):
     # a truncated row or an empty field must fail loudly, not NaN-fill
     from spark_timeseries_tpu.time import uniform

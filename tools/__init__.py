@@ -1,0 +1,2 @@
+# tools/ is a package so `python -m tools.sts_lint` works from the repo
+# root (bench_gate stays runnable as a plain script).

@@ -1,0 +1,34 @@
+"""sts-lint: JAX-aware static analysis for the spark_timeseries_tpu tree.
+
+Level 1 of the two-level checking stack (level 2 is
+``spark_timeseries_tpu.utils.contracts``, which checks what actually
+lowers).  This package runs AST rules over the source and enforces the
+invariants the last three PRs only promised in prose:
+
+- ``STS001`` host-sync / impure calls reachable from traced code
+  (``float()``/``int()``/``.item()``/``np.asarray``/``time.time()``/
+  ``print`` inside ``jit``/``vmap``/``scan``/``while_loop`` bodies);
+- ``STS002`` metrics / span / registry calls inside traced code (the
+  PR 1 "tracer-safe observability" promise, now machine-checked);
+- ``STS003`` implicit-float array creation in ``ops/`` and ``models/``
+  (``jnp.zeros(shape)`` with no ``dtype=`` flips to f64 under x64);
+- ``STS004`` numpy float64 creation in device code paths (silent
+  promotion under x64);
+- ``STS005`` Python-level branching on tracer-typed values;
+- ``STS006`` recompile hazards: ``jax.jit`` of a fresh lambda/closure
+  per call (defeats the global jit cache — every call retraces).
+
+Suppression: append ``# sts: noqa[STS0xx]`` (or bare ``# sts: noqa``)
+to the offending line.  Known-and-accepted findings live in the
+checked-in baseline (``tools/sts_lint/baseline.json``); only *new*
+findings fail the build.  ``python -m tools.sts_lint --help`` for the
+CLI; ``make lint`` / ``make verify-static`` are the canonical entry
+points.
+"""
+
+from .engine import (Finding, LintResult, lint_paths, load_baseline,
+                     write_baseline, DEFAULT_BASELINE)
+from .rules import RULES
+
+__all__ = ["Finding", "LintResult", "lint_paths", "load_baseline",
+           "write_baseline", "DEFAULT_BASELINE", "RULES"]

@@ -1,0 +1,111 @@
+"""CLI: ``python -m tools.sts_lint [paths ...]``.
+
+Exit 0 when every finding is suppressed or baselined; exit 1 on any new
+finding (or parse error).  ``--write-baseline`` regenerates the debt
+ledger instead of failing.  ``--json PATH`` writes the full machine
+report (the block ``bench.py`` embeds); ``-`` writes it to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .engine import (DEFAULT_BASELINE, lint_paths, load_baseline,
+                     write_baseline)
+from .rules import RULES
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="sts-lint",
+        description="JAX-aware static analysis for spark_timeseries_tpu "
+                    "(tracer safety, dtype discipline, recompile "
+                    "stability).")
+    ap.add_argument("paths", nargs="*", default=["spark_timeseries_tpu"],
+                    help="files or directories to lint "
+                         "(default: spark_timeseries_tpu)")
+    ap.add_argument("--root", default=None,
+                    help="path findings are reported relative to "
+                         "(default: cwd)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON (debt ledger) to match against")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: every finding is new")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from this run's "
+                         "findings and exit 0")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule codes to run "
+                         "(default: all)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="write the JSON report here ('-' = stdout)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress per-finding lines (summary only)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for code, rule in sorted(RULES.items()):
+            print(f"{code}  {rule.name:24s} {rule.summary}")
+        return 0
+
+    select = None
+    if args.select:
+        select = [c.strip().upper() for c in args.select.split(",")]
+        unknown = [c for c in select if c not in RULES]
+        if unknown:
+            ap.error(f"unknown rule code(s): {', '.join(unknown)}")
+
+    baseline = {} if (args.no_baseline or args.write_baseline) \
+        else load_baseline(args.baseline)
+    result, sources = lint_paths(args.paths, root=args.root,
+                                 baseline=baseline, select=select)
+
+    if args.write_baseline:
+        if result.parse_errors:
+            # an unparseable file's findings would silently vanish from
+            # the ledger — refuse to write an incomplete baseline
+            for e in result.parse_errors:
+                print(f"PARSE ERROR: {e}", file=sys.stderr)
+            print("sts-lint: baseline NOT written (fix parse errors "
+                  "first)", file=sys.stderr)
+            return 1
+        entries = write_baseline(args.baseline, result, sources)
+        print(f"sts-lint: baseline written to {args.baseline} "
+              f"({len(entries)} fingerprints, "
+              f"{sum(entries.values())} findings)")
+        return 0
+
+    # keep stdout machine-clean when the JSON report streams there
+    human_out = sys.stderr if args.json_out == "-" else sys.stdout
+    if not args.quiet:
+        for f in result.new:
+            print(f.render(), file=human_out)
+        for e in result.parse_errors:
+            print(f"PARSE ERROR: {e}", file=sys.stderr)
+
+    if args.json_out:
+        payload = json.dumps(result.to_json(), indent=1)
+        if args.json_out == "-":
+            print(payload)
+        else:
+            os.makedirs(os.path.dirname(args.json_out) or ".",
+                        exist_ok=True)
+            with open(args.json_out, "w", encoding="utf-8") as fh:
+                fh.write(payload + "\n")
+
+    s = result.summary()
+    print(f"sts-lint: {s['files_scanned']} files, "
+          f"{s['findings']} new finding(s), "
+          f"{s['suppressed']} suppressed, {s['baselined']} baselined"
+          + (f"; by code: {s['by_code']}" if s["by_code"] else ""),
+          file=human_out)
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,646 @@
+"""Semantic model behind the sts-lint rules.
+
+The rules need one non-local fact about every function in a module: *does
+its body run under a JAX trace?*  A function is **traced** when it is
+
+- decorated with ``jit`` (directly or via ``functools.partial``),
+- passed to a JAX transform (``jit``/``vmap``/``grad``/``lax.scan``/
+  ``lax.while_loop``/``lax.cond``/``pallas_call``/...),
+- passed to a *transformer parameter* of another function — a parameter
+  that function (transitively) hands to a transform.  This is how the
+  model objectives reach the optimizers: ``models/arima.py`` passes a
+  residual closure to ``ops.optimize.minimize_least_squares``, whose
+  ``solve_one`` vmaps it — so the closure is traced even though no
+  transform appears near its definition, or
+- referenced by name inside an already-traced function (helpers called
+  from traced code trace too).
+
+The computation is a whole-lint-run fixpoint over every parsed module:
+transform call sites seed the traced set and the transformer-parameter
+sets; name references inside traced functions grow the traced set; a
+parameter of an enclosing function referenced inside a traced nested
+function marks the *enclosing* function as a transformer in that
+parameter (the ``minimize_bfgs(fn, ...)`` shape).  Cross-module calls
+resolve through each module's import table into a global registry keyed
+by ``(module basename, function name)``.
+
+This is a linter's model, not an interpreter's: aliasing is tracked only
+through simple ``name = other_name`` assignments, return values are not
+tracked, and attribute-stored callables are invisible.  Misses
+under-report (a finding never fires in code the model cannot see);
+over-reporting is bounded by the name-reference closure being restricted
+to *defined functions*, never arbitrary data.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+# canonical transform name -> positions of function-valued args whose
+# bodies run under trace (variadic branch-taking forms live in
+# TRANSFORM_VARIADIC below)
+TRANSFORM_POSITIONS: Dict[str, Tuple[int, ...]] = {
+    "jax.jit": (0,),
+    "jax.vmap": (0,),
+    "jax.pmap": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.hessian": (0,),
+    "jax.jacfwd": (0,),
+    "jax.jacrev": (0,),
+    "jax.linearize": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+    "jax.custom_jvp": (0,),
+    "jax.custom_vjp": (0,),
+    "jax.lax.scan": (0,),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.map": (0,),
+    "jax.lax.associative_scan": (0,),
+    "jax.lax.custom_root": (0, 1, 2),
+    "jax.experimental.pallas.pallas_call": (0,),
+    "jax.experimental.shard_map.shard_map": (0,),
+}
+# cond/switch: every function-valued operand from position 1 is a branch
+TRANSFORM_VARIADIC: Dict[str, int] = {
+    "jax.lax.cond": 1,
+    "jax.lax.switch": 1,
+}
+
+# attribute accesses on a tracer that yield *static* Python values —
+# taint does not flow through these (branching on x.ndim is fine)
+STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "sharding",
+                          "aval", "weak_type"})
+
+
+def canonical_tail(canon: str) -> str:
+    """Strip relative-import dots so suffix matching works uniformly."""
+    return canon.lstrip(".")
+
+
+class FuncInfo:
+    """One function (def or lambda) plus the analysis state hung off it."""
+
+    __slots__ = ("node", "module", "qualname", "name", "params", "parent",
+                 "transformer_params", "static_params", "traced",
+                 "traced_via", "traced_root", "instrumented",
+                 "local_funcs", "is_lambda", "decorators")
+
+    def __init__(self, node: ast.AST, module: "ModuleModel",
+                 qualname: str, parent: Optional["FuncInfo"]):
+        self.node = node
+        self.module = module
+        self.qualname = qualname
+        self.parent = parent
+        self.is_lambda = isinstance(node, ast.Lambda)
+        self.name = "<lambda>" if self.is_lambda else node.name
+        self.params = _param_names(node.args)
+        self.transformer_params: Set[str] = set()
+        self.static_params: Set[str] = set()
+        self.traced = False
+        self.traced_via: Optional[str] = None
+        # a *root* receives tracer arguments directly (transform target /
+        # objective passed into a transformer param); a non-root merely
+        # runs at trace time because traced code references it — its
+        # params are only tracers if a tainted value visibly flows in
+        self.traced_root = False
+        # wrapped by utils.metrics.instrument_fit — its plain call form
+        # opens a span, so traced code must go through .__wrapped__
+        self.instrumented = False
+        self.local_funcs: Dict[str, "FuncInfo"] = {}
+        self.decorators = [] if self.is_lambda else list(node.decorator_list)
+
+    def mark_traced(self, via: str, root: bool = True) -> bool:
+        if self.traced:
+            if root and not self.traced_root:
+                self.traced_root = True
+                self.traced_via = via
+                return True
+            return False
+        self.traced = True
+        self.traced_root = root
+        self.traced_via = via
+        return True
+
+    def scope_chain(self) -> Iterator["FuncInfo"]:
+        f: Optional[FuncInfo] = self
+        while f is not None:
+            yield f
+            f = f.parent
+
+    def resolve_local(self, name: str) -> Optional["FuncInfo"]:
+        """Innermost-scope-first lookup of a locally defined function."""
+        for scope in self.scope_chain():
+            if name in scope.local_funcs:
+                return scope.local_funcs[name]
+        return self.module.module_funcs.get(name)
+
+
+def _param_names(args: ast.arguments) -> List[str]:
+    names = [a.arg for a in args.posonlyargs] + [a.arg for a in args.args]
+    # kwonly params participate in keyword matching; *args/**kwargs don't
+    # carry individual identities worth tracking
+    return names + [a.arg for a in args.kwonlyargs]
+
+
+def iter_scope(fn_node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function's *own* execution scope: its body, excluding the
+    bodies of nested defs/lambdas (their code runs when *they* run).
+    Nested def/lambda nodes themselves are yielded (they are statements
+    of this scope) — just not descended into."""
+    body = fn_node.body if not isinstance(fn_node, ast.Lambda) \
+        else [fn_node.body]
+    stack: List[ast.AST] = list(body) if isinstance(body, list) else [body]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # decorators and default-arg expressions evaluate here
+            if not isinstance(node, ast.Lambda):
+                stack.extend(node.decorator_list)
+                stack.extend(d for d in node.args.defaults)
+                stack.extend(d for d in node.args.kw_defaults if d)
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class ModuleModel:
+    """Parsed module + import table + function index."""
+
+    def __init__(self, path: str, relpath: str, source: str,
+                 tree: ast.Module):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.tree = tree
+        self.aliases: Dict[str, str] = {}       # local name -> dotted canon
+        self.module_funcs: Dict[str, FuncInfo] = {}
+        self.functions: List[FuncInfo] = []     # every def/lambda, any depth
+        self.func_of_node: Dict[ast.AST, FuncInfo] = {}
+        self._index()
+
+    # -- import table -----------------------------------------------------
+    def _index(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+                    if a.asname:
+                        self.aliases[a.asname] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                base = ("." * node.level) + (node.module or "")
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    canon = f"{base}.{a.name}" if base else a.name
+                    self.aliases[a.asname or a.name] = canon
+        self._index_module_scope()
+
+    def _index_module_scope(self) -> None:
+        # descend through module-level control flow and class bodies, but
+        # never into a function body — functions register themselves and
+        # recurse via iter_scope
+        stack: List[Tuple[ast.AST, str]] = [
+            (n, "") for n in ast.iter_child_nodes(self.tree)]
+        while stack:
+            node, prefix = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                self._register(node, None, prefix)
+            elif isinstance(node, ast.ClassDef):
+                stack.extend((c, f"{prefix}{node.name}.")
+                             for c in ast.iter_child_nodes(node))
+            else:
+                stack.extend((c, prefix)
+                             for c in ast.iter_child_nodes(node))
+
+    def _register(self, node: ast.AST, parent: Optional[FuncInfo],
+                  prefix: str) -> None:
+        if node in self.func_of_node:
+            return
+        name = "<lambda>" if isinstance(node, ast.Lambda) else node.name
+        qual = f"{prefix}{name}" if parent is None \
+            else f"{parent.qualname}.{name}"
+        info = FuncInfo(node, self, qual, parent)
+        self.functions.append(info)
+        self.func_of_node[node] = info
+        if not info.is_lambda:
+            if parent is None and not prefix:
+                self.module_funcs.setdefault(name, info)
+            elif parent is not None:
+                parent.local_funcs[name] = info
+        for child in iter_scope(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                self._register(child, info, prefix="")
+
+    # -- name resolution --------------------------------------------------
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted canonical name of a Name/Attribute chain, with the base
+        segment rewritten through the import table.  None for anything
+        that is not a plain dotted chain."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = self.aliases.get(node.id, node.id)
+        parts.append(base)
+        return ".".join(reversed(parts))
+
+
+class Project:
+    """All parsed modules + the cross-module traced-function fixpoint."""
+
+    def __init__(self, modules: List[ModuleModel]):
+        self.modules = modules
+        self._param_taint: Optional[Dict[FuncInfo, Set[str]]] = None
+        # (module basename, function name) -> FuncInfo, for cross-module
+        # call resolution through import tails.  Collisions keep the first
+        # registration and merge transformer params conservatively.
+        self.registry: Dict[Tuple[str, str], FuncInfo] = {}
+        for m in modules:
+            base = m.relpath.rsplit("/", 1)[-1].removesuffix(".py")
+            for name, fi in m.module_funcs.items():
+                self.registry.setdefault((base, name), fi)
+        self._fixpoint()
+
+    # -- cross-module lookup ---------------------------------------------
+    def lookup(self, canon: Optional[str], scope: Optional[FuncInfo],
+               module: ModuleModel) -> Optional[FuncInfo]:
+        if canon is None:
+            return None
+        tail = canonical_tail(canon).split(".")
+        if len(tail) == 1:
+            if scope is not None:
+                hit = scope.resolve_local(tail[0])
+                if hit is not None:
+                    return hit
+            return module.module_funcs.get(tail[0])
+        return self.registry.get((tail[-2], tail[-1]))
+
+    # -- fixpoint ---------------------------------------------------------
+    def _fixpoint(self, max_rounds: int = 25) -> None:
+        for m in self.modules:
+            for fi in m.functions:
+                self._seed_decorators(fi)
+        for _ in range(max_rounds):
+            changed = False
+            for m in self.modules:
+                for fi in m.functions:
+                    changed |= self._scan_calls(fi)
+            for m in self.modules:
+                for fi in m.functions:
+                    if fi.traced:
+                        changed |= self._propagate_traced(fi)
+            if not changed:
+                return
+
+    def _seed_decorators(self, fi: FuncInfo) -> None:
+        for dec in fi.decorators:
+            canon = fi.module.resolve(dec if not isinstance(dec, ast.Call)
+                                      else dec.func)
+            tail = canonical_tail(canon) if canon else ""
+            if tail.split(".")[-1] == "instrument_fit":
+                fi.instrumented = True
+            if tail in TRANSFORM_POSITIONS and tail != \
+                    "jax.experimental.pallas.pallas_call":
+                fi.mark_traced(f"@{tail}")
+                if isinstance(dec, ast.Call):
+                    self._record_statics(fi, dec)
+            elif isinstance(dec, ast.Call) and tail in (
+                    "functools.partial", "partial") and dec.args:
+                inner = fi.module.resolve(dec.args[0])
+                if inner and canonical_tail(inner) in TRANSFORM_POSITIONS:
+                    fi.mark_traced(f"@partial({canonical_tail(inner)})")
+                    self._record_statics(fi, dec)
+
+    def _record_statics(self, fi: FuncInfo, call: ast.Call) -> None:
+        """static_argnums/static_argnames from a visible jit(...) call —
+        those parameters are Python values, not tracers (STS005 must not
+        taint them)."""
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for n in _const_strings(kw.value):
+                    fi.static_params.add(n)
+            elif kw.arg == "static_argnums":
+                for i in _const_ints(kw.value):
+                    if 0 <= i < len(fi.params):
+                        fi.static_params.add(fi.params[i])
+
+    def _param_aliases(self, fi: FuncInfo) -> Dict[str, str]:
+        """name -> param it aliases, through simple assignments."""
+        out = {p: p for p in fi.params}
+        for node in iter_scope(fi.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Name):
+                src = out.get(node.value.id)
+                if src is not None:
+                    out[node.targets[0].id] = src
+        return out
+
+    def _traced_arg_positions(self, canon_tail: str,
+                              call: ast.Call) -> List[ast.AST]:
+        args: List[ast.AST] = []
+        if canon_tail in TRANSFORM_POSITIONS:
+            for pos in TRANSFORM_POSITIONS[canon_tail]:
+                if pos < len(call.args):
+                    args.append(call.args[pos])
+        elif canon_tail in TRANSFORM_VARIADIC:
+            args.extend(call.args[TRANSFORM_VARIADIC[canon_tail]:])
+        return args
+
+    def _scan_calls(self, fi: FuncInfo) -> bool:
+        changed = False
+        aliases = self._param_aliases(fi)
+        for node in iter_scope(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            canon = fi.module.resolve(node.func)
+            tail = canonical_tail(canon) if canon else ""
+            fn_args = self._traced_arg_positions(tail, node)
+            is_jit = tail == "jax.jit"
+            if not fn_args:
+                target = self.lookup(canon, fi, fi.module)
+                if target is None or not target.transformer_params:
+                    continue
+                fn_args = []
+                for i, a in enumerate(node.args):
+                    if i < len(target.params) \
+                            and target.params[i] in target.transformer_params:
+                        fn_args.append(a)
+                for kw in node.keywords:
+                    if kw.arg in target.transformer_params:
+                        fn_args.append(kw.value)
+                is_jit = False
+            for arg in fn_args:
+                changed |= self._mark_function_arg(fi, arg, aliases, tail,
+                                                  node if is_jit else None)
+        return changed
+
+    def _mark_function_arg(self, fi: FuncInfo, arg: ast.AST,
+                           aliases: Dict[str, str], via: str,
+                           jit_call: Optional[ast.Call]) -> bool:
+        if isinstance(arg, ast.Lambda):
+            target = fi.module.func_of_node.get(arg)
+            if target is not None:
+                hit = target.mark_traced(via)
+                if hit and jit_call is not None:
+                    self._record_statics(target, jit_call)
+                return hit
+            return False
+        if isinstance(arg, ast.Name):
+            param = aliases.get(arg.id)
+            if param is not None and param in fi.params:
+                if param not in fi.transformer_params:
+                    fi.transformer_params.add(param)
+                    return True
+                return False
+            target = self.lookup(fi.module.resolve(arg), fi, fi.module)
+            if target is not None:
+                hit = target.mark_traced(via)
+                if hit and jit_call is not None:
+                    self._record_statics(target, jit_call)
+                return hit
+        elif isinstance(arg, ast.Attribute):
+            target = self.lookup(fi.module.resolve(arg), fi, fi.module)
+            if target is not None:
+                return target.mark_traced(via)
+        return False
+
+    def _propagate_traced(self, fi: FuncInfo) -> bool:
+        """Inside a traced body: referenced functions trace too, and a
+        reference to an *enclosing* function's parameter marks that
+        parameter as transforming (objectives passed into optimizers)."""
+        changed = False
+        # names appearing as the callee of a call in this traced scope:
+        # the only evidence strong enough to conclude an enclosing
+        # function's parameter is a callable invoked under trace
+        called_names = {n.func.id for n in iter_scope(fi.node)
+                        if isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Name)}
+        for node in iter_scope(fi.node):
+            if isinstance(node, ast.Lambda):
+                target = fi.module.func_of_node.get(node)
+                if target is not None:
+                    changed |= target.mark_traced(
+                        f"defined in traced {fi.qualname}", root=False)
+                continue
+            if not isinstance(node, ast.Name) \
+                    or not isinstance(node.ctx, ast.Load):
+                continue
+            target = fi.resolve_local(node.id)
+            if target is not None:
+                changed |= target.mark_traced(
+                    f"referenced in traced {fi.qualname}", root=False)
+                continue
+            if node.id in fi.params or node.id not in called_names:
+                continue
+            for ancestor in fi.scope_chain():
+                if ancestor is fi:
+                    continue
+                if node.id in ancestor.params \
+                        and node.id not in ancestor.static_params \
+                        and node.id not in ancestor.transformer_params:
+                    ancestor.transformer_params.add(node.id)
+                    changed = True
+                    break
+        return changed
+
+
+    # -- tracer taint -----------------------------------------------------
+    def param_taint(self) -> Dict[FuncInfo, Set[str]]:
+        """Which parameters of each traced function hold tracer values.
+
+        Roots (transform targets, objectives handed to transformer
+        params) receive tracers in every non-static parameter.  A
+        non-root traced function — a helper that merely *runs* at trace
+        time — only holds a tracer in a parameter if a tainted
+        expression visibly flows into it at a call site inside traced
+        code (including through ``functools.partial``, whose bound
+        leading arguments are usually the static config ints).  This is
+        what lets ``_remove_effects_one(params, ts, p, d, q, icpt)``
+        branch on ``p``/``q`` freely: the call site binds them from host
+        ints, so only ``params``/``ts`` taint."""
+        if self._param_taint is not None:
+            return self._param_taint
+        taint: Dict[FuncInfo, Set[str]] = {}
+        traced = [fi for m in self.modules for fi in m.functions
+                  if fi.traced]
+        for fi in traced:
+            taint[fi] = (set(fi.params) - fi.static_params
+                         - fi.transformer_params) if fi.traced_root \
+                else set()
+        for _ in range(10):
+            changed = False
+            for fi in traced:
+                names = local_tainted_names(fi, taint[fi])
+                for node in iter_scope(fi.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    changed |= self._flow_call_taint(fi, node, names,
+                                                     taint)
+            if not changed:
+                break
+        self._param_taint = taint
+        return taint
+
+    def _flow_call_taint(self, fi: FuncInfo, call: ast.Call,
+                         names: Set[str],
+                         taint: Dict[FuncInfo, Set[str]]) -> bool:
+        mod = fi.module
+        canon = mod.resolve(call.func)
+        tail = canonical_tail(canon) if canon else ""
+        changed = False
+        if tail in ("functools.partial", "partial") and call.args:
+            g = self.lookup(mod.resolve(call.args[0]), fi, mod)
+            if g is None or not g.traced or g.traced_root \
+                    or g not in taint:
+                return False
+            bound = call.args[1:]
+            for i, a in enumerate(bound):
+                if isinstance(a, ast.Starred):
+                    break
+                if i < len(g.params) and taint_expr(a, names) \
+                        and g.params[i] not in taint[g]:
+                    taint[g].add(g.params[i])
+                    changed = True
+            for kw in call.keywords:
+                if kw.arg in g.params and taint_expr(kw.value, names) \
+                        and kw.arg not in taint[g]:
+                    taint[g].add(kw.arg)
+                    changed = True
+            # the unbound trailing params receive the runtime operands
+            # (refs/tracers) when the partial is finally invoked
+            for p in g.params[len(bound):]:
+                if p not in g.static_params and p not in taint[g]:
+                    taint[g].add(p)
+                    changed = True
+            return changed
+        g = self.lookup(canon, fi, mod)
+        if g is None or not g.traced or g.traced_root or g not in taint:
+            return False
+        for i, a in enumerate(call.args):
+            if isinstance(a, ast.Starred):
+                # conservatively taint the rest: *args forwarding
+                for p in g.params[i:]:
+                    if p not in g.static_params and p not in taint[g]:
+                        taint[g].add(p)
+                        changed = True
+                break
+            if i < len(g.params) and taint_expr(a, names) \
+                    and g.params[i] not in taint[g]:
+                taint[g].add(g.params[i])
+                changed = True
+        for kw in call.keywords:
+            if kw.arg in g.params and taint_expr(kw.value, names) \
+                    and kw.arg not in taint[g]:
+                taint[g].add(kw.arg)
+                changed = True
+        return changed
+
+
+# ---------------------------------------------------------------------------
+# expression-level tracer taint
+# ---------------------------------------------------------------------------
+
+_UNTAINTING_CALLS = frozenset({"len", "isinstance", "getattr", "hasattr",
+                               "type", "range", "enumerate", "zip", "int",
+                               "float", "bool"})
+
+
+def taint_expr(node: ast.AST, tainted: Set[str]) -> bool:
+    """Does this expression's *value* flow from a tracer-typed name?"""
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, ast.Attribute):
+        if node.attr in STATIC_ATTRS:
+            return False
+        return taint_expr(node.value, tainted)
+    if isinstance(node, ast.Subscript):
+        return taint_expr(node.value, tainted)
+    if isinstance(node, ast.BinOp):
+        return taint_expr(node.left, tainted) \
+            or taint_expr(node.right, tainted)
+    if isinstance(node, ast.UnaryOp):
+        return taint_expr(node.operand, tainted)
+    if isinstance(node, ast.Compare):
+        # `x is None` / `x is not None` is an identity check on the
+        # Python object, not a value read
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return False
+        return taint_expr(node.left, tainted) \
+            or any(taint_expr(c, tainted) for c in node.comparators)
+    if isinstance(node, ast.BoolOp):
+        return any(taint_expr(v, tainted) for v in node.values)
+    if isinstance(node, ast.IfExp):
+        return taint_expr(node.body, tainted) \
+            or taint_expr(node.orelse, tainted)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return any(taint_expr(e, tainted) for e in node.elts)
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in _UNTAINTING_CALLS:
+            return False
+        # a method call on a tainted object yields a tainted value
+        # ((params > 0).any()); .shape/.ndim chains already untaint in
+        # the Attribute case above
+        if isinstance(node.func, ast.Attribute) \
+                and taint_expr(node.func.value, tainted):
+            return True
+        return any(taint_expr(a, tainted) for a in node.args) \
+            or any(taint_expr(kw.value, tainted) for kw in node.keywords)
+    if isinstance(node, ast.Starred):
+        return taint_expr(node.value, tainted)
+    return False
+
+
+def local_tainted_names(fi: FuncInfo, seed: Set[str]) -> Set[str]:
+    """Grow a function's tainted-name set through simple local flow
+    (assignments; two passes for use-before-def in loops)."""
+    tainted = set(seed)
+    for _ in range(2):
+        for node in iter_scope(fi.node):
+            if isinstance(node, ast.Assign):
+                if taint_expr(node.value, tainted):
+                    for t in node.targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                tainted.add(n.id)
+            elif isinstance(node, ast.AugAssign):
+                if isinstance(node.target, ast.Name) \
+                        and taint_expr(node.value, tainted):
+                    tainted.add(node.target.id)
+            elif isinstance(node, ast.NamedExpr):
+                if isinstance(node.target, ast.Name) \
+                        and taint_expr(node.value, tainted):
+                    tainted.add(node.target.id)
+            elif isinstance(node, ast.For):
+                if taint_expr(node.iter, tainted):
+                    for n in ast.walk(node.target):
+                        if isinstance(n, ast.Name):
+                            tainted.add(n.id)
+    return tainted
+
+
+def _const_strings(node: ast.AST) -> List[str]:
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.append(n.value)
+    return out
+
+
+def _const_ints(node: ast.AST) -> List[int]:
+    out = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, int) \
+                and not isinstance(n.value, bool):
+            out.append(n.value)
+    return out

@@ -1,0 +1,224 @@
+"""sts-lint engine: file walking, suppression, baseline, reporting.
+
+Finding lifecycle: a rule emits a raw finding; the engine then
+
+1. drops it if the offending line carries a matching
+   ``# sts: noqa[STS0xx]`` (bare ``# sts: noqa`` matches every code) —
+   counted as *suppressed*;
+2. matches it against the checked-in baseline — counted as *baselined*
+   (the debt ledger: visible in the JSON report, not a failure);
+3. otherwise it is *new* and the lint exits nonzero.
+
+Baseline entries are line-number-independent fingerprints
+(``code|relpath|symbol|hash(stripped line text)``) with per-fingerprint
+counts, so unrelated edits above a baselined finding don't resurrect
+it, while a new copy of an already-baselined pattern still fails.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .analysis import ModuleModel, Project
+from .rules import RULES
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+_NOQA_RE = re.compile(r"#\s*sts:\s*noqa(?:\[([A-Z0-9,\s]+)\])?",
+                      re.IGNORECASE)
+
+
+@dataclass
+class Finding:
+    code: str
+    path: str            # repo-relative, forward slashes
+    line: int
+    col: int
+    symbol: str
+    message: str
+    status: str = "new"  # new | suppressed | baselined
+
+    def fingerprint(self, line_text: str) -> str:
+        h = hashlib.sha1(line_text.strip().encode()).hexdigest()[:10]
+        return f"{self.code}|{self.path}|{self.symbol}|{h}"
+
+    def to_json(self) -> Dict:
+        return {"code": self.code, "path": self.path, "line": self.line,
+                "col": self.col, "symbol": self.symbol,
+                "message": self.message, "status": self.status}
+
+    def render(self) -> str:
+        where = f" [in {self.symbol}]" if self.symbol else ""
+        return (f"{self.path}:{self.line}:{self.col + 1}: {self.code} "
+                f"{self.message}{where}")
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)
+    files_scanned: int = 0
+    parse_errors: List[str] = field(default_factory=list)
+
+    @property
+    def new(self) -> List[Finding]:
+        return [f for f in self.findings if f.status == "new"]
+
+    @property
+    def suppressed(self) -> List[Finding]:
+        return [f for f in self.findings if f.status == "suppressed"]
+
+    @property
+    def baselined(self) -> List[Finding]:
+        return [f for f in self.findings if f.status == "baselined"]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if (self.new or self.parse_errors) else 0
+
+    def summary(self) -> Dict:
+        by_code: Dict[str, int] = {}
+        for f in self.new:
+            by_code[f.code] = by_code.get(f.code, 0) + 1
+        return {
+            "findings": len(self.new),
+            "suppressed": len(self.suppressed),
+            "baselined": len(self.baselined),
+            "files_scanned": self.files_scanned,
+            "by_code": dict(sorted(by_code.items())),
+        }
+
+    def to_json(self) -> Dict:
+        return {
+            "version": 1,
+            "tool": "sts-lint",
+            "rules": {code: {"name": r.name, "summary": r.summary}
+                      for code, r in sorted(RULES.items())},
+            "summary": self.summary(),
+            "parse_errors": self.parse_errors,
+            "findings": [f.to_json() for f in self.findings],
+        }
+
+
+def _iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if f.endswith(".py"))
+    return out
+
+
+def _suppressions_for(source: str) -> Dict[int, Optional[set]]:
+    """line number -> set of suppressed codes (None = all codes)."""
+    out: Dict[int, Optional[set]] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        mo = _NOQA_RE.search(text)
+        if not mo:
+            continue
+        codes = mo.group(1)
+        out[i] = None if codes is None else \
+            {c.strip().upper() for c in codes.split(",") if c.strip()}
+    return out
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    entries = data.get("entries", {})
+    return {str(k): int(v) for k, v in entries.items()}
+
+
+def write_baseline(path: str, result: "LintResult",
+                   sources: Dict[str, str]) -> Dict[str, int]:
+    """Regenerate the baseline from every non-suppressed finding of this
+    run (suppressed lines are already handled in-source).  Entries carry
+    a human-readable context line so reviews of baseline diffs can see
+    what debt was admitted."""
+    entries: Dict[str, int] = {}
+    context: Dict[str, str] = {}
+    for f in result.findings:
+        if f.status == "suppressed":
+            continue
+        line_text = _line_of(sources.get(f.path, ""), f.line)
+        fp = f.fingerprint(line_text)
+        entries[fp] = entries.get(fp, 0) + 1
+        context.setdefault(fp, f"{f.path}:{f.line} {line_text.strip()}")
+    payload = {
+        "version": 1,
+        "comment": "sts-lint debt ledger — regenerate with "
+                   "`make lint-baseline`; every entry needs a written "
+                   "justification in the PR that adds it",
+        "entries": dict(sorted(entries.items())),
+        "context": dict(sorted(context.items())),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+    return entries
+
+
+def _line_of(source: str, lineno: int) -> str:
+    lines = source.splitlines()
+    return lines[lineno - 1] if 0 < lineno <= len(lines) else ""
+
+
+def lint_paths(paths: Sequence[str], root: Optional[str] = None,
+               baseline: Optional[Dict[str, int]] = None,
+               select: Optional[Sequence[str]] = None
+               ) -> Tuple[LintResult, Dict[str, str]]:
+    """Lint ``paths`` (files or directories).  Returns the result plus the
+    relpath->source map (the baseline writer needs the line text)."""
+    root = os.path.abspath(root or os.getcwd())
+    files = _iter_py_files(paths)
+    modules: List[ModuleModel] = []
+    result = LintResult()
+    sources: Dict[str, str] = {}
+    for path in files:
+        ap = os.path.abspath(path)
+        rel = os.path.relpath(ap, root).replace(os.sep, "/")
+        try:
+            source = open(ap, encoding="utf-8").read()
+            tree = ast.parse(source, filename=path)
+        except (OSError, SyntaxError) as e:
+            result.parse_errors.append(f"{rel}: {e}")
+            continue
+        sources[rel] = source
+        modules.append(ModuleModel(ap, rel, source, tree))
+    result.files_scanned = len(modules)
+    project = Project(modules)
+
+    active = [RULES[c] for c in (select or sorted(RULES))]
+    baseline = dict(baseline or {})
+    remaining = dict(baseline)
+    for mod in modules:
+        sup = _suppressions_for(mod.source)
+        for rule in active:
+            for raw in rule.check(project, mod):
+                f = Finding(raw.code, mod.relpath, raw.line, raw.col,
+                            raw.symbol, raw.message)
+                codes = sup.get(raw.line, False)
+                if codes is not False and (codes is None
+                                           or raw.code in codes):
+                    f.status = "suppressed"
+                else:
+                    fp = f.fingerprint(_line_of(mod.source, raw.line))
+                    if remaining.get(fp, 0) > 0:
+                        remaining[fp] -= 1
+                        f.status = "baselined"
+                result.findings.append(f)
+    result.findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return result, sources

@@ -1,0 +1,447 @@
+"""The STS0xx rule catalogue.
+
+Every rule is a function ``(Project, ModuleModel) -> Iterator[RawFinding]``
+registered in :data:`RULES`.  Rules lean on the semantic model in
+``analysis.py`` (which functions are traced, which parameters are static)
+and never re-derive it.
+
+Rule design notes, for anyone tuning these:
+
+- STS001/STS002/STS005 only fire *inside traced functions* — the whole
+  point of the model.  Host orchestration code (the ``minimize_*``
+  drivers, the fit entry points) may sync, print, and record metrics
+  freely; that is where those calls belong.
+- STS003 deliberately distinguishes float-defaulting creators
+  (``jnp.zeros(shape)`` is f32 today, f64 the day someone enables x64)
+  from dtype-preserving ones (``jnp.asarray(x)`` keeps x's dtype and is
+  exempt unless a float literal makes the result dtype implicit).
+  Integer index math (``jnp.arange(n)``) is exempt: its default dtype
+  follows the int-width config and flagging it would bury the real
+  findings in noise.
+- STS006 encodes a measured fact (see docs/design.md §6d): re-jitting
+  the *same module-level function object* hits jax's global jit cache,
+  while ``jax.jit(lambda ...)`` or jitting a nested def inside a
+  per-call body compiles fresh every call.  Only the latter is flagged;
+  an ``functools.lru_cache`` on the enclosing factory exempts it.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterator, Optional
+
+from .analysis import (FuncInfo, ModuleModel, Project, canonical_tail,
+                       iter_scope, local_tainted_names, taint_expr)
+
+
+@dataclass
+class RawFinding:
+    code: str
+    line: int
+    col: int
+    symbol: str          # qualname of the enclosing function ("" = module)
+    message: str
+
+
+@dataclass(frozen=True)
+class Rule:
+    code: str
+    name: str
+    summary: str
+    check: Callable[[Project, ModuleModel], Iterator[RawFinding]]
+
+
+# ---------------------------------------------------------------------------
+# STS001 — host sync / impurity inside traced code
+# ---------------------------------------------------------------------------
+
+_HOST_SYNC_CALLS = {
+    "time.time", "time.perf_counter", "time.monotonic",
+    "time.process_time", "time.sleep", "datetime.datetime.now",
+    "datetime.datetime.utcnow", "input", "random.random",
+    "random.uniform", "random.randint",
+}
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_NUMPY_SYNC_TAILS = {"asarray", "array", "copyto", "save", "savetxt"}
+
+
+def _is_constant_expr(node: ast.AST) -> bool:
+    return all(isinstance(n, (ast.Constant, ast.Tuple, ast.List,
+                              ast.expr_context, ast.UnaryOp, ast.USub,
+                              ast.UAdd))
+               for n in ast.walk(node))
+
+
+def _check_host_sync(project: Project, mod: ModuleModel
+                     ) -> Iterator[RawFinding]:
+    for fi in mod.functions:
+        if not fi.traced:
+            continue
+        via = fi.traced_via or "traced"
+        for node in iter_scope(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            canon = mod.resolve(node.func)
+            tail = canonical_tail(canon) if canon else ""
+            if tail in _HOST_SYNC_CALLS:
+                yield RawFinding(
+                    "STS001", node.lineno, node.col_offset, fi.qualname,
+                    f"impure host call {tail}() inside traced code "
+                    f"({via}): evaluated once at trace time, baked into "
+                    f"the compiled program")
+            elif tail == "print":
+                yield RawFinding(
+                    "STS001", node.lineno, node.col_offset, fi.qualname,
+                    f"print() inside traced code ({via}) runs at trace "
+                    f"time only — use jax.debug.print for runtime output")
+            elif tail in ("float", "int", "bool", "complex") and node.args \
+                    and not _is_constant_expr(node.args[0]):
+                yield RawFinding(
+                    "STS001", node.lineno, node.col_offset, fi.qualname,
+                    f"{tail}() on a non-constant inside traced code "
+                    f"({via}): host sync in eager, ConcretizationError "
+                    f"under jit")
+            elif tail.startswith("numpy.random."):
+                yield RawFinding(
+                    "STS001", node.lineno, node.col_offset, fi.qualname,
+                    f"{tail}() inside traced code ({via}): trace-time "
+                    f"randomness is baked in — thread a jax.random key")
+            elif tail.startswith("numpy.") \
+                    and tail.split(".")[-1] in _NUMPY_SYNC_TAILS \
+                    and node.args and not _is_constant_expr(node.args[0]):
+                yield RawFinding(
+                    "STS001", node.lineno, node.col_offset, fi.qualname,
+                    f"{tail}() on a non-constant inside traced code "
+                    f"({via}): device→host materialization (fails on "
+                    f"tracers under jit)")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _HOST_SYNC_METHODS \
+                    and not node.args:
+                yield RawFinding(
+                    "STS001", node.lineno, node.col_offset, fi.qualname,
+                    f".{node.func.attr}() inside traced code ({via}): "
+                    f"blocking device→host sync")
+
+
+# ---------------------------------------------------------------------------
+# STS002 — metrics / span / registry calls inside traced code
+# ---------------------------------------------------------------------------
+
+_METRICS_MODULE_TAILS = ("utils.metrics", "utils.tracing")
+_METRICS_BARE_NAMES = {
+    "span", "counter", "inc", "observe", "set_gauge", "gauge",
+    "histogram", "trace_instant", "observe_minimize", "record_fit",
+    "instrument_fit", "get_registry", "snapshot", "add_span_listener",
+}
+
+
+def _metrics_canon(mod: ModuleModel, node: ast.Call) -> Optional[str]:
+    canon = mod.resolve(node.func)
+    if canon is None:
+        return None
+    tail = canonical_tail(canon)
+    parts = tail.rsplit(".", 1)
+    if len(parts) == 2:
+        base, name = parts
+        if any(base.endswith(t) or base == t.split(".")[-1]
+               for t in _METRICS_MODULE_TAILS):
+            return tail
+    # bare name imported straight from the metrics module
+    if isinstance(node.func, ast.Name):
+        aliased = mod.aliases.get(node.func.id, "")
+        if any(canonical_tail(aliased).startswith(t) or
+               f".{t}." in aliased for t in _METRICS_MODULE_TAILS):
+            return canonical_tail(aliased)
+        if node.func.id in _METRICS_BARE_NAMES and aliased \
+                and aliased != node.func.id:
+            return canonical_tail(aliased)
+    return None
+
+
+def _check_metrics_in_trace(project: Project, mod: ModuleModel
+                            ) -> Iterator[RawFinding]:
+    for fi in mod.functions:
+        if not fi.traced:
+            continue
+        for node in iter_scope(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = _metrics_canon(mod, node)
+            if hit:
+                yield RawFinding(
+                    "STS002", node.lineno, node.col_offset, fi.qualname,
+                    f"observability call {hit}() inside traced code "
+                    f"({fi.traced_via}): spans/counters are host-side "
+                    f"only — record around the traced call, not in it")
+                continue
+            # calling an @instrument_fit-wrapped entry point from traced
+            # code opens its span under the trace; call .__wrapped__
+            canon = mod.resolve(node.func)
+            target = project.lookup(canon, fi, mod)
+            if target is not None and target.instrumented \
+                    and not (canon or "").endswith(".__wrapped__"):
+                yield RawFinding(
+                    "STS002", node.lineno, node.col_offset, fi.qualname,
+                    f"call to @instrument_fit-wrapped "
+                    f"{canonical_tail(canon or target.name)}() inside "
+                    f"traced code ({fi.traced_via}): the wrapper's span/"
+                    f"counters fire at trace time — call "
+                    f"{target.name}.__wrapped__ instead")
+
+
+# ---------------------------------------------------------------------------
+# STS003 / STS004 — dtype discipline in ops/ and models/
+# ---------------------------------------------------------------------------
+
+# creators whose no-dtype default is the *config-dependent* float width
+_FLOAT_DEFAULT_CREATORS = {"zeros", "ones", "empty", "full", "eye",
+                           "identity", "linspace"}
+# dtype-preserving / int-defaulting creators: flagged only when a float
+# literal makes the implicit result dtype float
+_VALUE_DEFAULT_CREATORS = {"array", "asarray", "arange"}
+
+_DTYPE_NAME_HINTS = {"bool", "int", "float", "complex"}
+
+
+def _arg_is_dtype_like(mod: ModuleModel, node: ast.AST) -> bool:
+    canon = mod.resolve(node)
+    if canon is not None:
+        tail = canonical_tail(canon)
+        last = tail.split(".")[-1]
+        if last in _DTYPE_NAME_HINTS or last.startswith(
+                ("float", "int", "uint", "bool", "complex", "bfloat")):
+            return True
+        # a local named `dtype` / `out_dtype` / `np_dtype` passed
+        # positionally is an explicit dtype choice
+        if last == "dtype" or last.endswith("dtype"):
+            return True
+    if isinstance(node, ast.Attribute) and node.attr == "dtype":
+        return True
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.startswith(("float", "int", "uint", "bool",
+                                      "complex", "bfloat"))
+    return False
+
+
+def _has_dtype(mod: ModuleModel, call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            return True
+    return any(_arg_is_dtype_like(mod, a) for a in call.args)
+
+
+def _has_float_literal(call: ast.Call) -> bool:
+    for a in call.args:
+        for n in ast.walk(a):
+            if isinstance(n, ast.Constant) and isinstance(n.value, float):
+                return True
+    return False
+
+
+def _dtype_scoped(mod: ModuleModel) -> bool:
+    parts = mod.relpath.split("/")
+    return "ops" in parts or "models" in parts
+
+
+def _enclosing(mod: ModuleModel, node: ast.AST,
+               parents: Dict[ast.AST, ast.AST]) -> str:
+    cur = parents.get(node)
+    while cur is not None:
+        fi = mod.func_of_node.get(cur)
+        if fi is not None:
+            return fi.qualname
+        cur = parents.get(cur)
+    return ""
+
+
+def _parent_map(tree: ast.Module) -> Dict[ast.AST, ast.AST]:
+    return {child: parent for parent in ast.walk(tree)
+            for child in ast.iter_child_nodes(parent)}
+
+
+def _check_dtype_discipline(project: Project, mod: ModuleModel
+                            ) -> Iterator[RawFinding]:
+    if not _dtype_scoped(mod):
+        return
+    parents = _parent_map(mod.tree)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = mod.resolve(node.func)
+        if canon is None:
+            continue
+        tail = canonical_tail(canon)
+        if not tail.startswith("jax.numpy."):
+            continue
+        name = tail.split(".")[-1]
+        if name in _FLOAT_DEFAULT_CREATORS:
+            if not _has_dtype(mod, node):
+                where = "ops" if "ops" in mod.relpath.split("/") \
+                    else "models"
+                yield RawFinding(
+                    "STS003", node.lineno, node.col_offset,
+                    _enclosing(mod, node, parents),
+                    f"jnp.{name}(...) without dtype= in {where}: "
+                    f"implicit default-float dtype flips f32→f64 when "
+                    f"x64 is enabled — pass dtype= explicitly")
+        elif name in _VALUE_DEFAULT_CREATORS:
+            if not _has_dtype(mod, node) and _has_float_literal(node):
+                yield RawFinding(
+                    "STS003", node.lineno, node.col_offset,
+                    _enclosing(mod, node, parents),
+                    f"jnp.{name}(...) with a bare float literal and no "
+                    f"dtype=: the literal's implicit dtype follows the "
+                    f"x64 config — pass dtype= (or derive it from an "
+                    f"input's .dtype)")
+
+
+_NUMPY_FLOAT_DEFAULT = {"zeros", "ones", "empty", "full", "linspace",
+                        "eye", "identity"}
+
+
+def _check_numpy_promotion(project: Project, mod: ModuleModel
+                           ) -> Iterator[RawFinding]:
+    if not _dtype_scoped(mod):
+        return
+    parents = _parent_map(mod.tree)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        canon = mod.resolve(node.func)
+        if canon is None:
+            continue
+        tail = canonical_tail(canon)
+        if not tail.startswith("numpy."):
+            continue
+        name = tail.split(".")[-1]
+        if name == "float64":
+            yield RawFinding(
+                "STS004", node.lineno, node.col_offset,
+                _enclosing(mod, node, parents),
+                "np.float64(...) in device code: a strongly-typed f64 "
+                "scalar silently promotes every jnp operand under x64 — "
+                "use a Python float (weak) or an explicit f32")
+        elif name in _NUMPY_FLOAT_DEFAULT and not _has_dtype(mod, node):
+            yield RawFinding(
+                "STS004", node.lineno, node.col_offset,
+                _enclosing(mod, node, parents),
+                f"np.{name}(...) without dtype= in device code: numpy "
+                f"defaults to float64, which promotes the jnp side "
+                f"under x64 — pass dtype= explicitly")
+
+
+# ---------------------------------------------------------------------------
+# STS005 — Python-level branching on tracer values
+# ---------------------------------------------------------------------------
+
+def _check_tracer_branch(project: Project, mod: ModuleModel
+                         ) -> Iterator[RawFinding]:
+    taints = project.param_taint()
+    for fi in mod.functions:
+        if not fi.traced:
+            continue
+        seed = taints.get(fi, set())
+        if not seed:
+            continue
+        tainted = local_tainted_names(fi, seed)
+        for node in iter_scope(fi.node):
+            test = None
+            kind = None
+            if isinstance(node, ast.If):
+                test, kind = node.test, "if"
+            elif isinstance(node, ast.While):
+                test, kind = node.test, "while"
+            elif isinstance(node, ast.Assert):
+                test, kind = node.test, "assert"
+            elif isinstance(node, ast.IfExp):
+                test, kind = node.test, "conditional expression"
+            if test is None or not taint_expr(test, tainted):
+                continue
+            yield RawFinding(
+                "STS005", node.lineno, node.col_offset, fi.qualname,
+                f"Python {kind} on a tracer-typed value inside traced "
+                f"code ({fi.traced_via}): trace-time branch freezes one "
+                f"side into the program (ConcretizationError under jit) "
+                f"— use jnp.where / lax.cond, or mark the argument "
+                f"static")
+
+
+# ---------------------------------------------------------------------------
+# STS006 — recompile hazards: fresh jit wrappers around closures
+# ---------------------------------------------------------------------------
+
+_CACHE_DECORATORS = {"functools.lru_cache", "functools.cache", "lru_cache",
+                     "cache"}
+
+
+def _has_cache_decorator(fi: FuncInfo) -> bool:
+    for f in fi.scope_chain():
+        for dec in f.decorators:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            canon = f.module.resolve(target)
+            if canon and canonical_tail(canon) in _CACHE_DECORATORS:
+                return True
+    return False
+
+
+def _check_recompile_hazard(project: Project, mod: ModuleModel
+                            ) -> Iterator[RawFinding]:
+    for fi in mod.functions:
+        # jit calls at module scope run once per process — fine.  Only
+        # jit calls inside function bodies can churn the cache.
+        for node in iter_scope(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            canon = mod.resolve(node.func)
+            if not canon or canonical_tail(canon) != "jax.jit" \
+                    or not node.args:
+                continue
+            target = node.args[0]
+            fresh: Optional[str] = None
+            if isinstance(target, ast.Lambda):
+                fresh = "a lambda"
+            elif isinstance(target, ast.Name):
+                resolved = fi.resolve_local(target.id)
+                if resolved is not None and resolved.parent is not None:
+                    fresh = f"nested function {target.id!r}"
+            if fresh is None:
+                continue
+            if _has_cache_decorator(fi):
+                continue
+            yield RawFinding(
+                "STS006", node.lineno, node.col_offset, fi.qualname,
+                f"jax.jit({fresh}) inside a function body: a fresh "
+                f"function object per call defeats jit's global cache — "
+                f"every call recompiles.  Hoist the jitted callee to "
+                f"module scope (closure state becomes arguments / "
+                f"static args) or cache the wrapper (functools.lru_cache)")
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+RULES: Dict[str, Rule] = {r.code: r for r in [
+    Rule("STS001", "host-sync-in-trace",
+         "Host-sync / impure calls (float/int/.item/np.asarray/time/"
+         "print) reachable from traced code", _check_host_sync),
+    Rule("STS002", "metrics-in-trace",
+         "Metrics / span / registry calls inside traced code "
+         "(tracer-safe observability)", _check_metrics_in_trace),
+    Rule("STS003", "implicit-float-dtype",
+         "Array creation in ops/ and models/ without an explicit dtype",
+         _check_dtype_discipline),
+    Rule("STS004", "numpy-promotion",
+         "numpy float64 creation in device code paths (silent promotion "
+         "under x64)", _check_numpy_promotion),
+    Rule("STS005", "tracer-branch",
+         "Python-level branching on tracer-typed values",
+         _check_tracer_branch),
+    Rule("STS006", "recompile-hazard",
+         "jax.jit of a per-call closure (defeats the jit cache)",
+         _check_recompile_hazard),
+]}
+
+TRACER_SAFETY_RULES = ("STS001", "STS002", "STS005", "STS006")
+DTYPE_RULES = ("STS003", "STS004")
