@@ -287,6 +287,7 @@ def main():
 
     import jax
     import jax.numpy as jnp
+    from spark_timeseries_tpu import engine as sts_engine
     from spark_timeseries_tpu.models import arima
     from spark_timeseries_tpu.utils import contracts, costs, metrics, \
         tracing
@@ -375,6 +376,13 @@ def main():
                       if k.startswith("device.mem.")}
         if mem_gauges:
             block["device_memory"] = mem_gauges
+        # the streaming engine's accounting: executable cache hits/misses,
+        # chunks, bytes donated/transferred, pad lanes (tools/bench_gate.py
+        # gates engine.cache_misses against the trailing median)
+        eng_counters = {k: v for k, v in snap["counters"].items()
+                        if k.startswith("engine.")}
+        if eng_counters:
+            block["engine"] = eng_counters
         block["static_analysis"] = _static_analysis_block()
         return block
 
@@ -432,74 +440,26 @@ def main():
     with metrics.span("bench.baseline_emulation"):
         cpu_rate, cpu_times = _baseline_rate(panel)
 
-    def _fit(v, n_real):
-        m = arima.fit(2, 1, 2, v, warn=False)
-        # converged-lane count rides along so the throughput number is
-        # auditable (speed not bought by silent non-convergence); one extra
-        # scalar per chunk, no extra passes.  ``n_real`` masks the ragged
-        # tail's zero-padded lanes out of the count (traced, so the tail
-        # reuses the same executable).
-        lane = jnp.arange(v.shape[0]) < n_real
-        return (m.coefficients,
-                jnp.sum(jnp.where(lane, m.diagnostics.converged, False)))
-
-    fit = jax.jit(_fit)
+    # the streaming fit engine (ISSUE 5) replaces this file's former
+    # inline double-buffer loop: shape-bucketed AOT executables (one
+    # compile per chunk bucket, shared across curve points and reps),
+    # prefetch-depth H2D/compute/D2H overlap, donated chunk buffers on
+    # accelerators, ragged-tail bucketing, and per-chunk failure
+    # isolation — with `engine.*` counters landing in every record's
+    # metrics block.  STS_COMPILE_CACHE additionally persists the
+    # executables across processes.
+    eng = sts_engine.FitEngine()
 
     def run(values: np.ndarray, chunk_n: int):
-        """Fit a panel chunked through HBM; returns
-        ``(wall_seconds, converged_lane_count, chunk_failures)``.  Timing is
-        to host materialization of every chunk's coefficients (on the
-        tunneled TPU platform block_until_ready alone does not synchronize),
-        and includes the H2D transfer of each chunk — the real pipeline
-        cost shape for a panel larger than device memory.
-
-        Double-buffered: chunk ``i+1``'s transfer + fit are dispatched
-        (JAX dispatch is async) before chunk ``i``'s coefficients are pulled
-        to host, so H2D/compute/D2H overlap; at most two chunks are live in
-        HBM at once.
-
-        A chunk whose fit (or host pull) raises is *recorded* in
-        ``chunk_failures`` and skipped — per-series failure isolation at
-        the bench tier (ISSUE 2): one pathological chunk degrades the
-        measurement's coverage, never the whole round."""
-        t0 = time.perf_counter()
-        pending = None
-        converged = 0
-        failures = []
-
-        def record_failure(start, n_real, e):
-            failures.append({"chunk_start": int(start),
-                             "n_series": int(n_real),
-                             "error": f"{type(e).__name__}: {e}"})
-            metrics.inc("resilience.bench.chunk_failures")
-
-        def pull(out, start, n_real):
-            nonlocal converged
-            try:
-                np.asarray(out[0])
-                converged += int(out[1])
-            except Exception as e:      # noqa: BLE001 — deferred device
-                # errors surface at materialization; isolate the chunk
-                record_failure(start, n_real, e)
-
-        for start in range(0, values.shape[0], chunk_n):
-            part = values[start:start + chunk_n]
-            n_real = part.shape[0]
-            if n_real != chunk_n:           # ragged tail: pad to one shape
-                pad = np.zeros((chunk_n - n_real, n_obs), part.dtype)
-                part = np.concatenate([part, pad])
-            try:
-                out = (fit(jnp.asarray(part, dtype), jnp.asarray(n_real)),
-                       start, n_real)
-            except Exception as e:          # noqa: BLE001 — same isolation
-                record_failure(start, n_real, e)
-                continue
-            if pending is not None:
-                pull(*pending)
-            pending = out
-        if pending is not None:
-            pull(*pending)
-        return time.perf_counter() - t0, converged, failures
+        """One streamed pass; returns the engine's
+        ``(wall_seconds, converged_lane_count, chunk_failures, stats)``.
+        Timing covers dispatch through host materialization of every
+        chunk's outputs (on the tunneled TPU platform block_until_ready
+        alone does not synchronize) and includes each chunk's H2D — the
+        real pipeline cost shape for a panel larger than device memory."""
+        res = eng.stream_fit(np.asarray(values, np_dtype), "arima",
+                             chunk_size=chunk_n, p=2, d=1, q=2)
+        return res.wall_s, res.n_converged, res.chunk_failures, res.stats
 
     # scaling curve: does the small-panel rate hold at 1M?  Each point uses
     # chunk = min(CHUNK, n) so small panels aren't padded up to the big
@@ -517,8 +477,20 @@ def main():
                 continue
             c = min(chunk, n)
             with metrics.span("bench.warmup"):
-                np.asarray(fit(jnp.asarray(panel[:c], dtype),
-                               jnp.asarray(c))[0])          # warm this shape
+                # precompile this point's exact chunk shape (and the
+                # tail's series bucket, when the point has a ragged
+                # tail) ahead of the timed pass — bucket=False keys the
+                # executables exactly as stream_fit will look them up,
+                # donation flag included; with a warm in-process or
+                # persistent cache this is a cache hit, not a compile
+                shapes = [(c, n_obs)]
+                tail = n % c
+                if tail:
+                    shapes.append((min(sts_engine.series_bucket(tail), c),
+                                   n_obs))
+                eng.warmup(("arima",), shapes, dtype=dtype,
+                           variants=("dense",), bucket=False,
+                           p=2, d=1, q=2)
             # per-point H2D bandwidth at this point's chunk shape (cached
             # by shape — re-shipping an identical chunk measures nothing
             # new): the curve's shape is transfer-dominated over the dev
@@ -540,7 +512,7 @@ def main():
                 # prefer the rep with the most coverage, then the fastest —
                 # a rep that dropped a chunk skips that chunk's work, so
                 # min-by-time alone would bias toward degraded runs
-                dt, conv, chunk_failures = min(
+                dt, conv, chunk_failures, eng_stats = min(
                     (run(panel[:n], c) for _ in range(reps)),
                     key=lambda r: (sum(f["n_series"] for f in r[2]), r[0]))
             # the rate covers only the series that actually fitted: a
@@ -558,6 +530,9 @@ def main():
                 "n_chunks": -(-n // c),
                 "platform": platform,
                 "css_lm_path": css_lm_path,
+                # per-pass engine accounting: a non-zero cache_misses here
+                # means this point paid a compile the warmup didn't cover
+                "engine": eng_stats,
             }
             if chunk_failures:
                 point["fit_failures"] = chunk_failures[:8]
@@ -588,9 +563,8 @@ def main():
 
             demo_n = min(chunk, n_target)
             with metrics.span("bench.refit_demo"):
-                fit_model = jax.jit(
-                    lambda v: arima.fit(2, 1, 2, v, warn=False))
-                model = fit_model(jnp.asarray(panel[:demo_n], dtype))
+                model = eng.fit(np.asarray(panel[:demo_n], np_dtype),
+                                "arima", p=2, d=1, q=2)
                 before = float(
                     np.asarray(model.diagnostics.converged).mean())
                 t0 = time.perf_counter()
@@ -738,11 +712,15 @@ def main():
         with metrics.span("bench.device_resident"):
             c = min(chunk, best_n)
             dev = jax.device_put(jnp.asarray(panel[:c], dtype))
-            np.asarray(fit(dev, jnp.asarray(c))[0])          # warm
+            # same engine executable as the streamed chunks, panel
+            # already in HBM, results pulled to host each rep
+            np.asarray(eng.fit(dev, "arima", p=2, d=1, q=2)
+                       .coefficients)                        # warm
             reps_dr = 3
             t0 = time.perf_counter()
             for _ in range(reps_dr):
-                np.asarray(fit(dev, jnp.asarray(c))[0])
+                np.asarray(eng.fit(dev, "arima", p=2, d=1, q=2)
+                           .coefficients)
             device_resident = round(c * reps_dr
                                     / (time.perf_counter() - t0), 1)
         emit({

@@ -1,0 +1,994 @@
+"""Streaming fit engine: shape-bucketed AOT executables, buffer donation,
+and library-grade chunk pipelining.
+
+The bench trajectory (BENCH_r04/r05) shows batched-fit throughput limited
+by two host-visible costs rather than by the chips: every distinct
+``(n_series, n_obs)`` panel shape re-traces and re-compiles the whole fit
+program (the tail-latency killer under multi-tenant traffic, where panels
+arrive in arbitrary shapes), and the only H2D/compute/D2H overlap in the
+tree was an inline double-buffer loop private to ``bench.py``.  This
+module is the one path every batched fit takes — the distributed-ARIMA
+lesson (PAPERS.md: "Distributed ARIMA Models for Ultra-long Time Series",
+ARIMA_PLUS's precompiled in-database fit pipelines) applied to XLA:
+amortize compilation across the workload, stream partitions through the
+accelerator, and account for both in the metrics registry.
+
+Three tiers, layered:
+
+- **shape bucketing** (:func:`pad_bucket`, promoted here from a static
+  check in ``utils.contracts`` — contracts now *imports* the policy it
+  asserts): any raw panel shape maps to a canonical padded bucket (series
+  to the next power of two, floor 8; observations to the next multiple of
+  32, floor 32), so the executable cache sees one shape per bucket
+  instead of one per panel.  Padding lanes are all-NaN — exactly the
+  shape the existing ragged/resilience machinery masks: the ragged
+  valid-window weighting for AOT fits, ``utils.resilience`` health
+  classification for resilient fits.  The stable-jaxpr contract
+  (``utils.contracts``) is what keeps "same bucket" implying "same
+  program".
+- **AOT executable cache** (:meth:`FitEngine.fit` /
+  :meth:`FitEngine.warmup`): one ``jit(...).lower(...).compile()`` per
+  ``(family, bucket, dtype, platform, statics, variant)``, held by the
+  engine and counted as ``engine.cache_hits`` / ``engine.cache_misses``.
+  ``warmup(families, shapes)`` precompiles ahead of traffic; setting
+  ``STS_COMPILE_CACHE=/path`` (or :func:`configure_compile_cache`)
+  additionally arms JAX's persistent on-disk compilation cache
+  (``jax_compilation_cache_dir``), so a *fresh process* deserializes
+  instead of compiling.
+- **streaming executor** (:meth:`FitEngine.stream_fit`): the
+  double-buffered chunk pipeline that used to live inline in ``bench.py``,
+  generalized — prefetch-depth-controlled H2D/compute/D2H overlap (JAX
+  dispatch is async; at most ``prefetch`` chunks live on device),
+  ``donate_argnums`` on the panel buffer so successive chunks reuse the
+  same HBM in place (auto-disabled on CPU, where XLA cannot alias the
+  buffer), ragged-tail bucketing (a tail chunk pads to its own series
+  bucket, not the full chunk shape), and per-chunk failure isolation —
+  a poisoned chunk is *recorded* in the result and in
+  ``engine.chunk_failures``, never raised, matching the bench-tier
+  semantics it replaces.
+
+Numerics contract: a panel already at its bucket shape (dense, no NaN)
+runs the exact program ``jax.jit(models.<family>.fit)`` would run —
+bit-for-bit identical results; a panel padded on the series axis keeps
+every real lane bit-for-bit (all-NaN lanes are weighted out exactly);
+padding on the observation axis routes through the ragged valid-window
+weighting, whose results match trimmed per-series fits to float rounding
+(the documented ``ops.ragged`` equivalence, pinned by
+``tests/test_ragged.py``).  Eager callers note: eager-vs-jit float32
+differences are pre-existing XLA fusion noise, not introduced here — the
+"pre-engine path" for every batched workload (bench, production
+pipelines) was already the jitted fit.
+
+``Panel.fit_resilient`` and ``models.arima.fit_panel`` route through the
+module-level :func:`default_engine`; ``bench.py`` consumes
+:meth:`FitEngine.stream_fit` and embeds the ``engine.*`` counters in
+every BENCH record.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import (Any, Callable, Dict, List, NamedTuple, Optional,
+                    Sequence, Tuple)
+
+import numpy as np
+
+from .utils import metrics as _metrics
+
+__all__ = [
+    "SERIES_BUCKET_FLOOR", "OBS_BUCKET_MULTIPLE",
+    "pad_bucket", "series_bucket",
+    "configure_compile_cache",
+    "FitEngine", "StreamResult", "default_engine",
+    "ENGINE_FAMILIES", "RAGGED_FAMILIES",
+]
+
+# ---------------------------------------------------------------------------
+# bucket policy (the single source of truth; utils.contracts re-exports)
+# ---------------------------------------------------------------------------
+
+# series round up to a power of two (floor 8), observation counts to a
+# multiple of 32 (floor 32).  Raw shapes in the same bucket share one
+# compiled program; the stable-jaxpr contract keeps that true.
+SERIES_BUCKET_FLOOR = 8
+OBS_BUCKET_MULTIPLE = 32
+
+
+def series_bucket(n_series: int) -> int:
+    """Series-axis bucket: next power of two, floor 8."""
+    s = SERIES_BUCKET_FLOOR
+    while s < n_series:
+        s *= 2
+    return s
+
+
+def pad_bucket(n_series: int, n_obs: int) -> Tuple[int, int]:
+    """Canonical padded shape for a raw panel shape: series to the next
+    power of two (floor 8), observations to the next multiple of 32
+    (floor 32)."""
+    t = max(OBS_BUCKET_MULTIPLE,
+            -(-n_obs // OBS_BUCKET_MULTIPLE) * OBS_BUCKET_MULTIPLE)
+    return series_bucket(n_series), t
+
+
+# ---------------------------------------------------------------------------
+# persistent compilation cache (STS_COMPILE_CACHE)
+# ---------------------------------------------------------------------------
+
+_cache_state = {"dir": None}
+
+
+def configure_compile_cache(path: Optional[str] = None) -> Optional[str]:
+    """Arm JAX's persistent on-disk compilation cache.
+
+    ``path`` (or, when None, the ``STS_COMPILE_CACHE`` environment
+    variable) becomes ``jax_compilation_cache_dir``; the
+    minimum-compile-time threshold is dropped to 0 so even fast fit
+    programs persist.  Returns the armed directory, or None when neither
+    source names one (the cache stays off — JAX's default).  Idempotent;
+    a fresh process pointed at a warm directory deserializes executables
+    instead of compiling them (``jax.cache_hits`` in the metrics
+    registry counts the proof).
+    """
+    if path is None:
+        path = os.environ.get("STS_COMPILE_CACHE")
+    if not path:
+        return None
+    if _cache_state["dir"] == path:
+        return path
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    except AttributeError:  # pragma: no cover — much older jax
+        pass
+    _cache_state["dir"] = path
+    _metrics.set_gauge("engine.compile_cache_enabled", 1.0)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# family table: statics builders + traced fit dispatch
+# ---------------------------------------------------------------------------
+
+# statics builders turn an engine call's kwargs into the hashable tuple
+# that keys the executable cache AND parameterizes the traced fit.  An
+# unknown kwarg raises TypeError, which `fit` treats as "bypass to the
+# direct eager path" (e.g. arima's user_init_params array cannot be a
+# static).
+_STATICS_BUILDERS: Dict[str, Callable[..., tuple]] = {
+    "arima": lambda p=2, d=1, q=2, include_intercept=True,
+    method="css-lm", max_iter=None, retry=None:
+        (int(p), int(d), int(q), bool(include_intercept), str(method),
+         max_iter, retry),
+    "ar": lambda max_lag=2, no_intercept=False:
+        (int(max_lag), bool(no_intercept)),
+    "ewma": lambda: (),
+    "garch": lambda: (),
+    "argarch": lambda: (),
+    "egarch": lambda: (),
+    "holt_winters": lambda period=12, model_type="additive":
+        (int(period), str(model_type)),
+}
+
+ENGINE_FAMILIES = tuple(_STATICS_BUILDERS)
+
+# families whose fit accepts an explicit left-aligned valid-window length
+# (`n_valid=`), enabling the fully-traced ragged variant that
+# observation-axis padding needs.  The x-carrying families (arimax, arx,
+# regression_arima) stay on the direct / resilient paths: their exogenous
+# regressor matrices would need the same obs-axis padding treatment.
+RAGGED_FAMILIES = ("arima", "ar")
+
+
+def _family_fit(family: str, statics: tuple, values, n_valid):
+    """One batched fit, dispatched by (family, statics) — runs under the
+    engine's jit trace, so every entry point is the undecorated
+    ``.__wrapped__`` (spans/counters are host-side; the engine records
+    its own, off the reconstructed model)."""
+    from . import models as m
+
+    if family == "arima":
+        p, d, q, icpt, method, max_iter, retry = statics
+        return m.arima.fit.__wrapped__(
+            p, d, q, values, include_intercept=icpt, method=method,
+            max_iter=max_iter, retry=retry, warn=False, n_valid=n_valid)
+    if family == "ar":
+        max_lag, no_icpt = statics
+        return m.autoregression.fit.__wrapped__(
+            values, max_lag, no_intercept=no_icpt, n_valid=n_valid)
+    if n_valid is not None:
+        raise ValueError(
+            f"family {family!r} has no traced ragged fit; only "
+            f"{RAGGED_FAMILIES} accept observation-axis padding")
+    if family == "ewma":
+        return m.ewma.fit.__wrapped__(values)
+    if family == "garch":
+        return m.garch.fit.__wrapped__(values)
+    if family == "argarch":
+        return m.garch.fit_ar_garch.__wrapped__(values)
+    if family == "egarch":
+        return m.garch.fit_egarch.__wrapped__(values)
+    if family == "holt_winters":
+        period, model_type = statics
+        return m.holt_winters.fit.__wrapped__(values, period,
+                                              model_type=model_type)
+    raise ValueError(f"unknown engine family {family!r}; expected one of "
+                     f"{sorted(_STATICS_BUILDERS)}")
+
+
+class _Skeleton(NamedTuple):
+    """Trace-time structure of a fitted model pytree: how to rebuild the
+    host model from the executable's array outputs.  ``static_leaves``
+    holds the (position, value) pairs of non-array leaves (model orders,
+    flags) captured during tracing; ``array_pos`` the positions the
+    executable's outputs fill."""
+    treedef: Any
+    static_leaves: Tuple[Tuple[int, Any], ...]
+    array_pos: Tuple[int, ...]
+    n_leaves: int
+
+
+_skeleton_capture = threading.local()
+
+
+def _is_arrayish(leaf: Any) -> bool:
+    return hasattr(leaf, "dtype") and hasattr(leaf, "shape")
+
+
+def _split_model(model, values, n_real):
+    """Shared tail of both traced variants: flatten the fitted model,
+    capture its skeleton (trace-time only), and reduce a lane-masked
+    converged count."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten(model)
+    pos = tuple(i for i, leaf in enumerate(leaves) if _is_arrayish(leaf))
+    slot = getattr(_skeleton_capture, "slot", None)
+    if slot is not None:
+        slot["skeleton"] = _Skeleton(
+            treedef,
+            tuple((i, leaves[i]) for i in range(len(leaves))
+                  if i not in pos),
+            pos, len(leaves))
+    lane = jnp.arange(values.shape[0], dtype=jnp.int32) < n_real
+    diag = getattr(model, "diagnostics", None)
+    conv = getattr(diag, "converged", None) if diag is not None else None
+    if conv is not None:
+        n_conv = jnp.sum(jnp.where(lane, jnp.reshape(conv, (-1,)), False))
+    else:
+        n_conv = jnp.sum(lane)
+    return [leaves[i] for i in pos], n_conv
+
+
+def _dense_fit(family: str, statics: tuple, values, n_real):
+    """Traced dense fit: exactly the program ``jax.jit(fit)`` runs, plus
+    a lane-masked converged count (padding lanes — zero rows on the
+    stream tier — self-quarantine per lane and are sliced off host-side)."""
+    return _split_model(_family_fit(family, statics, values, None),
+                        values, n_real)
+
+
+def _ragged_fit(family: str, statics: tuple, values, n_real):
+    """Traced ragged fit: NaN-padded input (leading/trailing per lane —
+    bucket padding is all-NaN lanes plus trailing observation columns) is
+    left-aligned in-trace and fitted against its explicit per-lane valid
+    window, so one executable serves every raw shape in the bucket."""
+    from .ops.ragged import _left_align
+
+    aligned, length, _ = _left_align(values)
+    return _split_model(_family_fit(family, statics, aligned, length),
+                        values, n_real)
+
+
+# Module-level jit wrappers (one function object per variant x donation,
+# so repeated lowers share jax's jit cache; see STS006).  values sits at
+# argument 2; family and statics are static.
+def _make_jits():
+    import jax
+    table = {}
+    for variant, fn in (("dense", _dense_fit), ("ragged", _ragged_fit)):
+        table[variant, False] = jax.jit(fn, static_argnums=(0, 1))
+        table[variant, True] = jax.jit(fn, static_argnums=(0, 1),
+                                       donate_argnums=(2,))
+    return table
+
+
+_jit_table: Dict[Tuple[str, bool], Any] = {}
+_jit_lock = threading.Lock()
+
+
+def _jit_for(variant: str, donate: bool):
+    with _jit_lock:
+        if not _jit_table:
+            _jit_table.update(_make_jits())
+        return _jit_table[variant, donate]
+
+
+# ---------------------------------------------------------------------------
+# host-side input classification
+# ---------------------------------------------------------------------------
+
+def _host_view(values) -> Optional[np.ndarray]:
+    """Zero-copy numpy view when the input already lives on host."""
+    if isinstance(values, np.ndarray):
+        return values
+    return None
+
+
+def _has_nan(values) -> bool:
+    if not np.issubdtype(np.asarray(values).dtype if isinstance(
+            values, np.ndarray) else values.dtype, np.floating):
+        return False
+    host = _host_view(values)
+    if host is not None:
+        return bool(np.isnan(host).any())
+    # device input: one tiny reduction instead of pulling the panel
+    import jax.numpy as jnp
+    return bool(jnp.any(jnp.isnan(values)))
+
+
+def _interior_gap_count(host: np.ndarray) -> int:
+    """Lanes with NaN strictly inside their observed window (the class
+    the ragged machinery cannot mask — same policy as
+    ``ops.ragged.ragged_view``, checked host-side because the engine's
+    traced fits cannot raise on data)."""
+    obs = ~np.isnan(host)
+    n = host.shape[-1]
+    any_obs = obs.any(axis=-1)
+    start = obs.argmax(axis=-1)
+    last = n - 1 - obs[:, ::-1].argmax(axis=-1)
+    window = np.where(any_obs, last - start + 1, 0)
+    return int(np.sum(obs.sum(axis=-1) != window))
+
+
+def _multi_device(values) -> bool:
+    sharding = getattr(values, "sharding", None)
+    if sharding is None:
+        return False
+    try:
+        return len(sharding.device_set) > 1
+    except Exception:  # noqa: BLE001 — exotic sharding: be conservative
+        return True
+
+
+# ---------------------------------------------------------------------------
+# results
+# ---------------------------------------------------------------------------
+
+class StreamResult(NamedTuple):
+    """Outcome of one :meth:`FitEngine.stream_fit` pass.
+
+    ``n_fitted`` counts the series whose chunks completed (``n_series``
+    minus poisoned-chunk lanes); ``models`` is None unless
+    ``collect=True`` (then a list of per-chunk host model pytrees, lanes
+    sliced back to the chunk's real count).  ``stats`` carries the
+    per-call engine accounting bench embeds: cache hits/misses, compile
+    seconds, bytes donated/transferred, pad lanes, chunk count."""
+    n_series: int
+    n_fitted: int
+    n_converged: int
+    wall_s: float
+    n_chunks: int
+    chunk_failures: List[Dict[str, Any]]
+    models: Optional[List[Any]]
+    stats: Dict[str, Any]
+
+    @property
+    def rate(self) -> float:
+        """Fitted series per second (0 when nothing completed)."""
+        return self.n_fitted / self.wall_s if self.wall_s > 0 else 0.0
+
+
+class _Entry(NamedTuple):
+    compiled: Any
+    skeleton: _Skeleton
+    bucket: Tuple[int, int]
+    variant: str
+    donate: bool
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+class FitEngine:
+    """Shape-bucketed AOT executable cache + streaming chunk executor.
+
+    One engine instance owns one executable cache; the module-level
+    :func:`default_engine` is what ``Panel.fit_resilient`` and
+    ``models.arima.fit_panel`` route through.  Thread-safe: the cache is
+    lock-guarded, and executables themselves are immutable.
+
+    ``donate``: ``None`` (auto) donates chunk buffers on accelerators and
+    skips donation on CPU (XLA CPU cannot alias them and would warn);
+    True/False force.  ``prefetch``: how many dispatched chunks may be
+    pending ahead of the one being drained in :meth:`stream_fit`
+    (1 = the classic double buffer — two chunks live during overlap;
+    the default 2 keeps a third in flight to ride out pull jitter).
+    """
+
+    def __init__(self, *, registry: Optional[Any] = None,
+                 prefetch: int = 2, donate: Optional[bool] = None,
+                 compile_cache_dir: Optional[str] = None):
+        self._reg = registry if registry is not None \
+            else _metrics.get_registry()
+        self.prefetch = max(1, int(prefetch))
+        self._donate = donate
+        self._lock = threading.RLock()
+        self._entries: Dict[tuple, _Entry] = {}
+        configure_compile_cache(compile_cache_dir)
+
+    # -- donation policy ----------------------------------------------------
+
+    def donate_default(self) -> bool:
+        if self._donate is not None:
+            return bool(self._donate)
+        import jax
+        return jax.default_backend() != "cpu"
+
+    # -- executable cache ---------------------------------------------------
+
+    def _entry(self, family: str, statics: tuple, bucket: Tuple[int, int],
+               dtype, variant: str, donate: bool) -> _Entry:
+        import jax
+
+        # canonicalize the key dtype: under x64-off, f64 input lowers to
+        # the byte-identical f32 program — two raw-dtype keys would
+        # compile it twice and double-count cache misses
+        dtype = jax.dtypes.canonicalize_dtype(np.dtype(dtype))
+        key = (family, statics, bucket, str(dtype), variant,
+               donate, jax.default_backend())
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._reg.inc("engine.cache_hits")
+                return hit
+        # compile outside the lock: one slow compile must not serialize
+        # unrelated buckets (a duplicate race costs one redundant compile,
+        # resolved by last-write-wins below)
+        self._reg.inc("engine.cache_misses")
+        jitted = _jit_for(variant, donate)
+        spec_v = jax.ShapeDtypeStruct(bucket, dtype)
+        spec_n = jax.ShapeDtypeStruct((), np.int32)
+        slot: Dict[str, Any] = {}
+        _skeleton_capture.slot = slot
+        try:
+            with _metrics.span("engine.compile"):
+                compiled = jitted.lower(family, statics, spec_v,
+                                        spec_n).compile()
+        finally:
+            _skeleton_capture.slot = None
+        skeleton = slot.get("skeleton")
+        if skeleton is None:
+            # jit served the lowering from its cache without re-tracing;
+            # one abstract re-trace recovers the skeleton
+            _skeleton_capture.slot = slot
+            try:
+                jax.eval_shape(
+                    lambda v, n: (_dense_fit if variant == "dense"
+                                  else _ragged_fit)(family, statics, v, n),
+                    spec_v, spec_n)
+            finally:
+                _skeleton_capture.slot = None
+            skeleton = slot["skeleton"]
+        entry = _Entry(compiled, skeleton, bucket, variant, donate)
+        with self._lock:
+            self._entries[key] = entry
+            self._reg.set_gauge("engine.executables", len(self._entries))
+        return entry
+
+    def cache_stats(self) -> Dict[str, int]:
+        snap = self._reg.snapshot()["counters"]
+        with self._lock:
+            n = len(self._entries)
+        return {"executables": n,
+                "cache_hits": int(snap.get("engine.cache_hits", 0)),
+                "cache_misses": int(snap.get("engine.cache_misses", 0))}
+
+    # -- model reconstruction ----------------------------------------------
+
+    @staticmethod
+    def _rebuild(skeleton: _Skeleton, arrays: Sequence[Any],
+                 n_series: int, n_obs: int, bucket: Tuple[int, int]):
+        """Executable outputs -> host model pytree, padding sliced off:
+        leading dims equal to the series bucket shrink to ``n_series``;
+        second dims equal to an *expanded* obs bucket shrink to
+        ``n_obs``.  Slicing happens host-side — a device-side gather
+        would compile one tiny program per raw shape, which is exactly
+        the per-shape compile churn the bucketed cache exists to kill."""
+        import jax
+        import jax.numpy as jnp
+
+        bs, bt = bucket
+        leaves: List[Any] = [None] * skeleton.n_leaves
+        for i, val in skeleton.static_leaves:
+            leaves[i] = val
+        for i, arr in zip(skeleton.array_pos, arrays):
+            if hasattr(arr, "ndim") and arr.ndim >= 1:
+                cut0 = arr.shape[0] == bs and bs != n_series
+                cut1 = arr.ndim >= 2 and bt != n_obs and arr.shape[1] == bt
+                if cut0 or cut1:
+                    host = np.asarray(arr)
+                    if cut0:
+                        host = host[:n_series]
+                    if cut1:
+                        host = host[:, :n_obs]
+                    arr = jnp.asarray(host)
+            leaves[i] = arr
+        return jax.tree_util.tree_unflatten(skeleton.treedef, leaves)
+
+    # -- single-shot bucketed fit (the Panel / fit_panel tier) --------------
+
+    def fit(self, values, family: str = "arima", *,
+            bucket_obs: bool = True, warn: bool = False, **kwargs):
+        """Fit one panel through the bucketed executable cache.
+
+        ``values (n_series, n_obs)``; ``kwargs`` are the family's static
+        fit parameters (arima: ``p``/``d``/``q``/``include_intercept``/
+        ``method``/``max_iter``/``retry``).  Returns the fitted model
+        with padding lanes/columns sliced off, so the result is shaped
+        exactly as the direct fit's would be.
+
+        Routing: a panel already at its bucket shape runs the dense
+        program (bit-for-bit the jitted direct fit); series-only padding
+        keeps the dense program with zero-padded lanes (real lanes
+        bit-for-bit, pad lanes sliced off); NaN input or observation-axis
+        padding takes the traced ragged program (:data:`RAGGED_FAMILIES`
+        — valid-window weighted, trimmed-fit equivalent to float
+        rounding).  Inputs the engine cannot bucket (non-2D, multi-device
+        sharded, unknown families, non-static kwargs such as arima's
+        ``user_init_params``) fall back to the direct eager fit and count
+        ``engine.bypass``.
+
+        Padding happens host-side (device-side slicing/padding would
+        compile one tiny program per raw shape — the churn the bucket
+        kills), so a *device-resident* panel that is not bucket-exact
+        pays one D2H+H2D round trip per fit; keep hot device-resident
+        loops at bucket-exact shapes (the bench's device-resident block
+        does) or feed host arrays.
+        """
+        builder = _STATICS_BUILDERS.get(family)
+        if builder is None or getattr(values, "ndim", None) != 2 \
+                or _multi_device(values) \
+                or not np.issubdtype(np.dtype(getattr(values, "dtype",
+                                                      np.float64)),
+                                     np.floating):
+            return self._direct(values, family, warn, kwargs)
+        try:
+            statics = builder(**kwargs)
+        except TypeError:
+            return self._direct(values, family, warn, kwargs)
+
+        with _metrics.span("engine.fit"):
+            n_series, n_obs = values.shape
+            bs, bt = pad_bucket(n_series, n_obs)
+            if not bucket_obs:
+                bt = n_obs
+            has_nan = _has_nan(values)
+            dtype = values.dtype
+
+            if not has_nan and (n_series, n_obs) == (bs, bt):
+                entry = self._entry(family, statics, (bs, bt), dtype,
+                                    "dense", False)
+                arrays, _ = entry.compiled(values, np.int32(n_series))
+            elif not has_nan and n_obs == bt:
+                # series-only padding: zero lanes quarantine themselves
+                # per lane and are sliced off — real lanes bit-for-bit
+                host = np.asarray(values)
+                padded = np.zeros((bs, bt), host.dtype)
+                padded[:n_series] = host
+                self._reg.inc("engine.pad_lanes", bs - n_series)
+                entry = self._entry(family, statics, (bs, bt), dtype,
+                                    "dense", False)
+                arrays, _ = entry.compiled(padded, np.int32(n_series))
+            else:
+                if family not in RAGGED_FAMILIES:
+                    return self._direct(values, family, warn, kwargs)
+                host = np.asarray(values)
+                gaps = _interior_gap_count(host)
+                if gaps:
+                    raise ValueError(
+                        f"{gaps} lane(s) have NaN strictly inside their "
+                        f"observed window; valid-window fits need "
+                        f"contiguous observations — impute interior gaps "
+                        f"first (e.g. Panel.fill), leading/trailing "
+                        f"padding needs no fill")
+                padded = np.full((bs, bt), np.nan, host.dtype)
+                padded[:n_series, :n_obs] = host
+                self._reg.inc("engine.pad_lanes", bs - n_series)
+                self._reg.inc("engine.pad_obs", bt - n_obs)
+                entry = self._entry(family, statics, (bs, bt), dtype,
+                                    "ragged", False)
+                arrays, _ = entry.compiled(padded, np.int32(n_series))
+
+            model = self._rebuild(entry.skeleton, arrays, n_series, n_obs,
+                                  entry.bucket)
+            self._reg.inc("engine.fits")
+        _metrics.record_fit(family, model, self._reg)
+        if warn and family == "arima":
+            from .models.arima import _warn_stationarity_invertibility
+            _warn_stationarity_invertibility(model, True)
+        return model
+
+    def _direct(self, values, family: str, warn: bool, kwargs):
+        """Bypass: the family's public eager fit, untouched semantics."""
+        self._reg.inc("engine.bypass")
+        from . import models as m
+
+        if family == "arima":
+            kw = dict(kwargs)
+            p, d, q = kw.pop("p", 2), kw.pop("d", 1), kw.pop("q", 2)
+            return m.arima.fit(p, d, q, values, warn=warn, **kw)
+        table = {
+            "ar": m.autoregression.fit,
+            "ewma": m.ewma.fit,
+            "garch": m.garch.fit,
+            "argarch": m.garch.fit_ar_garch,
+            "egarch": m.garch.fit_egarch,
+            "holt_winters": m.holt_winters.fit,
+        }
+        if family not in table:
+            raise ValueError(
+                f"unknown engine family {family!r}; expected one of "
+                f"{sorted(_STATICS_BUILDERS)}")
+        return table[family](values, **kwargs)
+
+    # -- resilient tier (the Panel.fit_resilient front-end) -----------------
+
+    @staticmethod
+    def resilient_dispatch(family: str) -> Callable:
+        """The family's ``fit_resilient`` entry point (the direct,
+        unbucketed chain)."""
+        from . import models
+        dispatch = {
+            "arima": models.arima.fit_resilient,
+            "arimax": models.arimax.fit_resilient,
+            "ar": models.autoregression.fit_resilient,
+            "arx": models.autoregression_x.fit_resilient,
+            "ewma": models.ewma.fit_resilient,
+            "garch": models.garch.fit_resilient,
+            "argarch": models.garch.fit_ar_garch_resilient,
+            "egarch": models.garch.fit_egarch_resilient,
+            "holt_winters": models.holt_winters.fit_resilient,
+            "regression_arima": models.regression_arima.fit_resilient,
+        }
+        if family not in dispatch:
+            raise ValueError(f"unknown model family {family!r}; expected "
+                             f"one of {sorted(dispatch)}")
+        return dispatch[family]
+
+    def fit_resilient(self, values, family: str, *args, **kwargs):
+        """Bucket the series axis, run the family's ``fit_resilient``
+        chain, slice the padding back off.
+
+        Padding lanes are all-NaN, so the existing resilience health
+        machinery classifies them unfittable and masks them out of every
+        stage — real lanes are bit-for-bit the unbucketed chain's result.
+        The observation axis is deliberately NOT padded here: the
+        resilient stages run eagerly (where ragged handling is
+        value-dependent), several families carry ``(n_obs, k)`` exogenous
+        regressors that would need matching pads, and series count is
+        what actually varies under multi-tenant traffic.  Returns
+        ``(model, FitOutcome)`` shaped for the REAL lanes.
+        """
+        fit_fn = self.resilient_dispatch(family)
+        if getattr(values, "ndim", None) != 2 or _multi_device(values) \
+                or not np.issubdtype(np.dtype(getattr(values, "dtype",
+                                                      np.float64)),
+                                     np.floating):
+            return fit_fn(values, *args, **kwargs)
+
+        n_series, n_obs = values.shape
+        bs = series_bucket(n_series)
+        if bs == n_series:
+            return fit_fn(values, *args, **kwargs)
+
+        import jax.numpy as jnp
+
+        host = np.asarray(values)
+        padded = np.full((bs, n_obs), np.nan, host.dtype)
+        padded[:n_series] = host
+        self._reg.inc("engine.pad_lanes", bs - n_series)
+        model, outcome = fit_fn(jnp.asarray(padded), *args, **kwargs)
+        model = self._slice_lanes(model, n_series, bs)
+        outcome = type(outcome)(
+            None if outcome.params is None else outcome.params[:n_series],
+            outcome.status[:n_series], outcome.attempts[:n_series],
+            outcome.fallback_used[:n_series], outcome.health[:n_series])
+        return model, outcome
+
+    @staticmethod
+    def _slice_lanes(model, n_series: int, bucket_s: int):
+        import jax
+
+        def cut(leaf):
+            if hasattr(leaf, "ndim") and getattr(leaf, "ndim", 0) >= 1 \
+                    and leaf.shape[0] == bucket_s:
+                return leaf[:n_series]
+            return leaf
+
+        return jax.tree_util.tree_map(cut, model)
+
+    # -- warmup -------------------------------------------------------------
+
+    def warmup(self, families: Sequence[str] = ("arima",),
+               shapes: Sequence[Tuple[int, int]] = ((1024, 128),),
+               *, dtype=None, variants: Optional[Sequence[str]] = None,
+               bucket: bool = True, **kwargs) -> Dict[str, Any]:
+        """Precompile executables ahead of traffic: one AOT compile per
+        ``(family, bucket(shape), variant)``.  ``kwargs`` parameterize
+        every family's statics (families that reject a kwarg use their
+        defaults).  With ``STS_COMPILE_CACHE`` armed the compiles also
+        persist to disk, so the *next* process warms from deserialization
+        alone.  Returns a summary of what was built.
+
+        ``bucket=False`` uses each shape verbatim as the executable
+        shape instead of padding it through :func:`pad_bucket` — the
+        streaming tier's keying, where full chunks run at their exact
+        ``(chunk_size, n_obs)`` (obs-axis padding would change dense
+        chunk numerics) — and compiles with the engine's stream-tier
+        donation default so the cache key matches what
+        :meth:`stream_fit` will look up.  Warm a stream with the exact
+        chunk and tail shapes (see ``bench.py``); warm single-shot
+        :meth:`fit` traffic with the default bucketing."""
+        import jax
+
+        if dtype is None:
+            import jax.numpy as jnp
+            dtype = jnp.float32
+        built = []
+        t0 = time.perf_counter()
+        with _metrics.span("engine.warmup"):
+            for family in families:
+                builder = _STATICS_BUILDERS.get(family)
+                if builder is None:
+                    raise ValueError(
+                        f"unknown engine family {family!r}; expected a "
+                        f"subset of {sorted(_STATICS_BUILDERS)}")
+                try:
+                    statics = builder(**kwargs)
+                except TypeError:
+                    statics = builder()
+                fam_variants = variants if variants is not None else (
+                    ("dense", "ragged") if family in RAGGED_FAMILIES
+                    else ("dense",))
+                don = False if bucket else self.donate_default()
+                for shape in shapes:
+                    bkt = pad_bucket(*shape) if bucket else tuple(shape)
+                    for variant in fam_variants:
+                        self._entry(family, statics, bkt, dtype,
+                                    variant, don)
+                        built.append({"family": family,
+                                      "bucket": list(bkt),
+                                      "variant": variant})
+        return {"built": built, "wall_s": round(time.perf_counter() - t0, 3),
+                "platform": jax.default_backend(),
+                **self.cache_stats()}
+
+    # -- streaming executor (the bench tier) --------------------------------
+
+    def stream_fit(self, values, family: str = "arima", *,
+                   chunk_size: int = 131072,
+                   prefetch: Optional[int] = None,
+                   donate: Optional[bool] = None,
+                   collect: bool = False, **kwargs) -> StreamResult:
+        """Fit a panel larger than device memory by streaming chunks.
+
+        Pipelining: each chunk's H2D transfer + fit is dispatched (JAX
+        dispatch is async) while earlier chunks' results are still being
+        pulled, so transfer, compute, and result D2H overlap; at most
+        ``prefetch`` dispatched chunks wait ahead of the one being
+        drained (``prefetch + 1`` briefly live on device).  Chunk
+        buffers are
+        engine-owned and (on accelerators) donated to the executable, so
+        successive chunks reuse the same HBM in place.  The tail chunk
+        pads to its own series bucket — not the full chunk shape — and
+        both tail and full-chunk executables come from the bucketed
+        cache, so re-streaming any same-shaped workload compiles nothing.
+
+        Failure isolation: a chunk whose dispatch or host materialization
+        raises is recorded in ``chunk_failures`` (and the
+        ``engine.chunk_failures`` counter) and skipped; the stream never
+        dies on one poisoned chunk.
+
+        Timing covers dispatch through host materialization of every
+        chunk's outputs — the real pipeline cost for out-of-core panels.
+        """
+        import jax
+
+        builder = _STATICS_BUILDERS.get(family)
+        if builder is None:
+            raise ValueError(
+                f"unknown engine family {family!r}; expected one of "
+                f"{sorted(_STATICS_BUILDERS)}")
+        statics = builder(**kwargs)
+        host = values if isinstance(values, np.ndarray) \
+            else np.asarray(values)
+        if host.ndim != 2:
+            raise ValueError(
+                f"stream_fit needs a (n_series, n_obs) panel, "
+                f"got {host.shape}")
+        n_series, n_obs = host.shape
+        chunk = max(1, min(int(chunk_size), n_series))
+        depth = self.prefetch if prefetch is None else max(1, int(prefetch))
+        don = self.donate_default() if donate is None else bool(donate)
+        before = self.cache_stats()
+
+        conv = 0
+        failures: List[Dict[str, Any]] = []
+        models: Optional[List[Any]] = [] if collect else None
+        pending: deque = deque()
+
+        def record_failure(start: int, n_real: int, e: Exception) -> None:
+            failures.append({"chunk_start": int(start),
+                             "n_series": int(n_real),
+                             "error": f"{type(e).__name__}: {e}"})
+            self._reg.inc("engine.chunk_failures")
+            _metrics.trace_instant("engine.chunk_failure",
+                                   {"chunk_start": int(start),
+                                    "error": type(e).__name__})
+
+        def pull(out, entry: _Entry, start: int, n_real: int) -> None:
+            nonlocal conv
+            with _metrics.span("engine.collect"):
+                try:
+                    arrays = [np.asarray(a) for a in out[0]]
+                    conv += int(out[1])
+                except Exception as e:  # noqa: BLE001 — deferred device
+                    # errors surface at materialization; isolate the chunk
+                    record_failure(start, n_real, e)
+                    return
+            self._reg.inc("engine.chunks")
+            if models is not None:
+                models.append(self._rebuild(entry.skeleton, arrays, n_real,
+                                            n_obs, entry.bucket))
+
+        t0 = time.perf_counter()
+        with _metrics.span("engine.stream"):
+            for start in range(0, n_series, chunk):
+                part = host[start:start + chunk]
+                n_real = part.shape[0]
+                bs = chunk if n_real == chunk \
+                    else min(series_bucket(n_real), chunk)
+                variant = "dense"
+                if np.issubdtype(part.dtype, np.floating) \
+                        and np.isnan(part).any():
+                    if family not in RAGGED_FAMILIES:
+                        record_failure(start, n_real, ValueError(
+                            f"NaN input needs a traced ragged fit; "
+                            f"family {family!r} has none "
+                            f"(only {RAGGED_FAMILIES})"))
+                        continue
+                    variant = "ragged"
+                    gaps = _interior_gap_count(part)
+                    if gaps:
+                        # same contract as FitEngine.fit, stream-tier
+                        # semantics: recorded, not raised
+                        record_failure(start, n_real, ValueError(
+                            f"{gaps} lane(s) have NaN strictly inside "
+                            f"their observed window; impute interior "
+                            f"gaps first"))
+                        continue
+                if n_real != bs:          # ragged tail: its own bucket
+                    fill = np.nan if variant == "ragged" else 0.0
+                    padded = np.full((bs, n_obs), fill, part.dtype)
+                    padded[:n_real] = part
+                    part = padded
+                    self._reg.inc("engine.pad_lanes", bs - n_real)
+                try:
+                    entry = self._entry(family, statics, (bs, n_obs),
+                                        part.dtype, variant, don)
+                    with _metrics.span("engine.dispatch"):
+                        dev = jax.device_put(part)
+                        out = entry.compiled(dev, np.int32(n_real))
+                    self._reg.inc("engine.bytes_h2d", int(part.nbytes))
+                    if don:
+                        self._reg.inc("engine.bytes_donated",
+                                      int(part.nbytes))
+                except Exception as e:  # noqa: BLE001 — same isolation
+                    record_failure(start, n_real, e)
+                    continue
+                pending.append((out, entry, start, n_real))
+                while len(pending) >= depth + 1:
+                    pull(*pending.popleft())
+            while pending:
+                pull(*pending.popleft())
+        wall = time.perf_counter() - t0
+
+        after = self.cache_stats()
+        n_failed = sum(f["n_series"] for f in failures)
+        stats = {
+            "cache_hits": after["cache_hits"] - before["cache_hits"],
+            "cache_misses": after["cache_misses"] - before["cache_misses"],
+            "executables": after["executables"],
+            "donated": don,
+            "prefetch": depth,
+            "chunk_size": chunk,
+        }
+        return StreamResult(n_series, max(n_series - n_failed, 0), conv,
+                            wall, -(-n_series // chunk), failures, models,
+                            stats)
+
+
+# ---------------------------------------------------------------------------
+# default engine
+# ---------------------------------------------------------------------------
+
+_default_engine: Optional[FitEngine] = None
+_default_lock = threading.Lock()
+
+
+def default_engine() -> FitEngine:
+    """The process-wide engine instance ``Panel`` and ``fit_panel`` route
+    through (lazily created; ``STS_COMPILE_CACHE`` is honored at
+    creation)."""
+    global _default_engine
+    with _default_lock:
+        if _default_engine is None:
+            _default_engine = FitEngine()
+        return _default_engine
+
+
+# ---------------------------------------------------------------------------
+# CLI: `python -m spark_timeseries_tpu.engine` (the `make warmup` target)
+# ---------------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="python -m spark_timeseries_tpu.engine",
+        description="Precompile fit executables at the given shapes "
+                    "(with STS_COMPILE_CACHE set, persists them to disk "
+                    "so later processes skip compiles entirely).")
+    ap.add_argument("--families", default="arima",
+                    help=f"comma-separated subset of {ENGINE_FAMILIES} "
+                         f"(default arima)")
+    ap.add_argument("--shapes", default="16384x128",
+                    help="comma-separated n_seriesXn_obs raw shapes; each "
+                         "warms its padding bucket (default 16384x128, "
+                         "the CPU bench chunk)")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--cache-dir", default=None,
+                    help="persistent compile cache directory (default: "
+                         "$STS_COMPILE_CACHE when set)")
+    args = ap.parse_args(argv)
+
+    families = [f for f in args.families.split(",") if f]
+    unknown = [f for f in families if f not in _STATICS_BUILDERS]
+    if unknown:
+        ap.error(f"unknown families {unknown}; expected subset of "
+                 f"{sorted(_STATICS_BUILDERS)}")
+    shapes = []
+    try:
+        for tok in args.shapes.split(","):
+            if not tok:
+                continue
+            s, t = (int(x) for x in tok.lower().split("x"))
+            if s < 1 or t < 1:
+                raise ValueError
+            shapes.append((s, t))
+        if not shapes:
+            raise ValueError
+    except ValueError:
+        ap.error(f"--shapes must be <n_series>x<n_obs>[,...] with positive "
+                 f"ints, got {args.shapes!r}")
+
+    _metrics.install_jax_hooks()
+    eng = FitEngine(compile_cache_dir=args.cache_dir)
+    report = eng.warmup(families, shapes, dtype=np.dtype(args.dtype))
+    report["jax"] = _metrics.jax_stats()
+    print(json.dumps(report, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

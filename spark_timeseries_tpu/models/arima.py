@@ -789,7 +789,8 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
         include_intercept: bool = True, method: str = "css-lm",
         user_init_params: Optional[jnp.ndarray] = None,
         warn: bool = True, max_iter: Optional[int] = None,
-        retry: Optional[_resilience.RetryPolicy] = None) -> ARIMAModel:
+        retry: Optional[_resilience.RetryPolicy] = None,
+        n_valid: Optional[jnp.ndarray] = None) -> ARIMAModel:
     """Fit an ARIMA(p, d, q) by conditional-sum-of-squares maximum likelihood
     (ref ``ARIMA.scala:79-116``).
 
@@ -856,12 +857,24 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
     set) becomes the per-attempt budget unless ``max_iter`` overrides it.
     The css-lm method then takes the XLA solver path (the Pallas kernel
     has no restart loop).
+
+    ``n_valid`` (per-lane valid-window lengths) bypasses the
+    value-dependent NaN detection entirely: ``ts`` must then already be
+    left-aligned with zeroed tails (the ``ops.ragged._left_align``
+    layout), and the whole fit — including the ragged weighting — traces
+    with no host branches, which is what the engine's AOT bucketed
+    executables (``spark_timeseries_tpu.engine``) need.  Short-lane
+    quarantine still applies, but as a traced mask without the host
+    warning.
     """
     ts = jnp.asarray(ts)
     rk = _resilience.retry_kwargs(retry)
     if max_iter is None and retry is not None and retry.max_iter is not None:
         max_iter = retry.max_iter
-    ts, obs_len = ragged_view(ts)
+    if n_valid is not None:
+        obs_len = jnp.asarray(n_valid)
+    else:
+        ts, obs_len = ragged_view(ts)
     icpt = 1 if include_intercept else 0
     diffed = differences_of_order_d(ts, d)[..., d:]
     nv = None if obs_len is None else jnp.maximum(obs_len - d, 0)
@@ -1021,10 +1034,26 @@ def _warn_stationarity_invertibility(model: ARIMAModel, warn: bool) -> None:
 
 
 @_metrics.instrument_fit("arima", record=False)
-def fit_panel(panel, p: int, d: int, q: int, **kwargs) -> ARIMAModel:
+def fit_panel(panel, p: int, d: int, q: int, engine=None,
+              **kwargs) -> ARIMAModel:
     """Batched fit over a Panel — the ``rdd.mapValues(ARIMA.fitModel(...))``
-    equivalent (ref ``src/site/markdown/docs/users.md:107-118``)."""
-    return fit(p, d, q, panel.values, **kwargs)
+    equivalent (ref ``src/site/markdown/docs/users.md:107-118``).
+
+    Routes through the streaming fit engine's shape-bucketed executable
+    cache (``spark_timeseries_tpu.engine``): the panel pads to its
+    ``pad_bucket`` shape, so fitting many same-bucket panels costs one
+    XLA compile, not one per shape.  ``engine=False`` restores the direct
+    eager fit; an explicit :class:`~spark_timeseries_tpu.engine.FitEngine`
+    uses that instance's cache.  Inputs the engine cannot bucket (sharded
+    panels, ``user_init_params``) fall back to the direct fit
+    automatically."""
+    warn = kwargs.pop("warn", True)
+    if engine is False:
+        return fit(p, d, q, panel.values, warn=warn, **kwargs)
+    from ..engine import default_engine
+    eng = engine if engine is not None else default_engine()
+    return eng.fit(panel.values, "arima", warn=warn, p=p, d=d, q=q,
+                   **kwargs)
 
 
 def _pad_to_order(model: ARIMAModel, p: int, q: int) -> ARIMAModel:

@@ -117,10 +117,18 @@ def short_lanes(obs_len: jnp.ndarray, min_n: int,
     Returns ``None`` when nothing is short.  ``what`` names the
     requirement in the message (e.g. ``"ARIMA(2,0,2) Hannan-Rissanen
     initialization"``).
+
+    Traced ``obs_len`` (a fit running under the engine's AOT executables,
+    where the lengths are runtime data) returns the traced boolean mask
+    instead: quarantine applies identically via ``jnp.where``, the host
+    warning is simply unavailable at trace time, and the mask keeps the
+    jaxpr independent of the lengths' values (the stable-jaxpr contract).
     """
     import warnings
 
     import numpy as np
+    if isinstance(obs_len, jax.core.Tracer):
+        return obs_len < min_n
     short = np.asarray(obs_len) < min_n
     if not short.any():
         return None

@@ -146,15 +146,31 @@ class Panel:
         return np.asarray(self.values[self.keys.index(key)])
 
     def select(self, keys: Sequence[Any]) -> "Panel":
-        """Sub-panel with the given keys, in the given order."""
-        locs = [self.keys.index(k) for k in keys]
-        return self._with(values=self.values[jnp.array(locs)], keys=list(keys))
+        """Sub-panel with the given keys, in the given order.
+
+        One key→position dict resolves every key (repeated ``list.index``
+        was O(n_keys * n_series)); duplicate panel keys resolve to their
+        first occurrence, matching ``list.index``.  A single vectorized
+        gather builds the value matrix."""
+        pos: dict = {}
+        for i, k in enumerate(self.keys):
+            pos.setdefault(k, i)
+        try:
+            locs = np.fromiter((pos[k] for k in keys), dtype=np.int64,
+                               count=len(keys))
+        except KeyError as e:
+            raise ValueError(f"{e.args[0]!r} is not in the panel keys") \
+                from None
+        return self._with(values=self.values[jnp.asarray(locs)],
+                          keys=list(keys))
 
     def filter_keys(self, predicate: Callable[[Any], bool]) -> "Panel":
         """Keep series whose key satisfies ``predicate``
-        (ref ``TimeSeriesRDD.scala:133-138`` filter/findSeries family)."""
-        locs = [i for i, k in enumerate(self.keys) if predicate(k)]
-        return self._with(values=self.values[jnp.array(locs)],
+        (ref ``TimeSeriesRDD.scala:133-138`` filter/findSeries family).
+        One host pass over the keys, one vectorized gather."""
+        locs = np.fromiter((i for i, k in enumerate(self.keys)
+                            if predicate(k)), dtype=np.int64)
+        return self._with(values=self.values[jnp.asarray(locs)],
                           keys=[self.keys[i] for i in locs])
 
     def filter_start_with(self, prefix: str) -> "Panel":
@@ -363,7 +379,7 @@ class Panel:
 
     # -- summary stats (ref TimeSeriesRDD.scala:265-267 seriesStats) ----------
 
-    def fit_resilient(self, family: str, *args, **kwargs):
+    def fit_resilient(self, family: str, *args, engine=None, **kwargs):
         """Fail-soft batched fit over the panel: per-series health masking,
         multi-start retry, and a declarative fallback chain — one pathological
         series (all-NaN, constant, too short, divergence-inducing) degrades
@@ -383,25 +399,25 @@ class Panel:
         series match the family's plain ``fit`` bit-for-bit, and
         ``resilience.*`` counters land in the metrics registry (surfaced in
         bench JSON).
+
+        Routes through the streaming fit engine's shape-bucketing
+        front-end (``spark_timeseries_tpu.engine``): the series axis pads
+        to its power-of-two bucket with all-NaN lanes — which the health
+        classification masks out of every stage — so panels of varying
+        series counts share the fit stages' compiled kernels instead of
+        retracing per count.  Real lanes are bit-for-bit the unbucketed
+        chain's results; the returned model and outcome are sliced to the
+        real lanes.  ``engine=False`` restores the direct dispatch; an
+        explicit :class:`~spark_timeseries_tpu.engine.FitEngine` uses
+        that instance.
         """
-        from . import models
-        dispatch = {
-            "arima": models.arima.fit_resilient,
-            "arimax": models.arimax.fit_resilient,
-            "ar": models.autoregression.fit_resilient,
-            "arx": models.autoregression_x.fit_resilient,
-            "ewma": models.ewma.fit_resilient,
-            "garch": models.garch.fit_resilient,
-            "argarch": models.garch.fit_ar_garch_resilient,
-            "egarch": models.garch.fit_egarch_resilient,
-            "holt_winters": models.holt_winters.fit_resilient,
-            "regression_arima": models.regression_arima.fit_resilient,
-        }
-        if family not in dispatch:
-            raise ValueError(f"unknown model family {family!r}; expected "
-                             f"one of {sorted(dispatch)}")
+        from .engine import FitEngine, default_engine
         with _metrics.span("panel.fit_resilient"):
-            return dispatch[family](self.values, *args, **kwargs)
+            if engine is False:
+                return FitEngine.resilient_dispatch(family)(
+                    self.values, *args, **kwargs)
+            eng = engine if engine is not None else default_engine()
+            return eng.fit_resilient(self.values, family, *args, **kwargs)
 
     def describe_costs(self, family: str = "arima") -> dict:
         """What would one compiled ``family`` fit of this panel cost?

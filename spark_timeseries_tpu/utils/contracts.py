@@ -52,12 +52,12 @@ __all__ = ["pad_bucket", "jaxpr_fingerprint", "trace_family",
 # the same ten families utils.costs knows how to lower
 from .costs import COST_FAMILIES as CONTRACT_FAMILIES  # noqa: E402
 
-# padding-bucket policy: series round up to a power of two (floor 8),
-# observation counts to a multiple of 32 (floor 32).  Raw shapes in the
-# same bucket share one compiled program; the stable-jaxpr contract is
-# what keeps that true.
-SERIES_BUCKET_FLOOR = 8
-OBS_BUCKET_MULTIPLE = 32
+# padding-bucket policy: defined by the streaming fit engine (its hot
+# path is what actually pads panels to buckets); re-exported here so the
+# stable-jaxpr contract provably asserts the SAME policy the engine
+# executes, and so `from utils.contracts import pad_bucket` keeps working.
+from ..engine import (OBS_BUCKET_MULTIPLE,  # noqa: E402,F401
+                      SERIES_BUCKET_FLOOR, pad_bucket)
 
 # jaxpr primitives that reach back to the host at runtime
 _CALLBACK_PRIMITIVES = ("pure_callback", "io_callback", "debug_callback",
@@ -81,18 +81,6 @@ class ContractResult:
     def to_json(self) -> Dict[str, Any]:
         return {"contract": self.contract, "family": self.family,
                 "ok": self.ok, "detail": self.detail}
-
-
-def pad_bucket(n_series: int, n_obs: int) -> Tuple[int, int]:
-    """Canonical padded shape for a raw panel shape: series to the next
-    power of two (floor 8), observations to the next multiple of 32
-    (floor 32)."""
-    s = SERIES_BUCKET_FLOOR
-    while s < n_series:
-        s *= 2
-    t = max(OBS_BUCKET_MULTIPLE,
-            -(-n_obs // OBS_BUCKET_MULTIPLE) * OBS_BUCKET_MULTIPLE)
-    return s, t
 
 
 def trace_family(family: str, n_series: int, n_obs: int, dtype=None):

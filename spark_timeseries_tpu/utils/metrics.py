@@ -671,6 +671,7 @@ def install_jax_hooks(registry: Optional[MetricsRegistry] = None) -> bool:
     # first compile still carries them (bench artifacts stay uniform)
     reg.counter("jax.jit_compiles")
     reg.counter("jax.cache_misses")
+    reg.counter("jax.cache_hits")
     reg.counter("jax.transfers")
     reg.histogram("jax.compile_s")
     return True
@@ -733,6 +734,7 @@ def jax_stats(registry: Optional[MetricsRegistry] = None,
         "compile_s_total": hist_sum("jax.compile_s"),
         "trace_s_total": hist_sum("jax.trace_s"),
         "cache_misses": int(c.get("jax.cache_misses", 0)),
+        "cache_hits": int(c.get("jax.cache_hits", 0)),
         "transfers": int(c.get("jax.transfers", 0)),
         "transfer_s_total": hist_sum("jax.transfer_s"),
     }

@@ -265,6 +265,17 @@ def _clear_jit_caches() -> None:
         jax.clear_caches()
     except Exception:  # pragma: no cover — very old jax
         pass
+    # the streaming engine's AOT executables are compiled objects held
+    # outside jax's caches — same staleness hazard, same flush
+    try:
+        from .. import engine as _engine
+        eng = _engine._default_engine
+        if eng is not None:
+            with eng._lock:
+                eng._entries.clear()
+    except Exception:  # pragma: no cover — engine import failure must
+        # never break fault scoping
+        pass
 
 
 @contextlib.contextmanager

@@ -103,6 +103,48 @@ def test_bench_suite_degrades_to_labeled_cpu_record():
     assert len(measured) >= 8
 
 
+def test_engine_records_poisoned_chunk_instead_of_raising(monkeypatch):
+    """The streaming engine replaced bench.py's inline chunk loop; the
+    bench-tier isolation contract moves with it: a chunk whose dispatch
+    raises is *recorded* (result + ``engine.chunk_failures`` counter) and
+    skipped — the stream, and therefore the bench round, never dies on
+    one poisoned chunk."""
+    import numpy as np
+
+    from spark_timeseries_tpu import engine as E
+    from spark_timeseries_tpu.utils import metrics
+
+    rng = np.random.default_rng(0)
+    panel = rng.normal(size=(192, 48)).astype(np.float32).cumsum(axis=1)
+
+    eng = E.FitEngine()
+    real_entry = E.FitEngine._entry
+    calls = {"n": 0}
+
+    def poisoned_entry(self, *args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 2:            # second chunk's executable lookup
+            raise RuntimeError("injected: poisoned chunk")
+        return real_entry(self, *args, **kwargs)
+
+    monkeypatch.setattr(E.FitEngine, "_entry", poisoned_entry)
+    reg = metrics.get_registry()
+    before = reg.snapshot()["counters"].get("engine.chunk_failures", 0)
+
+    res = eng.stream_fit(panel, "arima", chunk_size=64, p=1, d=0, q=1)
+
+    assert res.n_chunks == 3
+    assert len(res.chunk_failures) == 1
+    failure = res.chunk_failures[0]
+    assert failure["chunk_start"] == 64 and failure["n_series"] == 64
+    assert "injected: poisoned chunk" in failure["error"]
+    # coverage shrinks by exactly the poisoned chunk's lanes; the healthy
+    # chunks' work is kept
+    assert res.n_fitted == 192 - 64
+    assert res.n_converged > 0
+    assert reg.snapshot()["counters"]["engine.chunk_failures"] == before + 1
+
+
 @pytest.mark.timeout(900)
 def test_roofline_degrades_to_labeled_cpu_record():
     out = _run_degraded(

@@ -183,6 +183,41 @@ class TestSliceFilter:
         assert p.filter_end_with("1").keys == ["k1"]
         assert p.select(["k2", "k0"]).keys == ["k2", "k0"]
 
+    def test_select_gathers_values_in_key_order(self):
+        p = _uniform_panel()
+        sub = p.select(["k2", "k0"])
+        np.testing.assert_array_equal(np.asarray(sub.values),
+                                      np.asarray(p.values)[[2, 0]])
+        # repeated requested keys are allowed (one gather, any order)
+        dup = p.select(["k1", "k1"])
+        assert dup.keys == ["k1", "k1"]
+        np.testing.assert_array_equal(np.asarray(dup.values),
+                                      np.asarray(p.values)[[1, 1]])
+
+    def test_select_duplicate_panel_keys_resolve_first_occurrence(self):
+        # list.index semantics: the first matching position wins
+        idx = uniform("2015-04-09T00:00Z", 4, DayFrequency(1))
+        vals = np.arange(12.0).reshape(3, 4)
+        p = Panel(idx, vals, ["a", "b", "a"])
+        np.testing.assert_array_equal(np.asarray(p.select(["a"]).values),
+                                      vals[[0]])
+
+    def test_select_missing_key_raises_value_error(self):
+        p = _uniform_panel()
+        with pytest.raises(ValueError, match="not in the panel keys"):
+            p.select(["k0", "missing"])
+
+    def test_filter_keys_empty_and_large(self):
+        p = _uniform_panel()
+        assert p.filter_keys(lambda k: False).n_series == 0
+        # O(n) path: one dict/pass + one gather even for many keys
+        big = _uniform_panel(n_series=257, n_obs=8)
+        sub = big.select([f"k{i}" for i in range(256, -1, -2)])
+        assert sub.keys[0] == "k256" and sub.n_series == 129
+        np.testing.assert_array_equal(
+            np.asarray(sub.values),
+            np.asarray(big.values)[list(range(256, -1, -2))])
+
 
 class TestUnionStats:
     def test_union_and_add_series(self):

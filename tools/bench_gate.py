@@ -23,7 +23,13 @@ automated check (``make gate``):
   fit_wall_s           ``metrics.spans["bench.fit_panel"]`` p50  higher
   compile_s_total      ``metrics.compile_s_total``               higher
   jit_compiles         ``metrics.jit_compiles``                  higher
+  engine_cache_misses  ``metrics.engine["engine.cache_misses"]`` higher
   ===================  ========================================  =======
+
+  (``engine_cache_misses`` is the streaming engine's executable-cache
+  miss count — a >50% jump over the trailing median means fits stopped
+  sharing bucketed executables, i.e. the compile-amortization win
+  regressed even if wall time hasn't caught it yet.)
 
 - prints a pass/fail table with signed percentage deltas and exits 1 on
   any regression, 0 otherwise.  A newest round that crashed (``rc != 0``)
@@ -59,6 +65,7 @@ METRICS = [
     ("fit_wall_s", "lower_better", 25.0),
     ("compile_s_total", "lower_better", 50.0),
     ("jit_compiles", "lower_better", 50.0),
+    ("engine_cache_misses", "lower_better", 50.0),
 ]
 
 
@@ -136,6 +143,10 @@ def extract_metrics(headline: Optional[dict]) -> Dict[str, float]:
             out["compile_s_total"] = float(m["compile_s_total"])
         if isinstance(m.get("jit_compiles"), (int, float)):
             out["jit_compiles"] = float(m["jit_compiles"])
+        eng = m.get("engine")
+        if isinstance(eng, dict) and isinstance(
+                eng.get("engine.cache_misses"), (int, float)):
+            out["engine_cache_misses"] = float(eng["engine.cache_misses"])
     return out
 
 
@@ -232,19 +243,19 @@ def render(verdict: Dict[str, Any]) -> str:
     lines.append(f"bench gate: round r{verdict['round']:02d} "
                  f"(platform={verdict['platform']}) vs median of rounds "
                  f"{['r%02d' % r for r in verdict['baseline_rounds']]}")
-    hdr = (f"{'metric':<17} {'newest':>12} {'baseline':>12} "
+    hdr = (f"{'metric':<20} {'newest':>12} {'baseline':>12} "
            f"{'delta%':>8} {'thr%':>6}  status")
     lines.append(hdr)
     lines.append("-" * len(hdr))
     for row in verdict["rows"]:
         if row["status"] == "skipped":
-            lines.append(f"{row['metric']:<17} {'-':>12} {'-':>12} "
+            lines.append(f"{row['metric']:<20} {'-':>12} {'-':>12} "
                          f"{'-':>8} {row['threshold_pct']:>6.0f}  "
                          f"skipped ({row['note']})")
             continue
         delta = row.get("delta_pct")
         lines.append(
-            f"{row['metric']:<17} {row['value']:>12.2f} "
+            f"{row['metric']:<20} {row['value']:>12.2f} "
             f"{row['baseline']:>12.2f} "
             f"{('%+.1f' % delta) if delta is not None else '-':>8} "
             f"{row['threshold_pct']:>6.0f}  {row['status']}")
