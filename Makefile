@@ -10,8 +10,9 @@ WARMUP_FAMILIES ?= arima
 WARMUP_SHAPES ?= 16384x128
 STS_COMPILE_CACHE ?=
 
-.PHONY: help verify compileall tier1 verify-faults verify-perf gate trace \
-	lint lint-baseline contracts verify-static warmup
+.PHONY: help verify compileall tier1 verify-faults verify-durability \
+	verify-perf gate trace lint lint-baseline contracts verify-static \
+	warmup
 
 help:
 	@echo "Targets:"
@@ -22,7 +23,10 @@ help:
 	@echo "  lint-baseline regenerate tools/sts_lint/baseline.json (the debt ledger)"
 	@echo "  contracts     jaxpr/HLO contract checks for all ten fit families"
 	@echo "  verify-static lint + contracts (the full static-analysis gate)"
-	@echo "  verify-faults tier-1 sweep with STS_FAULT_INJECT=1 (retry/fallback paths forced)"
+	@echo "  verify-faults tier-1 sweep with STS_FAULT_INJECT=1 (retry/fallback paths forced),"
+	@echo "                plus the verify-durability subset"
+	@echo "  verify-durability durable-streaming suite (chunk journal + resume, deadlines,"
+	@echo "                quarantine/backoff, OOM degradation) under every fault mode"
 	@echo "  verify-perf   perf gate: newest BENCH_r*.json vs trailing-median baseline"
 	@echo "  gate          same as verify-perf (tools/bench_gate.py; exit 1 on regression)"
 	@echo "  trace         run a small demo workload, write trace.json (open in ui.perfetto.dev)"
@@ -73,10 +77,27 @@ tier1:
 # SUCCEED here, or a regression in them would be invisible).  Plain fits
 # are unaffected; the bit-for-bit equivalence tests skip themselves
 # under this flag.
-verify-faults:
+verify-faults: verify-durability
 	STS_FAULT_INJECT=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 		-m 'not slow' --continue-on-collection-errors \
 		-p no:cacheprovider -p no:xdist -p no:randomly
+
+# durable-streaming gate (ISSUE 6): the `durability`-marked subset
+# exercises every recovery path deterministically — hang -> deadline
+# fires, OOM -> degradation splits, corrupt journal -> detected and
+# quarantined, kill -9 -> journal resume (subprocess pair) — via the
+# utils.resilience streaming fault modes.  Two passes: once with the
+# knobs passed explicitly by the tests, once with the env-derived
+# defaults armed (STS_CHUNK_DEADLINE_S / STS_CHUNK_RETRIES), so both
+# configuration paths stay alive.
+verify-durability:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m durability \
+		--continue-on-collection-errors -p no:cacheprovider \
+		-p no:xdist -p no:randomly
+	STS_CHUNK_DEADLINE_S=300 STS_CHUNK_RETRIES=1 JAX_PLATFORMS=cpu \
+		$(PY) -m pytest tests/ -q -m durability \
+		--continue-on-collection-errors -p no:cacheprovider \
+		-p no:xdist -p no:randomly
 
 # perf regression gate over the recorded BENCH_r*.json trajectory: the
 # newest round is compared per headline metric (throughput, fit wall

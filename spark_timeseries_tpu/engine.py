@@ -45,7 +45,13 @@ Three tiers, layered:
   bucket, not the full chunk shape), and per-chunk failure isolation —
   a poisoned chunk is *recorded* in the result and in
   ``engine.chunk_failures``, never raised, matching the bench-tier
-  semantics it replaces.
+  semantics it replaces.  On top of it sits the opt-in **durability
+  tier** (docs/design.md §3c; ``utils.durability``): a crash-consistent
+  chunk journal with validated resume (``journal=``), a per-chunk
+  deadline watchdog (``STS_CHUNK_DEADLINE_S``), end-of-stream
+  quarantine retries with bounded backoff (``retry=``), and
+  OOM-adaptive chunk halving (``engine.degraded_chunks``) — all
+  strictly host-side.
 
 Numerics contract: a panel already at its bucket shape (dense, no NaN)
 runs the exact program ``jax.jit(models.<family>.fit)`` would run —
@@ -68,15 +74,20 @@ every BENCH record.
 from __future__ import annotations
 
 import os
+import signal
 import threading
 import time
+import traceback as _traceback
 from collections import deque
 from typing import (Any, Callable, Dict, List, NamedTuple, Optional,
                     Sequence, Tuple)
 
 import numpy as np
 
+from .utils import durability as _durability
 from .utils import metrics as _metrics
+from .utils.durability import (BackoffPolicy, ChunkDeadlineExceeded,
+                               JournalSpecMismatch)
 
 __all__ = [
     "SERIES_BUCKET_FLOOR", "OBS_BUCKET_MULTIPLE",
@@ -84,6 +95,7 @@ __all__ = [
     "configure_compile_cache",
     "FitEngine", "StreamResult", "default_engine",
     "ENGINE_FAMILIES", "RAGGED_FAMILIES",
+    "BackoffPolicy", "ChunkDeadlineExceeded", "JournalSpecMismatch",
 ]
 
 # ---------------------------------------------------------------------------
@@ -361,15 +373,24 @@ def _multi_device(values) -> bool:
 # results
 # ---------------------------------------------------------------------------
 
+class _ChunkDataError(ValueError):
+    """A chunk's input violates the engine's data contract (NaN for a
+    family without a ragged fit, interior gaps).  Deterministic — the
+    same data fails the same way forever — so these failures are
+    terminal: recorded immediately, never quarantined for retry."""
+
+
 class StreamResult(NamedTuple):
     """Outcome of one :meth:`FitEngine.stream_fit` pass.
 
     ``n_fitted`` counts the series whose chunks completed (``n_series``
-    minus poisoned-chunk lanes); ``models`` is None unless
-    ``collect=True`` (then a list of per-chunk host model pytrees, lanes
-    sliced back to the chunk's real count).  ``stats`` carries the
-    per-call engine accounting bench embeds: cache hits/misses, compile
-    seconds, bytes donated/transferred, pad lanes, chunk count."""
+    minus dead-chunk lanes); ``models`` is None unless ``collect=True``
+    (then a list of per-chunk host model pytrees in series order, lanes
+    sliced back to the chunk's real count — a chunk degraded under
+    memory pressure contributes one model per sub-chunk).  ``stats``
+    carries the per-call engine accounting bench embeds: cache
+    hits/misses, bytes donated/transferred, chunk/journal/durability
+    counters."""
     n_series: int
     n_fitted: int
     n_converged: int
@@ -777,7 +798,13 @@ class FitEngine:
                    chunk_size: int = 131072,
                    prefetch: Optional[int] = None,
                    donate: Optional[bool] = None,
-                   collect: bool = False, **kwargs) -> StreamResult:
+                   collect: bool = False,
+                   journal: Optional[str] = None,
+                   deadline_s: Optional[float] = None,
+                   retry=None,
+                   degrade: bool = True,
+                   degrade_floor: Optional[int] = None,
+                   **kwargs) -> StreamResult:
         """Fit a panel larger than device memory by streaming chunks.
 
         Pipelining: each chunk's H2D transfer + fit is dispatched (JAX
@@ -795,12 +822,48 @@ class FitEngine:
         Failure isolation: a chunk whose dispatch or host materialization
         raises is recorded in ``chunk_failures`` (and the
         ``engine.chunk_failures`` counter) and skipped; the stream never
-        dies on one poisoned chunk.
+        dies on one poisoned chunk.  Records carry the chunk's
+        ``(chunk_start, chunk_stop, bucket)``, the exception type, and a
+        truncated traceback, so quarantine triage is actionable.
+
+        Durability tier (docs/design.md §3c), all host-side:
+
+        - ``journal=path``: a crash-consistent chunk journal
+          (:class:`~spark_timeseries_tpu.utils.durability.ChunkJournal`).
+          Every completed chunk's model commits atomically
+          (tmp+rename payload, ``.ok`` marker rename as the commit
+          point, content-hashed against the job spec); re-running with
+          the same path skips committed chunks via a validated restore
+          (``engine.journal_hits``), so a killed job resumes where it
+          died with bitwise-identical results.  A journal written by a
+          different job spec refuses to resume
+          (:class:`JournalSpecMismatch`); a corrupt entry is detected,
+          moved to ``quarantine/``, and its chunk refit.
+        - ``deadline_s`` (default: ``STS_CHUNK_DEADLINE_S``, unset =
+          off): a watchdog thread arms a timer around each chunk's
+          dispatch and result materialization; a chunk that outlives it
+          raises :class:`ChunkDeadlineExceeded` on the caller's side
+          (the hung worker thread is abandoned) and the stream
+          continues.
+        - ``retry`` (int or
+          :class:`~spark_timeseries_tpu.utils.durability.BackoffPolicy`,
+          default ``STS_CHUNK_RETRIES`` → 0): failed/timed-out chunks
+          queue in quarantine and are retried at end-of-stream with
+          deterministic exponential backoff before being declared dead
+          (``engine.dead_chunks``).
+        - ``degrade`` (default True): a chunk whose dispatch dies with
+          ``RESOURCE_EXHAUSTED`` is halved and re-dispatched as two
+          sub-chunks, recursing down to ``degrade_floor`` (default
+          :data:`SERIES_BUCKET_FLOOR`) — ``engine.degraded_chunks``
+          counts the splits; at the floor the OOM quarantines like any
+          other failure.
 
         Timing covers dispatch through host materialization of every
         chunk's outputs — the real pipeline cost for out-of-core panels.
         """
         import jax
+
+        from .utils import resilience as _resilience
 
         builder = _STATICS_BUILDERS.get(family)
         if builder is None:
@@ -819,90 +882,405 @@ class FitEngine:
         depth = self.prefetch if prefetch is None else max(1, int(prefetch))
         don = self.donate_default() if donate is None else bool(donate)
         before = self.cache_stats()
+        partition = [(s, min(s + chunk, n_series))
+                     for s in range(0, n_series, chunk)]
+
+        if deadline_s is None:
+            env = os.environ.get("STS_CHUNK_DEADLINE_S")
+            try:
+                deadline = float(env) if env else None
+            except ValueError:
+                raise ValueError(
+                    f"STS_CHUNK_DEADLINE_S must be a number of seconds, "
+                    f"got {env!r}") from None
+        else:
+            deadline = float(deadline_s)
+        if deadline is not None and deadline <= 0:
+            deadline = None
+        policy = _durability.as_backoff(retry)
+        floor = SERIES_BUCKET_FLOOR if degrade_floor is None \
+            else max(1, int(degrade_floor))
+
+        jr = None
+        if journal:
+            # the job spec the journal is content-hashed against: any
+            # change to what a committed chunk MEANS (family, statics,
+            # dtype, bucket policy, chunk partition, the panel's bytes)
+            # must refuse resume — same-shape different data would
+            # otherwise silently restore a previous job's results
+            jr = _durability.ChunkJournal.open(journal, {
+                "format": 1,
+                "family": family,
+                "statics": repr(statics),
+                "dtype": str(np.dtype(host.dtype)),
+                "n_series": int(n_series),
+                "n_obs": int(n_obs),
+                "chunk_size": int(chunk),
+                "bucket_policy": [SERIES_BUCKET_FLOOR, OBS_BUCKET_MULTIPLE],
+                "data_sha256": _durability.array_digest(host),
+            })
+        keep_models = collect or jr is not None
 
         conv = 0
+        dead_series = 0
         failures: List[Dict[str, Any]] = []
-        models: Optional[List[Any]] = [] if collect else None
+        collected: Dict[int, Any] = {}
         pending: deque = deque()
+        quarantine: List[Dict[str, Any]] = []
+        durex = {"journal_hits": 0, "journal_commits": 0,
+                 "journal_corrupt": 0, "degraded_chunks": 0,
+                 "quarantined": 0, "retry_attempts": 0, "recovered": 0,
+                 "dead_chunks": 0, "abandoned_workers": 0}
 
-        def record_failure(start: int, n_real: int, e: Exception) -> None:
-            failures.append({"chunk_start": int(start),
-                             "n_series": int(n_real),
-                             "error": f"{type(e).__name__}: {e}"})
-            self._reg.inc("engine.chunk_failures")
-            _metrics.trace_instant("engine.chunk_failure",
-                                   {"chunk_start": int(start),
-                                    "error": type(e).__name__})
+        def _with_deadline(fn: Callable[[], Any], stage: str,
+                           start: int, stop: int):
+            """Run one blocking chunk stage under the watchdog: the work
+            happens in a daemon thread, the caller waits at most
+            ``deadline`` seconds.  On expiry the worker is abandoned
+            (its eventual result is discarded) and the chunk fails like
+            any other — strictly host-side, nothing here is traced."""
+            if deadline is None:
+                return fn()
+            box: Dict[str, Any] = {}
+            done = threading.Event()
 
-        def pull(out, entry: _Entry, start: int, n_real: int) -> None:
-            nonlocal conv
-            with _metrics.span("engine.collect"):
+            def _run():
                 try:
-                    arrays = [np.asarray(a) for a in out[0]]
-                    conv += int(out[1])
-                except Exception as e:  # noqa: BLE001 — deferred device
-                    # errors surface at materialization; isolate the chunk
-                    record_failure(start, n_real, e)
-                    return
+                    box["value"] = fn()
+                except BaseException as e:  # noqa: BLE001 — relayed below
+                    box["error"] = e
+                finally:
+                    done.set()
+
+            worker = threading.Thread(
+                target=_run, daemon=True,
+                name=f"sts-chunk-{start}-{stage}")
+            worker.start()
+            if not done.wait(deadline):
+                durex["abandoned_workers"] += 1
+                self._reg.inc("engine.deadline_expired")
+                self._reg.inc("engine.abandoned_workers")
+                _metrics.trace_instant(
+                    "engine.deadline_expired",
+                    {"chunk_start": int(start), "chunk_stop": int(stop),
+                     "stage": stage, "deadline_s": deadline})
+                err = ChunkDeadlineExceeded(
+                    f"chunk [{start}, {stop}) exceeded the {deadline:g}s "
+                    f"per-chunk deadline during {stage} "
+                    f"(deadline_s= / STS_CHUNK_DEADLINE_S); the worker "
+                    f"thread is abandoned and the stream continues")
+                # the retry loop gates on this: while the abandoned
+                # worker lives, it may still own the range's device
+                # buffers and eventually execute its fit
+                err.worker = worker
+                raise err
+            if "error" in box:
+                raise box["error"]
+            return box["value"]
+
+        def _prep(start: int, stop: int):
+            """Slice + pad one row range to its series bucket.  Raises
+            :class:`_ChunkDataError` on deterministic data-contract
+            violations (terminal — a retry cannot change the data)."""
+            part = host[start:stop]
+            n_real = stop - start
+            bs = chunk if n_real == chunk \
+                else min(series_bucket(n_real), chunk)
+            variant = "dense"
+            if np.issubdtype(part.dtype, np.floating) \
+                    and np.isnan(part).any():
+                if family not in RAGGED_FAMILIES:
+                    raise _ChunkDataError(
+                        f"NaN input needs a traced ragged fit; family "
+                        f"{family!r} has none (only {RAGGED_FAMILIES})")
+                variant = "ragged"
+                gaps = _interior_gap_count(part)
+                if gaps:
+                    raise _ChunkDataError(
+                        f"{gaps} lane(s) have NaN strictly inside their "
+                        f"observed window; impute interior gaps first")
+            if n_real != bs:          # ragged tail: its own bucket
+                fill = np.nan if variant == "ragged" else 0.0
+                padded = np.full((bs, n_obs), fill, part.dtype)
+                padded[:n_real] = part
+                part = padded
+                self._reg.inc("engine.pad_lanes", bs - n_real)
+            return part, bs, variant, n_real
+
+        def _dispatch(idx: int, start: int, stop: int):
+            """Prep + executable lookup + async dispatch under the
+            deadline (compiles can hang too).  Returns
+            ``(out, entry, n_real)``."""
+            part, bs, variant, n_real = _prep(start, stop)
+            oom = _resilience.chunk_fault("oom_chunk", idx)
+            if oom is not None and (start, stop) == partition[idx]:
+                # fires at the full chunk size only, so the degraded
+                # sub-chunks it provokes run clean
+                raise _resilience.InjectedOOM(
+                    "RESOURCE_EXHAUSTED: injected oom_chunk fault")
+
+            def work():
+                hang = _resilience.chunk_fault("hang_chunk", idx)
+                if hang is not None:
+                    time.sleep(hang.hang_s)
+                entry = self._entry(family, statics, (bs, n_obs),
+                                    part.dtype, variant, don)
+                with _metrics.span("engine.dispatch"):
+                    dev = jax.device_put(part)
+                    out = entry.compiled(dev, np.int32(n_real))
+                return entry, out
+
+            entry, out = _with_deadline(work, "dispatch", start, stop)
+            self._reg.inc("engine.bytes_h2d", int(part.nbytes))
+            if don:
+                self._reg.inc("engine.bytes_donated", int(part.nbytes))
+            return out, entry, n_real
+
+        def _materialize(out, entry: _Entry, idx: int, start: int,
+                         stop: int, n_real: int) -> None:
+            """Block on the chunk's outputs under the deadline, then
+            publish (and journal-commit) the result."""
+            def work():
+                with _metrics.span("engine.collect"):
+                    return [np.asarray(a) for a in out[0]], int(out[1])
+
+            arrays, c = _with_deadline(work, "materialize", start, stop)
+            _publish(entry, arrays, c, idx, start, stop, n_real)
+
+        def _publish(entry: _Entry, arrays, c: int, idx: int, start: int,
+                     stop: int, n_real: int) -> None:
+            nonlocal conv
+            conv += c
             self._reg.inc("engine.chunks")
-            if models is not None:
-                models.append(self._rebuild(entry.skeleton, arrays, n_real,
-                                            n_obs, entry.bucket))
+            model = None
+            if keep_models:
+                model = self._rebuild(entry.skeleton, arrays, n_real,
+                                      n_obs, entry.bucket)
+            if jr is not None:
+                jr.commit(start, stop, model,
+                          {"n_real": int(n_real), "n_conv": int(c),
+                           "bucket": list(entry.bucket),
+                           "variant": entry.variant})
+                durex["journal_commits"] += 1
+                self._reg.inc("engine.journal_commits")
+                full = (start, stop) == partition[idx]
+                if full and _resilience.chunk_fault(
+                        "kill_after_chunk", idx) is not None:
+                    os.kill(os.getpid(), signal.SIGKILL)
+                if full and _resilience.chunk_fault(
+                        "corrupt_journal", idx) is not None:
+                    jr.corrupt_entry(start, stop)
+            if collect:
+                collected[start] = model
+
+        def _failure_kind(e: Exception) -> str:
+            if isinstance(e, ChunkDeadlineExceeded):
+                return "deadline"
+            if _durability.is_oom(e):
+                return "oom"
+            return "error"
+
+        def _record_terminal(start: int, stop: int, e: Exception,
+                             kind: str, attempts: int) -> None:
+            """Declare one row range dead: the actionable failure record
+            (exception type, truncated traceback, chunk geometry) plus
+            counters.  ``engine.dead_chunks`` counts quarantine
+            exhaustion, not deterministic data rejections."""
+            nonlocal dead_series
+            n_real = stop - start
+            dead_series += n_real
+            bs = chunk if n_real == chunk \
+                else min(series_bucket(n_real), chunk)
+            tb = "".join(_traceback.format_exception(
+                type(e), e, e.__traceback__))
+            failures.append({
+                "chunk_start": int(start),
+                "chunk_stop": int(stop),
+                "n_series": int(n_real),
+                "bucket": int(bs),
+                "kind": kind,
+                "error_type": type(e).__name__,
+                "error": f"{type(e).__name__}: {e}",
+                "traceback": tb[-2000:],
+                "attempts": int(attempts),
+            })
+            self._reg.inc("engine.chunk_failures")
+            if kind != "data":
+                durex["dead_chunks"] += 1
+                self._reg.inc("engine.dead_chunks")
+            _metrics.trace_instant(
+                "engine.chunk_failure",
+                {"chunk_start": int(start), "chunk_stop": int(stop),
+                 "kind": kind, "error": type(e).__name__})
+
+        def _quarantine(idx: int, start: int, stop: int, e: Exception,
+                        kind: str) -> None:
+            durex["quarantined"] += 1
+            self._reg.inc("engine.quarantined")
+            _metrics.trace_instant(
+                "engine.quarantine",
+                {"chunk_start": int(start), "chunk_stop": int(stop),
+                 "kind": kind, "error": type(e).__name__})
+            quarantine.append({"idx": idx, "start": start, "stop": stop,
+                               "error": e, "kind": kind})
+
+        def _split(idx: int, start: int, stop: int) -> None:
+            """OOM degradation: halve the range and run each half
+            synchronously; halves route their own failures (an OOM in a
+            half that can still halve recurses toward the floor)."""
+            durex["degraded_chunks"] += 1
+            self._reg.inc("engine.degraded_chunks")
+            mid = start + (stop - start) // 2
+            _metrics.trace_instant(
+                "engine.degrade_split",
+                {"chunk_start": int(start), "chunk_stop": int(stop),
+                 "mid": int(mid)})
+            for a, b in ((start, mid), (mid, stop)):
+                try:
+                    _run_sync(idx, a, b)
+                except _ChunkDataError as e:
+                    _record_terminal(a, b, e, "data", 1)
+                except Exception as e:  # noqa: BLE001 — chunk isolation
+                    _quarantine(idx, a, b, e, _failure_kind(e))
+
+        def _run_sync(idx: int, start: int, stop: int) -> None:
+            """One synchronous attempt at exactly ``[start, stop)``;
+            raises on failure.  An OOM that can still split degrades
+            instead (each half then succeeds or routes itself), which
+            counts as this attempt succeeding.  Both stages sit inside
+            the OOM check: execution-time RESOURCE_EXHAUSTED surfaces
+            when *blocking* on async outputs, so a half whose
+            materialization OOMs must recurse toward the floor exactly
+            like a dispatch OOM."""
+            try:
+                out, entry, n_real = _dispatch(idx, start, stop)
+                _materialize(out, entry, idx, start, stop, n_real)
+            except Exception as e:  # noqa: BLE001 — classified below
+                if _durability.is_oom(e) and degrade \
+                        and (stop - start) > floor:
+                    _split(idx, start, stop)
+                    return
+                raise
+
+        def _route_failure(idx: int, start: int, stop: int,
+                           e: Exception) -> None:
+            if isinstance(e, _ChunkDataError):
+                _record_terminal(start, stop, e, "data", 1)
+            elif _durability.is_oom(e) and degrade \
+                    and (stop - start) > floor:
+                _split(idx, start, stop)
+            else:
+                _quarantine(idx, start, stop, e, _failure_kind(e))
+
+        def _resume_from_journal(start: int, stop: int) -> bool:
+            """True when ``[start, stop)`` was fully committed by a prior
+            run and every entry restores cleanly; a corrupt entry is
+            quarantined (journal-side) and the chunk refits."""
+            cover = jr.covering(start, stop)
+            if cover is None:
+                return False
+            loaded = []
+            for meta in cover:
+                try:
+                    model, pmeta = jr.load(meta)
+                except Exception as e:  # noqa: BLE001 — any corruption
+                    # (CRC, mismatched sidecar, garbled JSON) means the
+                    # entry cannot be trusted: move it aside and refit
+                    jr.quarantine(meta)
+                    durex["journal_corrupt"] += 1
+                    self._reg.inc("engine.journal_corrupt")
+                    _metrics.trace_instant(
+                        "engine.journal_corrupt",
+                        {"chunk_start": int(meta.get("start", -1)),
+                         "chunk_stop": int(meta.get("stop", -1)),
+                         "error": type(e).__name__})
+                    return False
+                loaded.append((pmeta, model))
+            nonlocal conv
+            for pmeta, model in loaded:
+                conv += int(pmeta.get("n_conv", 0))
+                if collect:
+                    collected[int(pmeta["start"])] = model
+            # one hit per restored CHUNK (a degraded chunk's sub-entry
+            # tiling is still one chunk skipped), so journal_hits +
+            # journal_commits + dead data/quarantine chunks reconcile
+            # against n_chunks
+            durex["journal_hits"] += 1
+            self._reg.inc("engine.journal_hits")
+            return True
+
+        def _pull(out, entry: _Entry, idx: int, start: int, stop: int,
+                  n_real: int) -> None:
+            try:
+                _materialize(out, entry, idx, start, stop, n_real)
+            except Exception as e:  # noqa: BLE001 — deferred device
+                # errors surface at materialization; isolate the chunk
+                _route_failure(idx, start, stop, e)
 
         t0 = time.perf_counter()
         with _metrics.span("engine.stream"):
-            for start in range(0, n_series, chunk):
-                part = host[start:start + chunk]
-                n_real = part.shape[0]
-                bs = chunk if n_real == chunk \
-                    else min(series_bucket(n_real), chunk)
-                variant = "dense"
-                if np.issubdtype(part.dtype, np.floating) \
-                        and np.isnan(part).any():
-                    if family not in RAGGED_FAMILIES:
-                        record_failure(start, n_real, ValueError(
-                            f"NaN input needs a traced ragged fit; "
-                            f"family {family!r} has none "
-                            f"(only {RAGGED_FAMILIES})"))
-                        continue
-                    variant = "ragged"
-                    gaps = _interior_gap_count(part)
-                    if gaps:
-                        # same contract as FitEngine.fit, stream-tier
-                        # semantics: recorded, not raised
-                        record_failure(start, n_real, ValueError(
-                            f"{gaps} lane(s) have NaN strictly inside "
-                            f"their observed window; impute interior "
-                            f"gaps first"))
-                        continue
-                if n_real != bs:          # ragged tail: its own bucket
-                    fill = np.nan if variant == "ragged" else 0.0
-                    padded = np.full((bs, n_obs), fill, part.dtype)
-                    padded[:n_real] = part
-                    part = padded
-                    self._reg.inc("engine.pad_lanes", bs - n_real)
-                try:
-                    entry = self._entry(family, statics, (bs, n_obs),
-                                        part.dtype, variant, don)
-                    with _metrics.span("engine.dispatch"):
-                        dev = jax.device_put(part)
-                        out = entry.compiled(dev, np.int32(n_real))
-                    self._reg.inc("engine.bytes_h2d", int(part.nbytes))
-                    if don:
-                        self._reg.inc("engine.bytes_donated",
-                                      int(part.nbytes))
-                except Exception as e:  # noqa: BLE001 — same isolation
-                    record_failure(start, n_real, e)
+            for idx, (start, stop) in enumerate(partition):
+                if jr is not None and _resume_from_journal(start, stop):
                     continue
-                pending.append((out, entry, start, n_real))
+                try:
+                    out, entry, n_real = _dispatch(idx, start, stop)
+                except Exception as e:  # noqa: BLE001 — chunk isolation
+                    _route_failure(idx, start, stop, e)
+                    continue
+                pending.append((out, entry, idx, start, stop, n_real))
                 while len(pending) >= depth + 1:
-                    pull(*pending.popleft())
+                    _pull(*pending.popleft())
             while pending:
-                pull(*pending.popleft())
+                _pull(*pending.popleft())
+
+            # end-of-stream quarantine: bounded deterministic backoff
+            # retries, then declare the chunk dead.  Index-based walk —
+            # a retry that degrades under OOM can quarantine fresh
+            # sub-ranges, which get their own retries.
+            qi = 0
+            while qi < len(quarantine):
+                q = quarantine[qi]
+                qi += 1
+                recovered = False
+                last_err = q["error"]
+                attempts = 1
+                for attempt in range(1, policy.max_retries + 1):
+                    delay = policy.delay(attempt)
+                    durex["retry_attempts"] += 1
+                    self._reg.inc("engine.retry_attempts")
+                    _metrics.trace_instant(
+                        "engine.retry_attempt",
+                        {"chunk_start": int(q["start"]),
+                         "chunk_stop": int(q["stop"]),
+                         "attempt": attempt, "delay_s": delay})
+                    attempts += 1
+                    hung = getattr(last_err, "worker", None)
+                    if hung is not None and hung.is_alive():
+                        # a deadline-abandoned worker may still own this
+                        # range's device buffers and eventually run its
+                        # fit; the backoff doubles as a grace join, and
+                        # while it lives we never race a duplicate
+                        # dispatch against it
+                        hung.join(delay)
+                        if hung.is_alive():
+                            continue
+                    elif delay > 0:
+                        time.sleep(delay)
+                    try:
+                        _run_sync(q["idx"], q["start"], q["stop"])
+                        recovered = True
+                        break
+                    except Exception as e:  # noqa: BLE001 — retried
+                        last_err = e
+                if recovered:
+                    durex["recovered"] += 1
+                    self._reg.inc("engine.quarantine_recovered")
+                else:
+                    _record_terminal(q["start"], q["stop"], last_err,
+                                     _failure_kind(last_err), attempts)
         wall = time.perf_counter() - t0
 
         after = self.cache_stats()
-        n_failed = sum(f["n_series"] for f in failures)
         stats = {
             "cache_hits": after["cache_hits"] - before["cache_hits"],
             "cache_misses": after["cache_misses"] - before["cache_misses"],
@@ -910,9 +1288,16 @@ class FitEngine:
             "donated": don,
             "prefetch": depth,
             "chunk_size": chunk,
+            "deadline_s": deadline,
+            "retries": policy.max_retries,
+            **durex,
         }
-        return StreamResult(n_series, max(n_series - n_failed, 0), conv,
-                            wall, -(-n_series // chunk), failures, models,
+        if jr is not None:
+            stats["journal_path"] = jr.path
+        models = [collected[k] for k in sorted(collected)] if collect \
+            else None
+        return StreamResult(n_series, max(n_series - dead_series, 0), conv,
+                            wall, len(partition), failures, models,
                             stats)
 
 

@@ -419,6 +419,25 @@ class Panel:
             eng = engine if engine is not None else default_engine()
             return eng.fit_resilient(self.values, family, *args, **kwargs)
 
+    def stream_fit(self, family: str = "arima", *, engine=None, **kwargs):
+        """Stream this panel's series through the engine's chunked fit
+        pipeline (:meth:`~spark_timeseries_tpu.engine.FitEngine.stream_fit`):
+        out-of-core chunking with prefetch overlap and per-chunk failure
+        isolation, plus the opt-in durability tier — ``journal=path``
+        for crash-consistent per-chunk commits with validated resume,
+        ``deadline_s=`` for the per-chunk watchdog
+        (``STS_CHUNK_DEADLINE_S``), ``retry=`` for quarantine/backoff
+        retries of failed chunks, and OOM-adaptive chunk halving
+        (``degrade=``).  ``chunk_size``/``prefetch``/``collect`` and the
+        family's static fit parameters pass through.  Returns the
+        engine's :class:`~spark_timeseries_tpu.engine.StreamResult`;
+        an explicit :class:`~spark_timeseries_tpu.engine.FitEngine`
+        instance overrides the process default."""
+        from .engine import default_engine
+        with _metrics.span("panel.stream_fit"):
+            eng = engine if engine is not None else default_engine()
+            return eng.stream_fit(self.values, family, **kwargs)
+
     def describe_costs(self, family: str = "arima") -> dict:
         """What would one compiled ``family`` fit of this panel cost?
         Asks XLA directly (``utils.costs.fit_cost_report`` at this

@@ -135,6 +135,26 @@ def save_pytree(path: str, tree: Any) -> None:
         json.dump({"format": 2, "spec": spec, "n_leaves": len(arrays)}, f)
 
 
+def _fsync_replace(tmp: str, dst: str) -> None:
+    with open(tmp, "rb+") as f:
+        os.fsync(f.fileno())
+    os.replace(tmp, dst)
+
+
+def save_pytree_atomic(path: str, tree: Any) -> None:
+    """:func:`save_pytree` through tmp-file + fsync + rename: a crash at
+    any instant leaves either the previous files or the new ones at
+    ``path``, never a torn ``.npz``/``.tree.json``.  The two renames are
+    individually atomic but not as a pair — a caller that needs the pair
+    committed as a unit writes its own marker after both (the chunk
+    journal in ``utils.durability`` renames a ``.ok`` marker as its
+    commit point)."""
+    tmp = f"{path}.tmp-{os.getpid()}"
+    save_pytree(tmp, tree)
+    _fsync_replace(tmp + ".npz", path + ".npz")
+    _fsync_replace(tmp + ".tree.json", path + ".tree.json")
+
+
 def load_pytree(path: str) -> Any:
     """Rebuild the exact pytree saved by :func:`save_pytree` — structure,
     static Python fields, and array leaves — with no caller-side knowledge."""

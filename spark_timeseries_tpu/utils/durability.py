@@ -1,0 +1,342 @@
+"""Durability layer for streaming fit jobs: crash-consistent chunk
+journal, resume validation, and deterministic retry/backoff policy.
+
+PR 5's ``engine.stream_fit`` made every batched fit a chunked stream, but
+the stream itself was a single point of failure: a process death, a hung
+compile, or an OOM mid-stream lost every completed chunk and could wedge
+the job.  ARIMA_PLUS (PAPERS.md, arXiv 2510.24452) argues that what makes
+in-database forecasting a *product* is hands-off operation at scale;
+DARIMA (arXiv 2007.09577) frames exactly this workload — long-running
+distributed fits over huge panels — where partial-progress durability is
+the missing robustness tier on top of PR 2's per-series fallback.
+
+This module is the host-side substrate the engine's durable streaming
+builds on (``engine.stream_fit(..., journal=...)``); nothing here ever
+runs under a JAX trace:
+
+- :class:`ChunkJournal` — a directory of per-chunk result commits.  Each
+  committed chunk is a :mod:`~spark_timeseries_tpu.utils.checkpoint`
+  pytree pair (``.npz`` + ``.tree.json``, both written tmp-file+rename)
+  plus a ``.ok`` commit marker whose atomic rename IS the commit point:
+  a chunk exists iff its marker does, so a kill -9 at any instant leaves
+  either a fully committed chunk or no chunk, never a torn one.  The
+  journal's ``MANIFEST.json`` records a content hash of the job spec
+  (family, statics, dtype, bucket policy, chunk partition); opening the
+  same path with a different spec refuses with
+  :class:`JournalSpecMismatch` instead of silently mixing results from
+  two different jobs.  Restores go through ``checkpoint.load_pytree``'s
+  shape/dtype-validated path, so bit-rot or a swapped ``.npz`` surfaces
+  as a detected corruption — the entry is moved to ``quarantine/`` and
+  the chunk refits — never as silently wrong numbers.
+- :class:`BackoffPolicy` — bounded exponential backoff for the engine's
+  end-of-stream quarantine retries.  Purely deterministic (the delay is
+  a closed form of the attempt number; no wall-clock reads feed traced
+  code) and host-side (``time.sleep`` between attempts).
+- :class:`ChunkDeadlineExceeded` / :func:`is_oom` — the failure taxonomy
+  the engine's watchdog and degradation tiers route on.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from . import checkpoint as _checkpoint
+
+__all__ = [
+    "BackoffPolicy", "as_backoff",
+    "ChunkDeadlineExceeded", "JournalSpecMismatch",
+    "is_oom", "spec_digest", "array_digest",
+    "ChunkJournal",
+]
+
+
+class JournalSpecMismatch(ValueError):
+    """A chunk journal was written by a different job spec (family,
+    statics, dtype, bucket policy, or chunk partition) than the one now
+    trying to resume from it.  Raised eagerly when the journal is opened
+    — resuming would silently mix results from two different jobs."""
+
+
+class ChunkDeadlineExceeded(RuntimeError):
+    """A streaming chunk's dispatch or result materialization outlived
+    the armed per-chunk deadline (``STS_CHUNK_DEADLINE_S`` or
+    ``stream_fit(..., deadline_s=)``).  The watchdog abandons the hung
+    worker thread and the stream continues; the chunk is recorded like
+    any other chunk failure and quarantined for end-of-stream retry."""
+
+
+class BackoffPolicy(NamedTuple):
+    """Bounded exponential backoff for quarantined-chunk retries.
+
+    ``max_retries`` attempts after the original failure (0 = declare the
+    chunk dead immediately — the pre-durability behavior);
+    :meth:`delay` for attempt ``k`` (1-based) is
+    ``min(base_delay_s * multiplier**(k-1), max_delay_s)`` — a closed
+    form of the attempt number, so retry schedules are deterministic and
+    no wall-clock value ever feeds traced code (the sleep itself is
+    host-side, between dispatches).
+    """
+    max_retries: int = 2
+    base_delay_s: float = 0.05
+    multiplier: float = 2.0
+    max_delay_s: float = 2.0
+
+    def delay(self, attempt: int) -> float:
+        """Seconds to back off before retry ``attempt`` (1-based)."""
+        if attempt < 1:
+            raise ValueError(f"attempt is 1-based, got {attempt}")
+        d = self.base_delay_s * self.multiplier ** (attempt - 1)
+        return float(min(d, self.max_delay_s))
+
+
+def as_backoff(retry: Any) -> BackoffPolicy:
+    """Coerce ``stream_fit``'s ``retry=`` argument to a policy.
+
+    ``None`` reads ``STS_CHUNK_RETRIES`` (default 0 — failures are
+    declared dead immediately, the pre-durability stream semantics); an
+    int is a retry count with the default backoff curve; a
+    :class:`BackoffPolicy` passes through."""
+    if retry is None:
+        env = os.environ.get("STS_CHUNK_RETRIES")
+        try:
+            return BackoffPolicy(max_retries=max(0, int(env)) if env else 0)
+        except ValueError:
+            raise ValueError(
+                f"STS_CHUNK_RETRIES must be an integer, got {env!r}"
+            ) from None
+    if isinstance(retry, BackoffPolicy):
+        return retry
+    if isinstance(retry, bool):
+        raise TypeError("retry must be None, an int, or a BackoffPolicy")
+    if isinstance(retry, int):
+        return BackoffPolicy(max_retries=max(0, retry))
+    raise TypeError(f"retry must be None, an int, or a BackoffPolicy, "
+                    f"got {type(retry).__name__}")
+
+
+def is_oom(e: BaseException) -> bool:
+    """Does this exception look like an XLA allocation failure?  XLA
+    surfaces device OOM as ``RESOURCE_EXHAUSTED`` status strings (or
+    ``Out of memory`` on some backends); the engine's degradation tier
+    keys off this classification to split the chunk instead of killing
+    the stream."""
+    text = f"{type(e).__name__}: {e}"
+    return ("RESOURCE_EXHAUSTED" in text
+            or "out of memory" in text.lower()
+            or "OutOfMemory" in text)
+
+
+def spec_digest(spec: Dict[str, Any]) -> str:
+    """Content hash of a job spec dict (order-insensitive JSON)."""
+    blob = json.dumps(spec, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def array_digest(arr) -> str:
+    """Content hash of a host array's raw bytes — the job-spec field
+    that refuses a resume when the panel's *data* changed under the same
+    geometry (a refreshed daily panel with identical shape/dtype would
+    otherwise silently restore the previous job's results).  Zero-copy
+    over the array's buffer; a one-pass SHA-256 is noise next to fitting
+    the panel, and runs only when a journal is armed."""
+    a = np.ascontiguousarray(arr)
+    h = hashlib.sha256()
+    h.update(memoryview(a).cast("B"))
+    return h.hexdigest()[:16]
+
+
+def _atomic_write_json(path: str, obj: Any) -> None:
+    """tmp-file + fsync + rename: the file either has its full contents
+    or does not exist — the rename is the visibility point."""
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class ChunkJournal:
+    """Crash-consistent per-chunk result journal for one streaming job.
+
+    Directory layout::
+
+        <path>/MANIFEST.json                   job-spec hash (format 1)
+        <path>/chunk_<start>_<stop>.npz        array leaves (checkpoint)
+        <path>/chunk_<start>_<stop>.tree.json  structure sidecar
+        <path>/chunk_<start>_<stop>.ok         commit marker (atomic)
+        <path>/quarantine/...                  corrupt entries, moved aside
+
+    Commit protocol: payload files land first (each tmp+rename'd), then
+    the ``.ok`` marker is renamed into place — the marker IS the commit
+    point, so a chunk is committed if and only if its marker exists and a
+    crash at any instant leaves no torn entries.  Entries are keyed by
+    their half-open series-row range ``[start, stop)``; a chunk that was
+    degraded into sub-chunks under memory pressure commits each sub-range
+    separately, and :meth:`covering` recognizes an exact tiling of the
+    full chunk range on resume.
+    """
+
+    MANIFEST = "MANIFEST.json"
+    QUARANTINE_DIR = "quarantine"
+
+    def __init__(self, path: str, spec: Dict[str, Any], digest: str):
+        self.path = path
+        self.spec = spec
+        self.digest = digest
+        self._index: Dict[Tuple[int, int], Dict[str, Any]] = {}
+        self._scan()
+
+    # -- open / scan --------------------------------------------------------
+
+    @classmethod
+    def open(cls, path: str, spec: Dict[str, Any]) -> "ChunkJournal":
+        """Create or resume the journal at ``path`` for job ``spec``.
+
+        A fresh directory gets a manifest recording the spec and its
+        content hash; an existing one is validated against it —
+        :class:`JournalSpecMismatch` (with the differing fields spelled
+        out) refuses a resume under a different job."""
+        os.makedirs(path, exist_ok=True)
+        digest = spec_digest(spec)
+        mpath = os.path.join(path, cls.MANIFEST)
+        if os.path.exists(mpath):
+            with open(mpath) as f:
+                manifest = json.load(f)
+            if manifest.get("digest") != digest:
+                old = manifest.get("spec") or {}
+                diffs = [f"  {k}: journal={old.get(k)!r} vs job={v!r}"
+                         for k, v in sorted(spec.items())
+                         if old.get(k) != v]
+                raise JournalSpecMismatch(
+                    f"journal at {path!r} belongs to a different job spec "
+                    f"and cannot resume this one; differing fields:\n"
+                    + ("\n".join(diffs)
+                       or "  (fields match but recorded hash differs)")
+                    + "\nuse a fresh journal path for a different job")
+        else:
+            _atomic_write_json(mpath, {"format": 1, "digest": digest,
+                                       "spec": spec})
+        return cls(path, spec, digest)
+
+    def _scan(self) -> None:
+        self._index.clear()
+        for name in sorted(os.listdir(self.path)):
+            if not name.endswith(".ok"):
+                continue
+            try:
+                with open(os.path.join(self.path, name)) as f:
+                    meta = json.load(f)
+                key = (int(meta["start"]), int(meta["stop"]))
+            except (OSError, ValueError, KeyError, TypeError):
+                continue        # torn/garbled marker: not committed
+            self._index[key] = meta
+
+    def _prefix(self, start: int, stop: int) -> str:
+        return os.path.join(self.path, f"chunk_{start:010d}_{stop:010d}")
+
+    # -- queries ------------------------------------------------------------
+
+    @property
+    def n_committed(self) -> int:
+        return len(self._index)
+
+    def committed_ranges(self) -> List[Tuple[int, int]]:
+        return sorted(self._index)
+
+    def covering(self, start: int, stop: int
+                 ) -> Optional[List[Dict[str, Any]]]:
+        """Committed entry metas exactly tiling ``[start, stop)`` in
+        order, or None when the range is not fully committed (a partial
+        cover refits the whole chunk — per-chunk fits are idempotent)."""
+        inside = sorted(k for k in self._index
+                        if start <= k[0] and k[1] <= stop)
+        if not inside:
+            return None
+        cursor = start
+        out = []
+        for k in inside:
+            if k[0] != cursor:
+                return None
+            out.append(self._index[k])
+            cursor = k[1]
+        return out if cursor == stop else None
+
+    # -- entry IO -----------------------------------------------------------
+
+    def load(self, meta: Dict[str, Any]) -> Tuple[Any, Dict[str, Any]]:
+        """Validated restore of one committed entry: the chunk's host
+        model pytree plus the payload meta.  Raises (checkpoint mismatch,
+        zip CRC, JSON, ...) on any corruption — callers quarantine the
+        entry and refit the chunk."""
+        start, stop = int(meta["start"]), int(meta["stop"])
+        payload = _checkpoint.load_pytree(self._prefix(start, stop))
+        pmeta = payload["meta"]
+        if (int(pmeta.get("start", -1)), int(pmeta.get("stop", -1))) \
+                != (start, stop):
+            raise _checkpoint.CheckpointMismatchError(
+                f"journal entry [{start}, {stop}) payload claims range "
+                f"[{pmeta.get('start')}, {pmeta.get('stop')}) — the files "
+                f"do not belong to this commit marker")
+        return payload["model"], pmeta
+
+    def commit(self, start: int, stop: int, model: Any,
+               meta: Dict[str, Any]) -> None:
+        """Atomically commit one chunk's fitted model.  Payload files are
+        written tmp+rename first; the ``.ok`` marker rename that follows
+        is the commit point.
+
+        Any committed entry strictly inside ``[start, stop)`` is
+        superseded (a full-chunk refit after a partially corrupt
+        degraded cover would otherwise leave sub-entries that overlap
+        the new one and defeat :meth:`covering` on every future resume).
+        Stale markers drop *before* the new marker lands: a crash in
+        between leaves the range uncommitted — a refit, never a mixed
+        cover."""
+        start, stop = int(start), int(stop)
+        meta = dict(meta, start=start, stop=stop)
+        prefix = self._prefix(start, stop)
+        _checkpoint.save_pytree_atomic(prefix, {"model": model,
+                                                "meta": meta})
+        for k in [k for k in self._index
+                  if k != (start, stop)
+                  and start <= k[0] and k[1] <= stop]:
+            sub = self._prefix(*k)
+            for suffix in (".ok", ".npz", ".tree.json"):
+                if os.path.exists(sub + suffix):
+                    os.remove(sub + suffix)
+            del self._index[k]
+        _atomic_write_json(prefix + ".ok", meta)
+        self._index[(start, stop)] = meta
+
+    def quarantine(self, meta: Dict[str, Any]) -> str:
+        """Move a corrupt entry's files into ``quarantine/`` so the entry
+        is never trusted again (the chunk refits and recommits a fresh
+        entry).  Returns the quarantine directory."""
+        start, stop = int(meta["start"]), int(meta["stop"])
+        qdir = os.path.join(self.path, self.QUARANTINE_DIR)
+        os.makedirs(qdir, exist_ok=True)
+        prefix = self._prefix(start, stop)
+        base = os.path.basename(prefix)
+        for suffix in (".ok", ".npz", ".tree.json"):
+            src = prefix + suffix
+            if os.path.exists(src):
+                os.replace(src, os.path.join(qdir, base + suffix))
+        self._index.pop((start, stop), None)
+        return qdir
+
+    def corrupt_entry(self, start: int, stop: int) -> None:
+        """Garble a committed entry's array payload in place, leaving the
+        commit marker intact — the ``corrupt_journal`` fault-injection
+        hook (and test helper).  Only a validated restore can catch what
+        this does; that is the point."""
+        npz = self._prefix(int(start), int(stop)) + ".npz"
+        size = os.path.getsize(npz)
+        with open(npz, "r+b") as f:
+            f.seek(size // 2)
+            f.write(b"\x00CORRUPTED\x00")

@@ -60,8 +60,8 @@ __all__ = [
     "STATUS_ABANDONED", "STATUS_NAMES",
     "classify_series", "unfittable_mask",
     "FitOutcome", "RetryPolicy", "retry_kwargs",
-    "FaultSpec", "fault_injection", "fault_spec",
-    "forced_optimizer_failures", "corrupt_values",
+    "FaultSpec", "InjectedOOM", "fault_injection", "fault_spec",
+    "chunk_fault", "forced_optimizer_failures", "corrupt_values",
     "resilient_fit",
 ]
 
@@ -232,19 +232,58 @@ class FaultSpec(NamedTuple):
       input panel becomes all-NaN before classification;
     - ``"corrupt_inf"``: every ``lane_stride``-th lane gets one interior
       ``inf`` observation.
+
+    Streaming-chunk modes (consumed host-side by ``engine.stream_fit``
+    via :func:`chunk_fault`; ``chunk_index`` selects the target chunk in
+    partition order):
+
+    - ``"hang_chunk"``: the target chunk's dispatch sleeps ``hang_s``
+      seconds — a wedged compile/transfer, exercising the per-chunk
+      deadline watchdog;
+    - ``"oom_chunk"``: the target chunk's dispatch raises a synthetic
+      ``RESOURCE_EXHAUSTED`` (:class:`InjectedOOM`) at its full chunk
+      size only, exercising the halve-and-redispatch degradation path;
+    - ``"kill_after_chunk"``: SIGKILL the process right after the target
+      chunk's journal commit — the kill-9-then-resume scenario;
+    - ``"corrupt_journal"``: garble the target chunk's journal entry
+      right after commit, exercising detect-quarantine-refit on resume.
     """
     mode: str
     n_attempts: int = 1
     lane_stride: int = 2
+    chunk_index: int = 0
+    hang_s: float = 3600.0
 
 
-_VALID_MODES = ("force_nonconverge", "corrupt_nan", "corrupt_inf")
+class InjectedOOM(RuntimeError):
+    """Synthetic device allocation failure raised by the ``oom_chunk``
+    fault mode; the message carries ``RESOURCE_EXHAUSTED`` so it routes
+    through exactly the classifier (``utils.durability.is_oom``) a real
+    XLA OOM would."""
+
+
+_VALID_MODES = ("force_nonconverge", "corrupt_nan", "corrupt_inf",
+                "hang_chunk", "oom_chunk", "kill_after_chunk",
+                "corrupt_journal")
+_CHUNK_MODES = _VALID_MODES[3:]
 _active_fault: List[FaultSpec] = []
 
 
 def fault_spec() -> Optional[FaultSpec]:
     """The innermost active fault, or None."""
     return _active_fault[-1] if _active_fault else None
+
+
+def chunk_fault(mode: str, chunk_index: int) -> Optional[FaultSpec]:
+    """The active fault spec when it is a streaming-chunk fault of the
+    given ``mode`` targeting ``chunk_index``, else None.  Read host-side
+    by ``engine.stream_fit`` at each chunk's dispatch/commit — these
+    modes never touch traced code."""
+    spec = fault_spec()
+    if spec is not None and spec.mode == mode \
+            and int(spec.chunk_index) == int(chunk_index):
+        return spec
+    return None
 
 
 def forced_optimizer_failures() -> int:
@@ -280,6 +319,7 @@ def _clear_jit_caches() -> None:
 
 @contextlib.contextmanager
 def fault_injection(mode: str, n_attempts: int = 1, lane_stride: int = 2,
+                    chunk_index: int = 0, hang_s: float = 3600.0,
                     _clear_caches: Optional[bool] = None):
     """Deterministically inject one fault for the scope's duration::
 
@@ -292,16 +332,20 @@ def fault_injection(mode: str, n_attempts: int = 1, lane_stride: int = 2,
     whose flag is baked into optimizer traces — entering and leaving the
     scope clears the jit executable cache so a fit jitted by the caller in
     the other regime is never served stale (the corruption modes mutate
-    host inputs only and skip the flush; ``_clear_caches`` overrides).
+    host inputs only, and the streaming-chunk modes are read host-side
+    per chunk; both skip the flush; ``_clear_caches`` overrides).
     """
     if mode not in _VALID_MODES:
         raise ValueError(
             f"unknown fault mode {mode!r}; expected one of {_VALID_MODES}")
     if n_attempts < 1 or lane_stride < 1:
         raise ValueError("n_attempts and lane_stride must be >= 1")
+    if chunk_index < 0 or hang_s <= 0:
+        raise ValueError("chunk_index must be >= 0 and hang_s > 0")
     clear = mode == "force_nonconverge" if _clear_caches is None \
         else _clear_caches
-    spec = FaultSpec(mode, int(n_attempts), int(lane_stride))
+    spec = FaultSpec(mode, int(n_attempts), int(lane_stride),
+                     int(chunk_index), float(hang_s))
     _active_fault.append(spec)
     if clear:
         _clear_jit_caches()

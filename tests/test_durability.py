@@ -1,0 +1,635 @@
+"""Durable streaming jobs (ISSUE 6): chunk journal + resume, per-chunk
+deadlines, quarantine/backoff retry, and OOM-adaptive degradation.
+
+The acceptance contract: a streaming job killed (kill -9) mid-run resumes
+from its chunk journal without refitting committed chunks and produces
+bitwise-identical results; a mismatched job spec refuses to resume with a
+clear error; and every new fault mode drives its recovery path
+deterministically — hang → deadline fires, OOM → degradation splits,
+corrupt journal → detected and quarantined, kill → resume.
+
+Fast host-only tests (policy math, journal mechanics) run in tier-1;
+everything that compiles a fit program or spawns subprocesses is marked
+``slow`` and runs via ``make verify-durability`` (the ``durability``
+marker), which the ``verify-faults`` CI target depends on.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu import engine as E
+from spark_timeseries_tpu.utils import checkpoint, durability, metrics
+from spark_timeseries_tpu.utils import resilience as res
+
+pytestmark = pytest.mark.durability
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ar_panel(n_series: int, n_obs: int, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(n_series, n_obs)).astype(np.float32) \
+        .cumsum(axis=1)
+
+
+def _coef_stack(models) -> np.ndarray:
+    return np.concatenate([np.asarray(m.coefficients) for m in models])
+
+
+def _wait_for_abandoned_workers(timeout_s: float = 15.0) -> None:
+    """Block until every abandoned deadline-watchdog worker thread has
+    drained, so its late registry updates can't leak into later tests."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if not any(t.name.startswith("sts-chunk-")
+                   for t in threading.enumerate()):
+            return
+        time.sleep(0.05)
+
+
+# ---------------------------------------------------------------------------
+# backoff policy + failure taxonomy (fast, host-only)
+# ---------------------------------------------------------------------------
+
+def test_backoff_policy_is_deterministic_and_bounded():
+    p = durability.BackoffPolicy(max_retries=4, base_delay_s=0.1,
+                                 multiplier=3.0, max_delay_s=0.5)
+    assert [p.delay(k) for k in (1, 2, 3, 4)] \
+        == pytest.approx([0.1, 0.3, 0.5, 0.5])
+    # closed form of the attempt number: same schedule every time
+    assert p.delay(2) == p.delay(2)
+    with pytest.raises(ValueError):
+        p.delay(0)
+
+
+def test_as_backoff_coercions(monkeypatch):
+    monkeypatch.delenv("STS_CHUNK_RETRIES", raising=False)
+    assert durability.as_backoff(None).max_retries == 0
+    monkeypatch.setenv("STS_CHUNK_RETRIES", "3")
+    assert durability.as_backoff(None).max_retries == 3
+    assert durability.as_backoff(2).max_retries == 2
+    pol = durability.BackoffPolicy(max_retries=7)
+    assert durability.as_backoff(pol) is pol
+    with pytest.raises(TypeError):
+        durability.as_backoff(True)
+    with pytest.raises(TypeError):
+        durability.as_backoff("2")
+
+
+def test_is_oom_classifier():
+    assert durability.is_oom(RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory allocating 1234 bytes"))
+    assert durability.is_oom(res.InjectedOOM(
+        "RESOURCE_EXHAUSTED: injected oom_chunk fault"))
+    assert not durability.is_oom(ValueError("bad shape"))
+    assert not durability.is_oom(RuntimeError("INTERNAL: compiler bug"))
+
+
+def test_chunk_fault_matches_mode_and_index():
+    assert res.chunk_fault("hang_chunk", 0) is None
+    with res.fault_injection("hang_chunk", chunk_index=2, hang_s=1.0):
+        assert res.chunk_fault("hang_chunk", 2) is not None
+        assert res.chunk_fault("hang_chunk", 1) is None
+        assert res.chunk_fault("oom_chunk", 2) is None
+    assert res.chunk_fault("hang_chunk", 2) is None
+    with pytest.raises(ValueError):
+        with res.fault_injection("hang_chunk", chunk_index=-1):
+            pass
+    with pytest.raises(ValueError):
+        with res.fault_injection("oom_chunk", hang_s=0.0):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# chunk journal mechanics (fast, host-only)
+# ---------------------------------------------------------------------------
+
+_SPEC = {"format": 1, "family": "ar", "statics": "(2, False)",
+         "dtype": "float32", "n_series": 16, "n_obs": 8, "chunk_size": 8,
+         "bucket_policy": [8, 32]}
+
+
+def _toy_model(start: int) -> dict:
+    rng = np.random.default_rng(start)
+    return {"coefficients": rng.standard_normal((8, 3)).astype(np.float32),
+            "order": 2}
+
+
+def test_journal_commit_marker_is_the_commit_point(tmp_path):
+    jr = durability.ChunkJournal.open(str(tmp_path / "j"), _SPEC)
+    assert jr.n_committed == 0
+    jr.commit(0, 8, _toy_model(0), {"n_real": 8, "n_conv": 7})
+    prefix = jr._prefix(0, 8)
+    assert os.path.exists(prefix + ".ok")
+    assert os.path.exists(prefix + ".npz")
+    assert os.path.exists(prefix + ".tree.json")
+    # reopen = resume: the committed entry is indexed and restores intact
+    jr2 = durability.ChunkJournal.open(str(tmp_path / "j"), _SPEC)
+    assert jr2.committed_ranges() == [(0, 8)]
+    model, meta = jr2.load(jr2.covering(0, 8)[0])
+    assert meta["n_conv"] == 7
+    np.testing.assert_array_equal(model["coefficients"],
+                                  _toy_model(0)["coefficients"])
+    # an entry whose marker never landed is not committed
+    jr2.commit(8, 16, _toy_model(8), {"n_real": 8, "n_conv": 8})
+    os.remove(jr2._prefix(8, 16) + ".ok")
+    jr3 = durability.ChunkJournal.open(str(tmp_path / "j"), _SPEC)
+    assert jr3.committed_ranges() == [(0, 8)]
+
+
+def test_journal_covering_recognizes_subchunk_tiling(tmp_path):
+    jr = durability.ChunkJournal.open(str(tmp_path / "j"), _SPEC)
+    jr.commit(0, 4, _toy_model(0), {"n_real": 4, "n_conv": 4})
+    jr.commit(4, 8, _toy_model(4), {"n_real": 4, "n_conv": 4})
+    # an exact tiling of [0, 8) by degraded sub-chunks counts as covered
+    cover = jr.covering(0, 8)
+    assert [(m["start"], m["stop"]) for m in cover] == [(0, 4), (4, 8)]
+    # gaps and partial covers don't
+    assert jr.covering(0, 16) is None
+    jr.commit(12, 16, _toy_model(12), {"n_real": 4, "n_conv": 4})
+    assert jr.covering(8, 16) is None
+
+
+def test_journal_spec_mismatch_refuses_resume(tmp_path):
+    durability.ChunkJournal.open(str(tmp_path / "j"), _SPEC)
+    other = dict(_SPEC, statics="(3, False)")
+    with pytest.raises(durability.JournalSpecMismatch) as ei:
+        durability.ChunkJournal.open(str(tmp_path / "j"), other)
+    msg = str(ei.value)
+    assert "statics" in msg and "(2, False)" in msg and "(3, False)" in msg
+    # same spec reopens fine
+    durability.ChunkJournal.open(str(tmp_path / "j"), _SPEC)
+
+
+def test_journal_corruption_detected_and_quarantined(tmp_path):
+    jr = durability.ChunkJournal.open(str(tmp_path / "j"), _SPEC)
+    jr.commit(0, 8, _toy_model(0), {"n_real": 8, "n_conv": 8})
+    jr.corrupt_entry(0, 8)
+    meta = jr.covering(0, 8)[0]
+    with pytest.raises(Exception):
+        jr.load(meta)
+    qdir = jr.quarantine(meta)
+    assert jr.covering(0, 8) is None
+    assert os.path.exists(os.path.join(
+        qdir, os.path.basename(jr._prefix(0, 8)) + ".npz"))
+    # the chunk recommits a fresh entry afterwards
+    jr.commit(0, 8, _toy_model(0), {"n_real": 8, "n_conv": 8})
+    model, _ = jr.load(jr.covering(0, 8)[0])
+    np.testing.assert_array_equal(model["coefficients"],
+                                  _toy_model(0)["coefficients"])
+
+
+def test_journal_commit_supersedes_contained_subentries(tmp_path):
+    # a full-range refit over a previously degraded cover must drop the
+    # stale sub-entries, or the overlap defeats covering() forever
+    jr = durability.ChunkJournal.open(str(tmp_path / "j"), _SPEC)
+    jr.commit(0, 4, _toy_model(0), {"n_real": 4, "n_conv": 4})
+    jr.commit(4, 8, _toy_model(4), {"n_real": 4, "n_conv": 4})
+    jr.commit(0, 8, _toy_model(8), {"n_real": 8, "n_conv": 8})
+    assert jr.committed_ranges() == [(0, 8)]
+    assert len(jr.covering(0, 8)) == 1
+    assert not os.path.exists(jr._prefix(0, 4) + ".ok")
+    assert not os.path.exists(jr._prefix(0, 4) + ".npz")
+    # a fresh scan sees the same single entry
+    jr2 = durability.ChunkJournal.open(str(tmp_path / "j"), _SPEC)
+    assert jr2.committed_ranges() == [(0, 8)]
+    model, _ = jr2.load(jr2.covering(0, 8)[0])
+    np.testing.assert_array_equal(model["coefficients"],
+                                  _toy_model(8)["coefficients"])
+
+
+def test_array_digest_tracks_content_not_just_shape():
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    b = a.copy()
+    assert durability.array_digest(a) == durability.array_digest(b)
+    b[1, 2] += 1.0
+    assert durability.array_digest(a) != durability.array_digest(b)
+    # non-contiguous views hash their logical content
+    assert durability.array_digest(a[:, ::2]) \
+        == durability.array_digest(np.ascontiguousarray(a[:, ::2]))
+
+
+def test_env_knob_misconfiguration_is_actionable(monkeypatch):
+    monkeypatch.setenv("STS_CHUNK_RETRIES", "two")
+    with pytest.raises(ValueError, match="STS_CHUNK_RETRIES"):
+        durability.as_backoff(None)
+    monkeypatch.setenv("STS_CHUNK_DEADLINE_S", "10m")
+    v = _ar_panel(8, 32)
+    with pytest.raises(ValueError, match="STS_CHUNK_DEADLINE_S"):
+        E.FitEngine().stream_fit(v, "ar", chunk_size=8, max_lag=2)
+
+
+def test_atomic_save_pytree_replaces_not_appends(tmp_path):
+    path = str(tmp_path / "ckpt")
+    checkpoint.save_pytree_atomic(path, {"a": np.arange(4)})
+    checkpoint.save_pytree_atomic(path, {"a": np.arange(8)})
+    out = checkpoint.load_pytree(path)
+    np.testing.assert_array_equal(out["a"], np.arange(8))
+    assert not [f for f in os.listdir(tmp_path) if ".tmp-" in f]
+
+
+# ---------------------------------------------------------------------------
+# streaming durability tiers (compile fits: slow, make verify-durability)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_stream_journal_commit_resume_bitwise(tmp_path):
+    v = _ar_panel(96, 64, seed=1)
+    j = str(tmp_path / "journal")
+    res1 = E.FitEngine().stream_fit(v, "ar", chunk_size=32, max_lag=2,
+                                    journal=j, collect=True)
+    assert res1.n_fitted == 96 and not res1.chunk_failures
+    assert res1.stats["journal_commits"] == 3
+    assert res1.stats["journal_hits"] == 0
+    # fresh engine + same journal: every chunk restores, nothing refits,
+    # nothing compiles
+    res2 = E.FitEngine().stream_fit(v, "ar", chunk_size=32, max_lag=2,
+                                    journal=j, collect=True)
+    assert res2.stats["journal_hits"] == 3
+    assert res2.stats["journal_commits"] == 0
+    assert res2.stats["cache_misses"] == 0
+    assert res2.n_fitted == 96
+    assert res2.n_converged == res1.n_converged
+    np.testing.assert_array_equal(_coef_stack(res2.models),
+                                  _coef_stack(res1.models))
+    # and both match an uninterrupted journal-free run bitwise
+    ref = E.FitEngine().stream_fit(v, "ar", chunk_size=32, max_lag=2,
+                                   collect=True)
+    np.testing.assert_array_equal(_coef_stack(res1.models),
+                                  _coef_stack(ref.models))
+
+
+@pytest.mark.slow
+def test_stream_resumes_after_partial_failure(tmp_path, monkeypatch):
+    # in-process "crash": chunk 1's executable lookup dies, chunks 0 and 2
+    # commit; the resume run refits ONLY the missing chunk
+    v = _ar_panel(96, 64, seed=2)
+    j = str(tmp_path / "journal")
+    real_entry = E.FitEngine._entry
+    calls = {"n": 0}
+
+    def poisoned(self, *a, **k):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("injected: poisoned chunk")
+        return real_entry(self, *a, **k)
+
+    monkeypatch.setattr(E.FitEngine, "_entry", poisoned)
+    res1 = E.FitEngine().stream_fit(v, "ar", chunk_size=32, max_lag=2,
+                                    journal=j, retry=0)
+    assert len(res1.chunk_failures) == 1
+    f = res1.chunk_failures[0]
+    assert (f["chunk_start"], f["chunk_stop"], f["bucket"]) == (32, 64, 32)
+    assert f["kind"] == "error" and f["error_type"] == "RuntimeError"
+    assert "injected: poisoned chunk" in f["traceback"]
+    assert res1.stats["journal_commits"] == 2
+    monkeypatch.setattr(E.FitEngine, "_entry", real_entry)
+    res2 = E.FitEngine().stream_fit(v, "ar", chunk_size=32, max_lag=2,
+                                    journal=j, collect=True)
+    assert res2.stats["journal_hits"] == 2
+    assert res2.stats["journal_commits"] == 1
+    assert res2.n_fitted == 96 and not res2.chunk_failures
+    ref = E.FitEngine().stream_fit(v, "ar", chunk_size=32, max_lag=2,
+                                   collect=True)
+    np.testing.assert_array_equal(_coef_stack(res2.models),
+                                  _coef_stack(ref.models))
+
+
+@pytest.mark.slow
+def test_stream_journal_spec_mismatch_raises(tmp_path):
+    v = _ar_panel(64, 64, seed=3)
+    j = str(tmp_path / "journal")
+    E.FitEngine().stream_fit(v, "ar", chunk_size=32, max_lag=2, journal=j)
+    with pytest.raises(E.JournalSpecMismatch):
+        E.FitEngine().stream_fit(v, "ar", chunk_size=32, max_lag=3,
+                                 journal=j)
+    with pytest.raises(E.JournalSpecMismatch):
+        E.FitEngine().stream_fit(v[:32], "ar", chunk_size=32, max_lag=2,
+                                 journal=j)
+    # same geometry, different DATA: the digest must refuse the resume —
+    # silently restoring the old panel's fits is the worst failure mode
+    v2 = v.copy()
+    v2[50, 10] += 1.0
+    with pytest.raises(E.JournalSpecMismatch, match="data_sha256"):
+        E.FitEngine().stream_fit(v2, "ar", chunk_size=32, max_lag=2,
+                                 journal=j)
+
+
+@pytest.mark.slow
+def test_degraded_subchunk_commits_resume_as_one_chunk(tmp_path):
+    # run 1: chunk 0 OOMs, degrades, commits its two sub-ranges; run 2
+    # recognizes the tiling as one restored chunk (per-chunk hit
+    # accounting) and refits nothing
+    v = _ar_panel(96, 48, seed=11)
+    j = str(tmp_path / "journal")
+    with res.fault_injection("oom_chunk", chunk_index=0):
+        res1 = E.FitEngine().stream_fit(v, "ar", chunk_size=32, max_lag=2,
+                                        journal=j, collect=True, retry=0)
+    assert res1.stats["degraded_chunks"] == 1
+    assert res1.stats["journal_commits"] == 4   # 2 halves + chunks 1, 2
+    res2 = E.FitEngine().stream_fit(v, "ar", chunk_size=32, max_lag=2,
+                                    journal=j, collect=True)
+    assert res2.stats["journal_hits"] == 3      # chunks, not entries
+    assert res2.stats["journal_commits"] == 0
+    assert res2.n_fitted == 96 and not res2.chunk_failures
+    np.testing.assert_array_equal(_coef_stack(res2.models),
+                                  _coef_stack(res1.models))
+
+
+@pytest.mark.slow
+def test_retry_gates_on_live_abandoned_worker():
+    # the hung worker outlives every backoff: retries must consume their
+    # attempts WITHOUT dispatching a duplicate fit against the range the
+    # abandoned worker may still own
+    v = _ar_panel(64, 48, seed=12)
+    real_entry = E.FitEngine._entry
+    calls = {"n": 0}
+
+    def counting(self, *a, **k):
+        calls["n"] += 1
+        return real_entry(self, *a, **k)
+
+    try:
+        with res.fault_injection("hang_chunk", chunk_index=0, hang_s=3.0):
+            E.FitEngine._entry = counting
+            out = E.FitEngine().stream_fit(
+                v, "ar", chunk_size=32, max_lag=2, deadline_s=0.25,
+                retry=durability.BackoffPolicy(max_retries=2,
+                                               base_delay_s=0.01))
+    finally:
+        E.FitEngine._entry = real_entry
+        _wait_for_abandoned_workers(timeout_s=60.0)
+    assert out.stats["abandoned_workers"] == 1
+    assert out.stats["retry_attempts"] == 2
+    assert out.stats["dead_chunks"] == 1
+    f = out.chunk_failures[0]
+    assert f["kind"] == "deadline" and f["attempts"] == 3
+    # only chunk 1's clean dispatch entered the executable lookup while
+    # the stream ran: both retries of the hung range consumed their
+    # attempts without racing a duplicate dispatch (the abandoned
+    # worker's own late lookup happens after the fault scope exits and
+    # the real _entry is restored)
+    assert calls["n"] == 1
+
+
+@pytest.mark.slow
+def test_hang_chunk_deadline_fires_and_stream_continues():
+    v = _ar_panel(96, 64, seed=4)
+    reg = metrics.get_registry()
+    before = reg.snapshot()["counters"].get("engine.deadline_expired", 0)
+    try:
+        with res.fault_injection("hang_chunk", chunk_index=1, hang_s=1.0):
+            out = E.FitEngine().stream_fit(v, "ar", chunk_size=32,
+                                           max_lag=2, deadline_s=0.25,
+                                           retry=0)
+    finally:
+        _wait_for_abandoned_workers()
+    assert out.n_fitted == 64          # the other two chunks completed
+    assert len(out.chunk_failures) == 1
+    f = out.chunk_failures[0]
+    assert f["kind"] == "deadline"
+    assert f["error_type"] == "ChunkDeadlineExceeded"
+    assert (f["chunk_start"], f["chunk_stop"]) == (32, 64)
+    assert out.stats["quarantined"] == 1
+    assert out.stats["dead_chunks"] == 1
+    assert out.stats["deadline_s"] == 0.25
+    assert reg.snapshot()["counters"]["engine.deadline_expired"] > before
+
+
+@pytest.mark.slow
+def test_quarantine_backoff_retry_recovers_transient_failure(monkeypatch):
+    # a transient failure (dispatch dies once) is quarantined, retried at
+    # end-of-stream with backoff, and recovers — bitwise equal to a clean
+    # run, with nothing recorded dead
+    v = _ar_panel(96, 64, seed=5)
+    real_entry = E.FitEngine._entry
+    calls = {"n": 0}
+
+    def flaky(self, *a, **k):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("injected: transient")
+        return real_entry(self, *a, **k)
+
+    monkeypatch.setattr(E.FitEngine, "_entry", flaky)
+    reg = metrics.get_registry()
+    before = reg.snapshot()["counters"].get("engine.quarantine_recovered", 0)
+    out = E.FitEngine().stream_fit(
+        v, "ar", chunk_size=32, max_lag=2, collect=True,
+        retry=durability.BackoffPolicy(max_retries=2, base_delay_s=0.01))
+    assert not out.chunk_failures
+    assert out.n_fitted == 96
+    assert out.stats["quarantined"] == 1
+    assert out.stats["retry_attempts"] == 1
+    assert out.stats["recovered"] == 1
+    assert out.stats["dead_chunks"] == 0
+    assert reg.snapshot()["counters"]["engine.quarantine_recovered"] \
+        == before + 1
+    monkeypatch.setattr(E.FitEngine, "_entry", real_entry)
+    ref = E.FitEngine().stream_fit(v, "ar", chunk_size=32, max_lag=2,
+                                   collect=True)
+    np.testing.assert_array_equal(_coef_stack(out.models),
+                                  _coef_stack(ref.models))
+
+
+@pytest.mark.slow
+def test_oom_chunk_degrades_and_splits_bitwise():
+    v = _ar_panel(64, 48, seed=6)
+    reg = metrics.get_registry()
+    before = reg.snapshot()["counters"].get("engine.degraded_chunks", 0)
+    with res.fault_injection("oom_chunk", chunk_index=0):
+        out = E.FitEngine().stream_fit(v, "ar", chunk_size=64, max_lag=2,
+                                       collect=True, retry=0)
+    assert out.n_fitted == 64 and not out.chunk_failures
+    assert out.stats["degraded_chunks"] == 1
+    assert out.stats["dead_chunks"] == 0
+    assert len(out.models) == 2        # two sub-chunks for one chunk
+    assert reg.snapshot()["counters"]["engine.degraded_chunks"] \
+        == before + 1
+    # each half ran the same dense program a direct half-panel stream
+    # runs — bitwise identical
+    for half, model in zip((v[:32], v[32:]), out.models):
+        ref = E.FitEngine().stream_fit(half, "ar", chunk_size=32,
+                                       max_lag=2, collect=True)
+        np.testing.assert_array_equal(np.asarray(model.coefficients),
+                                      np.asarray(ref.models[0].coefficients))
+
+
+@pytest.mark.slow
+def test_oom_at_floor_quarantines_instead_of_splitting():
+    v = _ar_panel(64, 48, seed=7)
+    with res.fault_injection("oom_chunk", chunk_index=0):
+        out = E.FitEngine().stream_fit(v, "ar", chunk_size=64, max_lag=2,
+                                       degrade_floor=64, retry=0)
+    assert out.n_fitted == 0
+    assert out.stats["degraded_chunks"] == 0
+    assert out.stats["quarantined"] == 1
+    assert out.stats["dead_chunks"] == 1
+    f = out.chunk_failures[0]
+    assert f["kind"] == "oom" and "RESOURCE_EXHAUSTED" in f["error"]
+
+
+@pytest.mark.slow
+def test_corrupt_journal_detected_quarantined_refit(tmp_path):
+    v = _ar_panel(96, 64, seed=8)
+    j = str(tmp_path / "journal")
+    with res.fault_injection("corrupt_journal", chunk_index=1):
+        res1 = E.FitEngine().stream_fit(v, "ar", chunk_size=32, max_lag=2,
+                                        journal=j, collect=True)
+    assert res1.stats["journal_commits"] == 3
+    reg = metrics.get_registry()
+    before = reg.snapshot()["counters"].get("engine.journal_corrupt", 0)
+    res2 = E.FitEngine().stream_fit(v, "ar", chunk_size=32, max_lag=2,
+                                    journal=j, collect=True)
+    assert res2.stats["journal_corrupt"] == 1
+    assert res2.stats["journal_hits"] == 2       # the two intact chunks
+    assert res2.stats["journal_commits"] == 1    # the refit chunk
+    assert res2.n_fitted == 96 and not res2.chunk_failures
+    assert reg.snapshot()["counters"]["engine.journal_corrupt"] \
+        == before + 1
+    # the corrupt entry was moved aside, and the refit result is bitwise
+    # what the uninterrupted run produced
+    assert os.path.isdir(os.path.join(j, "quarantine"))
+    np.testing.assert_array_equal(_coef_stack(res2.models),
+                                  _coef_stack(res1.models))
+
+
+# ---------------------------------------------------------------------------
+# kill -9 then resume (subprocess pair; the acceptance scenario)
+# ---------------------------------------------------------------------------
+
+_STREAM_CHILD = """
+import contextlib, hashlib, json, os
+import numpy as np
+from spark_timeseries_tpu import engine as E
+from spark_timeseries_tpu.utils import resilience
+
+rng = np.random.default_rng(0)
+v = rng.normal(size=(128, 48)).astype(np.float32).cumsum(axis=1)
+ctx = resilience.fault_injection("kill_after_chunk", chunk_index=1) \\
+    if os.environ.get("STS_TEST_KILL") == "1" else contextlib.nullcontext()
+with ctx:
+    res = E.FitEngine().stream_fit(
+        v, "ar", chunk_size=32, max_lag=2, collect=True,
+        journal=os.environ.get("STS_TEST_JOURNAL") or None)
+h = hashlib.sha256()
+for m in res.models:
+    h.update(np.ascontiguousarray(np.asarray(m.coefficients)).tobytes())
+print(json.dumps({
+    "sha": h.hexdigest(), "n_fitted": res.n_fitted,
+    "n_conv": res.n_converged,
+    "journal_hits": res.stats["journal_hits"],
+    "journal_commits": res.stats["journal_commits"]}))
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(900)
+def test_kill9_mid_stream_then_resume_bitwise(tmp_path):
+    """kill -9 a streaming job after its second chunk commit, resume with
+    the same journal path: committed chunks are NOT refitted (the journal
+    hit counter proves it) and the final results are bitwise-identical to
+    an uninterrupted run."""
+    jdir = str(tmp_path / "journal")
+    cache = tmp_path / "xla-cache"
+    cache.mkdir()
+    base_env = dict(os.environ, JAX_PLATFORMS="cpu",
+                    STS_COMPILE_CACHE=str(cache))
+
+    def run(**extra):
+        env = dict(base_env, **extra)
+        return subprocess.run([sys.executable, "-c", _STREAM_CHILD],
+                              capture_output=True, text=True, cwd=REPO,
+                              env=env, timeout=600)
+
+    # run A: killed by its own fault right after chunk 1's commit
+    out_a = run(STS_TEST_KILL="1", STS_TEST_JOURNAL=jdir)
+    assert out_a.returncode == -9, (out_a.returncode, out_a.stderr[-2000:])
+    committed = [f for f in os.listdir(jdir) if f.endswith(".ok")]
+    assert len(committed) == 2, committed
+
+    # run B: same journal, no fault — resumes, refits only the missing
+    # chunks
+    out_b = run(STS_TEST_JOURNAL=jdir)
+    assert out_b.returncode == 0, out_b.stderr[-2000:]
+    rec_b = json.loads(out_b.stdout.strip().splitlines()[-1])
+    assert rec_b["journal_hits"] == 2
+    assert rec_b["journal_commits"] == 2
+    assert rec_b["n_fitted"] == 128
+
+    # run C: uninterrupted, journal-free reference
+    out_c = run()
+    assert out_c.returncode == 0, out_c.stderr[-2000:]
+    rec_c = json.loads(out_c.stdout.strip().splitlines()[-1])
+    assert rec_b["sha"] == rec_c["sha"]
+    assert rec_b["n_conv"] == rec_c["n_conv"]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint round-trip of real fit results (all ten families)
+# ---------------------------------------------------------------------------
+
+ALL_FAMILIES = ["arima", "arimax", "ar", "arx", "ewma", "garch", "argarch",
+                "egarch", "holt_winters", "regression_arima"]
+
+
+def _healthy_panel(n_series: int = 6, n_obs: int = 96,
+                   seed: int = 9) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return (rng.normal(size=(n_series, n_obs)).cumsum(axis=1)
+            + 50.0).astype(np.float64)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family", ALL_FAMILIES)
+def test_checkpoint_roundtrips_real_fit_results(family, tmp_path):
+    """The journal's restore path is checkpoint.load_pytree; every model
+    family's real fitted pytree — array leaves AND static leaves (model
+    orders, Holt-Winters period/model_type) — must survive the round
+    trip bitwise."""
+    import jax
+    import jax.numpy as jnp
+
+    from spark_timeseries_tpu.panel import Panel
+    from spark_timeseries_tpu.time import DayFrequency, uniform
+
+    vals = _healthy_panel()
+    n_obs = vals.shape[1]
+    rng = np.random.default_rng(10)
+    xreg = jnp.asarray(rng.standard_normal((n_obs, 2)))
+    args = {
+        "arima": (1, 0, 1), "arimax": (xreg, 1, 0, 1, 1), "ar": (2,),
+        "arx": (xreg, 1, 1), "ewma": (), "garch": (), "argarch": (),
+        "egarch": (), "holt_winters": (4,), "regression_arima": (xreg,),
+    }[family]
+    index = uniform("2020-01-01T00:00Z", n_obs, DayFrequency(1))
+    panel = Panel(index, jnp.asarray(vals),
+                  [f"s{i}" for i in range(vals.shape[0])])
+    model, _ = panel.fit_resilient(family, *args)
+
+    path = str(tmp_path / family)
+    checkpoint.save_pytree_atomic(path, model)
+    restored = checkpoint.load_pytree(path)
+
+    assert type(restored).__name__ == type(model).__name__
+    leaves, treedef = jax.tree_util.tree_flatten(model)
+    r_leaves, r_treedef = jax.tree_util.tree_flatten(restored)
+    assert len(r_leaves) == len(leaves)
+    for a, b in zip(leaves, r_leaves):
+        if hasattr(a, "dtype") or hasattr(b, "dtype"):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        else:
+            assert a == b and type(a) is type(b)
+    if family == "holt_winters":
+        # static leaves survive with their Python types, not as arrays
+        assert restored.period == model.period
+        assert isinstance(restored.period, int)
+        assert restored.model_type == model.model_type
+        assert isinstance(restored.model_type, str)

@@ -16,20 +16,29 @@ automated check (``make gate``):
   the trailing ``--window`` comparable rounds and fails past the
   metric's threshold:
 
-  ===================  ========================================  =======
-  metric               source                                    worse
-  ===================  ========================================  =======
-  throughput           headline ``value`` (series/sec)           lower
-  fit_wall_s           ``metrics.spans["bench.fit_panel"]`` p50  higher
-  compile_s_total      ``metrics.compile_s_total``               higher
-  jit_compiles         ``metrics.jit_compiles``                  higher
-  engine_cache_misses  ``metrics.engine["engine.cache_misses"]`` higher
-  ===================  ========================================  =======
+  =====================  ==========================================  ======
+  metric                 source                                      worse
+  =====================  ==========================================  ======
+  throughput             headline ``value`` (series/sec)             lower
+  fit_wall_s             ``metrics.spans["bench.fit_panel"]`` p50    higher
+  compile_s_total        ``metrics.compile_s_total``                 higher
+  jit_compiles           ``metrics.jit_compiles``                    higher
+  engine_cache_misses    ``metrics.engine["engine.cache_misses"]``   higher
+  engine_chunk_failures  ``metrics.engine["engine.chunk_failures"]`` higher
+  engine_dead_chunks     ``metrics.engine["engine.dead_chunks"]``    higher
+  =====================  ==========================================  ======
 
   (``engine_cache_misses`` is the streaming engine's executable-cache
   miss count — a >50% jump over the trailing median means fits stopped
   sharing bucketed executables, i.e. the compile-amortization win
-  regressed even if wall time hasn't caught it yet.)
+  regressed even if wall time hasn't caught it yet.
+  ``engine_chunk_failures``/``engine_dead_chunks`` are the stream's
+  reliability counters: when an ``engine`` block is present but the
+  counter is absent the round ran CLEAN and the value is a real 0 —
+  registry counters only materialize on first increment — so a history
+  of zeros flags ANY newly nonzero round via the zero-baseline rule
+  below, exactly the "a chunk silently started dying every round"
+  regression the durability tier exists to prevent.)
 
 - prints a pass/fail table with signed percentage deltas and exits 1 on
   any regression, 0 otherwise.  A newest round that crashed (``rc != 0``)
@@ -66,6 +75,8 @@ METRICS = [
     ("compile_s_total", "lower_better", 50.0),
     ("jit_compiles", "lower_better", 50.0),
     ("engine_cache_misses", "lower_better", 50.0),
+    ("engine_chunk_failures", "lower_better", 50.0),
+    ("engine_dead_chunks", "lower_better", 50.0),
 ]
 
 
@@ -144,9 +155,22 @@ def extract_metrics(headline: Optional[dict]) -> Dict[str, float]:
         if isinstance(m.get("jit_compiles"), (int, float)):
             out["jit_compiles"] = float(m["jit_compiles"])
         eng = m.get("engine")
-        if isinstance(eng, dict) and isinstance(
-                eng.get("engine.cache_misses"), (int, float)):
-            out["engine_cache_misses"] = float(eng["engine.cache_misses"])
+        if isinstance(eng, dict):
+            if isinstance(eng.get("engine.cache_misses"), (int, float)):
+                out["engine_cache_misses"] = \
+                    float(eng["engine.cache_misses"])
+            # reliability counters: an engine block without the key means
+            # the stream ran clean (counters materialize on first
+            # increment), so 0 here is a measurement, not a fabrication —
+            # it seeds the zero baseline that flags the first failing
+            # round
+            for key, name in (("engine.chunk_failures",
+                               "engine_chunk_failures"),
+                              ("engine.dead_chunks",
+                               "engine_dead_chunks")):
+                v = eng.get(key, 0)
+                if isinstance(v, (int, float)):
+                    out[name] = float(v)
     return out
 
 
@@ -243,19 +267,19 @@ def render(verdict: Dict[str, Any]) -> str:
     lines.append(f"bench gate: round r{verdict['round']:02d} "
                  f"(platform={verdict['platform']}) vs median of rounds "
                  f"{['r%02d' % r for r in verdict['baseline_rounds']]}")
-    hdr = (f"{'metric':<20} {'newest':>12} {'baseline':>12} "
+    hdr = (f"{'metric':<22} {'newest':>12} {'baseline':>12} "
            f"{'delta%':>8} {'thr%':>6}  status")
     lines.append(hdr)
     lines.append("-" * len(hdr))
     for row in verdict["rows"]:
         if row["status"] == "skipped":
-            lines.append(f"{row['metric']:<20} {'-':>12} {'-':>12} "
+            lines.append(f"{row['metric']:<22} {'-':>12} {'-':>12} "
                          f"{'-':>8} {row['threshold_pct']:>6.0f}  "
                          f"skipped ({row['note']})")
             continue
         delta = row.get("delta_pct")
         lines.append(
-            f"{row['metric']:<20} {row['value']:>12.2f} "
+            f"{row['metric']:<22} {row['value']:>12.2f} "
             f"{row['baseline']:>12.2f} "
             f"{('%+.1f' % delta) if delta is not None else '-':>8} "
             f"{row['threshold_pct']:>6.0f}  {row['status']}")
