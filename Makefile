@@ -8,11 +8,14 @@ PY ?= python
 # this process.
 WARMUP_FAMILIES ?= arima
 WARMUP_SHAPES ?= 16384x128
+# WARMUP_SERVING=1 also precompiles the serving tier's per-tick update
+# executables at the same series counts (statespace.serving.warmup_update)
+WARMUP_SERVING ?=
 STS_COMPILE_CACHE ?=
 
 .PHONY: help verify compileall tier1 verify-faults verify-durability \
-	verify-perf gate trace lint lint-baseline contracts verify-static \
-	warmup
+	verify-perf verify-serving gate trace lint lint-baseline contracts \
+	verify-static warmup
 
 help:
 	@echo "Targets:"
@@ -27,6 +30,8 @@ help:
 	@echo "                plus the verify-durability subset"
 	@echo "  verify-durability durable-streaming suite (chunk journal + resume, deadlines,"
 	@echo "                quarantine/backoff, OOM degradation) under every fault mode"
+	@echo "  verify-serving state-space/Kalman serving-tier suite (O(1) tick updates,"
+	@echo "                exact-likelihood ARIMA, session checkpoint/restore, 0-recompile pin)"
 	@echo "  verify-perf   perf gate: newest BENCH_r*.json vs trailing-median baseline"
 	@echo "  gate          same as verify-perf (tools/bench_gate.py; exit 1 on regression)"
 	@echo "  trace         run a small demo workload, write trace.json (open in ui.perfetto.dev)"
@@ -59,7 +64,8 @@ verify-static: lint contracts
 warmup:
 	STS_COMPILE_CACHE=$(STS_COMPILE_CACHE) JAX_PLATFORMS=cpu \
 		$(PY) -m spark_timeseries_tpu.engine \
-		--families $(WARMUP_FAMILIES) --shapes $(WARMUP_SHAPES)
+		--families $(WARMUP_FAMILIES) --shapes $(WARMUP_SHAPES) \
+		$(if $(WARMUP_SERVING),--serving)
 
 compileall:
 	$(PY) -m compileall -q spark_timeseries_tpu
@@ -96,6 +102,15 @@ verify-durability:
 		-p no:xdist -p no:randomly
 	STS_CHUNK_DEADLINE_S=300 STS_CHUNK_RETRIES=1 JAX_PLATFORMS=cpu \
 		$(PY) -m pytest tests/ -q -m durability \
+		--continue-on-collection-errors -p no:cacheprovider \
+		-p no:xdist -p no:randomly
+
+# serving-tier gate (ISSUE 7): the `serving`-marked subset — Kalman
+# filter vs the NumPy oracle, exact-vs-CSS likelihood ordering,
+# ServingSession update-vs-batch consistency, checkpoint round-trip,
+# and the zero-recompile pin on warmed per-tick updates
+verify-serving:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m serving \
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly
 

@@ -383,6 +383,15 @@ def main():
                         if k.startswith("engine.")}
         if eng_counters:
             block["engine"] = eng_counters
+        # the serving tier's accounting: sessions opened, ticks ingested,
+        # update/forecast calls, state bytes (tools/bench_gate.py gates the
+        # serving.update span's p50/p95 against the trailing median)
+        serv = {k: v for k, v in snap["counters"].items()
+                if k.startswith("serving.")}
+        serv.update({k: v for k, v in snap["gauges"].items()
+                     if k.startswith("serving.")})
+        if serv:
+            block["serving"] = serv
         block["static_analysis"] = _static_analysis_block()
         return block
 
@@ -673,6 +682,62 @@ def main():
             # failure must not void the already-measured curve
             resilience_demo = {"error": f"{type(e).__name__}: {e}"}
 
+    # serving demo (ISSUE 7): warm a ServingSession on a slice of the
+    # panel, stream ticks through the O(1) Kalman update (a single cached
+    # executable — zero compiles after warmup), and report the per-tick
+    # latency distribution plus forecast throughput.  The serving.update
+    # span's p50/p95 land in the metrics block, where
+    # tools/bench_gate.py enforces the per-tick latency SLO.
+    serving_demo = None
+    if error is None and os.environ.get("BENCH_SERVING", "1") == "1":
+        try:
+            from spark_timeseries_tpu.statespace import serving as sstate
+
+            demo_n = min(int(os.environ.get("BENCH_SERVING_SERIES",
+                                            "1024")), n_target)
+            ticks = max(1, min(int(os.environ.get("BENCH_SERVING_TICKS",
+                                                  "64")), n_obs - 32))
+            hist = np.array(panel[:demo_n, :n_obs - ticks], dtype=np_dtype)
+            live = np.array(panel[:demo_n, n_obs - ticks:], dtype=np_dtype)
+            with metrics.span("bench.serving_demo"):
+                model = arima.fit(2, 1, 2, jnp.asarray(hist), warn=False)
+                sess = sstate.ServingSession.start(model, hist)
+                sess.warmup()              # compile outside the timed ticks
+                t0 = time.perf_counter()
+                for t in range(ticks):
+                    sess.update(live[:, t])
+                update_s = time.perf_counter() - t0
+                horizon = 24
+                sess.forecast(horizon)     # compile the horizon's program
+                fc_reps = 3
+                t0 = time.perf_counter()
+                for _ in range(fc_reps):
+                    sess.forecast(horizon)
+                fc_s = time.perf_counter() - t0
+            # the update span nests under this demo's scope
+            # ("bench.serving_demo/serving.update") — resolve it with the
+            # same leaf matcher the gate uses, so the reported and gated
+            # numbers can never diverge
+            from tools.bench_gate import _leaf_span
+            sp = _leaf_span(metrics.snapshot()["spans"],
+                            "serving.update") or {}
+            serving_demo = {
+                "panel": demo_n,
+                "ticks": ticks,
+                "update_p50_ms": round(1e3 * sp.get("p50_s", 0.0), 3),
+                "update_p95_ms": round(1e3 * sp.get("p95_s", 0.0), 3),
+                "updates_per_s": round(ticks / update_s, 1),
+                "tick_throughput_series_per_s": round(
+                    ticks * demo_n / update_s, 1),
+                "forecast_horizon": horizon,
+                "forecast_series_per_s": round(
+                    fc_reps * demo_n / fc_s, 1),
+                "state_bytes": sess.state_bytes,
+            }
+        except Exception as e:  # noqa: BLE001 — optional extra; its
+            # failure must not void the already-measured curve
+            serving_demo = {"error": f"{type(e).__name__}: {e}"}
+
     # compiled-program cost accounting (ISSUE 3): ask XLA what one
     # compiled fit of the benched chunk shape costs — FLOPs, bytes, peak
     # memory, HLO op mix — per family in BENCH_COST_FAMILIES (default:
@@ -786,6 +851,7 @@ def main():
         "peak_device_memory_mb": peak_mb,
         "refit_demo": refit_demo,
         "resilience_demo": resilience_demo,
+        "serving_demo": serving_demo,
         "cost_reports": cost_reports,
         "baseline_emulation": {
             "kind": "per-series scipy Powell on the same CSS objective",

@@ -174,9 +174,9 @@ def configure_compile_cache(path: Optional[str] = None) -> Optional[str]:
 # static).
 _STATICS_BUILDERS: Dict[str, Callable[..., tuple]] = {
     "arima": lambda p=2, d=1, q=2, include_intercept=True,
-    method="css-lm", max_iter=None, retry=None:
+    method="css-lm", max_iter=None, retry=None, objective="css":
         (int(p), int(d), int(q), bool(include_intercept), str(method),
-         max_iter, retry),
+         max_iter, retry, str(objective)),
     "ar": lambda max_lag=2, no_intercept=False:
         (int(max_lag), bool(no_intercept)),
     "ewma": lambda: (),
@@ -205,10 +205,11 @@ def _family_fit(family: str, statics: tuple, values, n_valid):
     from . import models as m
 
     if family == "arima":
-        p, d, q, icpt, method, max_iter, retry = statics
+        p, d, q, icpt, method, max_iter, retry, objective = statics
         return m.arima.fit.__wrapped__(
             p, d, q, values, include_intercept=icpt, method=method,
-            max_iter=max_iter, retry=retry, warn=False, n_valid=n_valid)
+            max_iter=max_iter, retry=retry, warn=False, n_valid=n_valid,
+            objective=objective)
     if family == "ar":
         max_lag, no_icpt = statics
         return m.autoregression.fit.__wrapped__(
@@ -1344,6 +1345,11 @@ def main(argv=None) -> int:
     ap.add_argument("--cache-dir", default=None,
                     help="persistent compile cache directory (default: "
                          "$STS_COMPILE_CACHE when set)")
+    ap.add_argument("--serving", action="store_true",
+                    help="also warm the serving tier's per-tick update "
+                         "executables at the same series counts "
+                         "(statespace.serving.warmup_update; families "
+                         "with a state-space form only)")
     args = ap.parse_args(argv)
 
     families = [f for f in args.families.split(",") if f]
@@ -1369,6 +1375,18 @@ def main(argv=None) -> int:
     _metrics.install_jax_hooks()
     eng = FitEngine(compile_cache_dir=args.cache_dir)
     report = eng.warmup(families, shapes, dtype=np.dtype(args.dtype))
+    if args.serving:
+        from .statespace import serving as _serving
+        served = []
+        for fam in families:
+            if fam not in _serving.WARMUP_FAMILIES:
+                continue
+            for s, _t in shapes:
+                served.append(_serving.warmup_update(
+                    fam, s, dtype=np.dtype(args.dtype)))
+        report["serving"] = served or (
+            f"no serving-capable families in {families}; expected a "
+            f"subset of {list(_serving.WARMUP_FAMILIES)}")
     report["jax"] = _metrics.jax_stats()
     print(json.dumps(report, indent=1))
     return 0

@@ -606,6 +606,23 @@ class ARIMAModel(NamedTuple):
                 prm, y, self.p, self.q, self._icpt),
             jnp.asarray(self.coefficients), jnp.asarray(diffed))
 
+    def log_likelihood_exact(self, ts: jnp.ndarray) -> jnp.ndarray:
+        """Exact (σ²-concentrated) Gaussian log likelihood on an
+        *undifferenced* series, via the stationary-initialized Kalman
+        filter (``statespace.convert.arma_concentrated_neg_ll``).
+
+        Unlike :meth:`log_likelihood_css` this keeps the first
+        ``max(p, q)`` observations and weights them by the stationary
+        prior — the objective ``fit(..., objective="exact")`` maximizes,
+        and the common scale for comparing CSS and exact fits."""
+        from ..statespace.convert import arma_concentrated_neg_ll
+        ts = jnp.asarray(ts)
+        diffed = differences_of_order_d(ts, self.d)[..., self.d:]
+        return _batched(
+            lambda prm, y: -arma_concentrated_neg_ll(
+                prm, y, self.p, self.q, self._icpt),
+            jnp.asarray(self.coefficients), diffed)
+
     def gradient_log_likelihood_css_arma(self, diffed: jnp.ndarray) -> jnp.ndarray:
         """Gradient of the CSS log likelihood — autodiff through the scan
         replaces the reference's hand-derived recursion
@@ -790,7 +807,8 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
         user_init_params: Optional[jnp.ndarray] = None,
         warn: bool = True, max_iter: Optional[int] = None,
         retry: Optional[_resilience.RetryPolicy] = None,
-        n_valid: Optional[jnp.ndarray] = None) -> ARIMAModel:
+        n_valid: Optional[jnp.ndarray] = None,
+        objective: str = "css") -> ARIMAModel:
     """Fit an ARIMA(p, d, q) by conditional-sum-of-squares maximum likelihood
     (ref ``ARIMA.scala:79-116``).
 
@@ -866,7 +884,34 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
     executables (``spark_timeseries_tpu.engine``) need.  Short-lane
     quarantine still applies, but as a traced mask without the host
     warning.
+
+    ``objective="exact"`` upgrades the estimate from CSS to the exact
+    Gaussian maximum likelihood: the CSS solution above becomes the
+    initial point for a batched BFGS on the σ²-concentrated Kalman-filter
+    log-likelihood (``statespace.convert.arma_concentrated_neg_ll`` —
+    stationary initial distribution, no dropped leading residuals).
+    Per lane the better of {refined, CSS-init} under the exact objective
+    is kept, so the exact fit's exact log-likelihood is never below the
+    CSS solution's.  Fully traced — the same ragged/engine contracts
+    apply; ``diagnostics.fun`` then holds the exact negative
+    log-likelihood instead of the CSS one.
     """
+    if objective not in ("css", "exact"):
+        raise ValueError(f"unknown objective {objective!r}; expected "
+                         f"'css' or 'exact'")
+    if objective == "exact":
+        base = fit.__wrapped__(p, d, q, ts, include_intercept, method,
+                               user_init_params, warn=False,
+                               max_iter=max_iter, retry=retry,
+                               n_valid=n_valid)
+        # the refine honors the retry policy's iteration cap the same way
+        # the CSS solve below does
+        if max_iter is None and retry is not None \
+                and retry.max_iter is not None:
+            max_iter = retry.max_iter
+        model = _exact_refine(base, ts, n_valid=n_valid, max_iter=max_iter)
+        _warn_stationarity_invertibility(model, warn)
+        return model
     ts = jnp.asarray(ts)
     rk = _resilience.retry_kwargs(retry)
     if max_iter is None and retry is not None and retry.max_iter is not None:
@@ -1003,6 +1048,63 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
 # fit_long segments): internal exploratory fits must not inflate the public
 # fit.arima.* counter bundle — only the entry point the user called records
 _fit_unrecorded = fit.__wrapped__
+
+
+def _exact_refine(base: ARIMAModel, ts: jnp.ndarray,
+                  n_valid: Optional[jnp.ndarray] = None,
+                  max_iter: Optional[int] = None) -> ARIMAModel:
+    """Refine a CSS-fitted model under the exact Kalman likelihood.
+
+    Batched BFGS on ``statespace.convert.arma_concentrated_neg_ll`` from
+    the CSS coefficients; per lane the refined parameters are kept only
+    when they do not worsen the exact objective (BFGS can wander on the
+    common-factor plateaus the CSS fit already documents), so the result
+    is exact-loglik-monotone versus its init by construction.  Lanes the
+    CSS fit quarantined (NaN coefficients) stay quarantined — a NaN init
+    propagates through the solve and the keep-the-better rule falls back
+    to the init.
+    """
+    from ..statespace.convert import arma_concentrated_neg_ll
+
+    p, q, icpt = base.p, base.q, base._icpt
+    init = jnp.asarray(base.coefficients)
+    if init.shape[-1] == 0:
+        return base
+    ts = jnp.asarray(ts)
+    if n_valid is not None:
+        obs_len = jnp.asarray(n_valid)
+    else:
+        ts, obs_len = ragged_view(ts)
+    diffed = differences_of_order_d(ts, base.d)[..., base.d:]
+    nv = None if obs_len is None else jnp.maximum(obs_len - base.d, 0)
+    extra = () if nv is None else (nv,)
+
+    def neg_ll(prm, y, *v):
+        return arma_concentrated_neg_ll(prm, y, p, q, icpt,
+                                        n_valid=v[0] if v else None)
+
+    res = minimize_bfgs(neg_ll, init, diffed, *extra, tol=1e-9,
+                        max_iter=max_iter if max_iter is not None else 200)
+    if init.ndim == 1:
+        f_init = neg_ll(init, diffed, *extra)
+    else:
+        f_init = jax.vmap(neg_ll)(init, diffed, *extra)
+    # keep the refined point only when it is finite and no worse than the
+    # init under the exact objective (NaN comparisons are False, so NaN
+    # lanes fall back to the init automatically)
+    improved = jnp.isfinite(res.fun) \
+        & jnp.all(jnp.isfinite(res.x), axis=-1) & (res.fun <= f_init)
+    params = jnp.where(improved[..., None] if init.ndim > 1 else improved,
+                       res.x, init)
+    fun = jnp.where(improved, res.fun, f_init)
+    base_conv = base.diagnostics.converged if base.diagnostics is not None \
+        else jnp.isfinite(f_init)
+    converged = jnp.where(improved, jnp.asarray(res.converged),
+                          jnp.reshape(jnp.asarray(base_conv), fun.shape))
+    diag = FitDiagnostics(converged & jnp.isfinite(fun),
+                          jnp.asarray(res.n_iter), fun)
+    return ARIMAModel(base.p, base.d, base.q, params, base.has_intercept,
+                      diagnostics=diag)
 
 
 def _ll_batched(coefs: jnp.ndarray, diffed: jnp.ndarray,

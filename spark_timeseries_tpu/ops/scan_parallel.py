@@ -45,6 +45,40 @@ def linear_recurrence(a: jnp.ndarray, b: jnp.ndarray,
     return y
 
 
+def affine_recurrence(A: jnp.ndarray, b: jnp.ndarray,
+                      x0: jnp.ndarray = None) -> jnp.ndarray:
+    """Solve the *vector* affine recurrence ``x_t = A_t @ x_{t-1} + b_t``
+    for t = 1..n in O(log n) depth — the matrix generalization of
+    :func:`linear_recurrence`.
+
+    ``A (n, ..., m, m)``, ``b (n, ..., m)`` with the time axis leading;
+    ``x0 (..., m)`` seeds ``x_0`` (zeros when None).  The affine maps
+    compose as ``(A2, b2) ∘ (A1, b1) = (A2 A1, A2 b1 + b2)`` — associative,
+    so ``lax.associative_scan`` evaluates every prefix composition in
+    logarithmic depth.  Returns ``x (n, ..., m)`` = the states x_1..x_n.
+
+    This is the parallel-prefix engine behind the state-space tier's
+    fixed-gain Kalman filter (``statespace.kalman.filter_panel_parallel``):
+    with a pinned gain the filtered-state recursion is exactly this affine
+    map, so a whole series filters in O(log n) depth instead of an O(n)
+    scan — the same trade the EWMA/GARCH paths already make.
+    """
+    A = jnp.asarray(A)
+    b = jnp.asarray(b)
+    if x0 is not None:
+        # fold the seed into the first step: x_1 = A_1 x_0 + b_1
+        b = b.at[0].add(jnp.einsum("...ij,...j->...i", A[0], x0))
+
+    def combine(left, right):
+        a1, b1 = left
+        a2, b2 = right
+        return (jnp.einsum("...ij,...jk->...ik", a2, a1),
+                jnp.einsum("...ij,...j->...i", a2, b1) + b2)
+
+    _, x = lax.associative_scan(combine, (A, b), axis=0)
+    return x
+
+
 def ewma_smooth(x: jnp.ndarray, alpha: jnp.ndarray,
                 axis: int = -1) -> jnp.ndarray:
     """EWMA smoothing ``S_t = alpha*x_t + (1-alpha)*S_{t-1}``, ``S_0 = x_0``

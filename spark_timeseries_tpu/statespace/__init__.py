@@ -1,0 +1,38 @@
+"""State-space/Kalman subsystem: the online serving tier (ROADMAP item 3).
+
+Express the classical families — ARIMA, AR/ARX, EWMA, additive
+Holt-Winters — as batched linear-Gaussian state-space models so that
+
+- a new observation on an already-fitted series is a single O(m²)
+  Kalman-filter step (:class:`serving.ServingSession.update`, one cached
+  executable per bucket — constant work per tick, no re-optimization),
+- h-step forecasts read straight off the filtered state
+  (:meth:`serving.ServingSession.forecast`), and
+- the **exact** Gaussian likelihood falls out of the same recursion,
+  which ``models.arima.fit(objective="exact")`` maximizes through the
+  existing ``ops.optimize`` minimizers — an accuracy upgrade over the
+  CSS objective.
+
+Layout: :mod:`ssm` (representation + filter-state pytrees), :mod:`kalman`
+(the step/scan/parallel-prefix filters and likelihood accumulation),
+:mod:`convert` (fitted model → state-space form + bootstrap calibration),
+:mod:`serving` (warm sessions, tick ingest, checkpoint/restore).
+"""
+
+from . import convert, kalman, serving, ssm  # noqa: F401
+from .convert import Bootstrapped, bootstrap, to_statespace  # noqa: F401
+from .kalman import (FilterResult, concentrated_loglik,  # noqa: F401
+                     filter_panel, filter_panel_parallel,
+                     filter_step_panel)
+from .serving import ServingSession, TickResult, start_session  # noqa: F401
+from .ssm import (FilterState, SSMeta, StateSpace,  # noqa: F401
+                  initial_state, state_nbytes)
+
+__all__ = [
+    "ssm", "kalman", "convert", "serving",
+    "StateSpace", "SSMeta", "FilterState", "initial_state", "state_nbytes",
+    "filter_step_panel", "filter_panel", "filter_panel_parallel",
+    "concentrated_loglik", "FilterResult",
+    "to_statespace", "bootstrap", "Bootstrapped",
+    "ServingSession", "TickResult", "start_session",
+]

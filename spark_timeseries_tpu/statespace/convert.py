@@ -1,0 +1,319 @@
+"""Fitted model → state-space form, plus the exact-likelihood objective.
+
+``to_statespace`` turns any supported fitted model pytree into a
+``(StateSpace, SSMeta)`` pair; ``bootstrap`` additionally filters the
+model's training history through it — calibrating the innovation
+variance σ² and leaving a ready-to-serve
+:class:`~spark_timeseries_tpu.statespace.ssm.FilterState` — which is how
+:class:`~spark_timeseries_tpu.statespace.serving.ServingSession` starts.
+
+Converter algebra (docs/design.md §7):
+
+- **ARIMA(p, d, q)** — Harvey/Hamilton companion form on the d-times
+  differenced series, state dim ``m = max(p, q+1)``: ``T`` carries φ in
+  its first column and an identity superdiagonal, the noise loads
+  through ``R = (1, θ₁..θ_q, 0..)`` with ``Q = σ²RRᵀ``, ``Z = e₁``,
+  ``H = 0``.  The intercept rides the state (``c_vec = c·e₁``) so the
+  same form serves ARX's exogenous offsets; the filter's stationary
+  initialization is what makes the likelihood *exact* where CSS drops
+  the first ``max(p, q)`` residuals.  ``d`` is folded into the meta —
+  sessions difference ticks (and integrate forecasts) through a
+  length-``d`` ring of last raw differences.
+- **AR(p) / ARX** — the ARMA form with q = 0; ARX's exogenous
+  contribution enters as a per-tick observation offset
+  (``update(..., offset=xβ)``), keeping the state machinery identical.
+- **EWMA** — the SES innovations form: state = the smoothed level,
+  ``T = Z = (1,)``, pinned ``gain = (α,)``.  The filter step IS the
+  smoothing recursion (``S_t = S_{t-1} + α(y_t - S_{t-1})``), so the
+  session's level — and its flat forecast — match the fitted model
+  bit-for-bit.
+- **Holt-Winters (additive)** — the ETS(A,A,A) innovations form under
+  the R↔ETS parameter map the fit already documents
+  (``level += αe, trend += αβe, season += γ(1-α)e``): state
+  ``(ℓ, b, s₁..s_period)`` with the season ring head-first, pinned
+  ``gain = (α, αβ, 0.., γ(1-α))``, rotation rows in ``T``.  The
+  multiplicative model's observation is nonlinear in the state and
+  stays out (raise).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+
+from .kalman import concentrated_loglik, filter_panel
+from .ssm import FilterState, SSMeta, StateSpace, initial_state
+
+__all__ = ["to_statespace", "bootstrap", "companion_arma",
+           "arma_concentrated_neg_ll", "Bootstrapped"]
+
+
+def _batched_2d(x, width: int) -> jnp.ndarray:
+    """Normalize model coefficients to a ``(S, width)`` batch."""
+    x = jnp.asarray(x)
+    if x.ndim == 1:
+        x = x[None]
+    return x.reshape(x.shape[0], width)
+
+
+def companion_arma(phi: jnp.ndarray, theta: jnp.ndarray,
+                   c: Optional[jnp.ndarray] = None) -> StateSpace:
+    """Harvey companion-form ``StateSpace`` for a batched ARMA(p, q) at
+    unit noise scale (σ² = 1; ``bootstrap`` rescales after calibration).
+
+    ``phi (S, p)``, ``theta (S, q)``, ``c (S,)`` the regression-form
+    intercept (enters the state as ``c·e₁``).
+    """
+    phi = jnp.asarray(phi)
+    theta = jnp.asarray(theta)
+    S, p = phi.shape
+    q = theta.shape[-1]
+    m = max(p, q + 1)
+    dtype = phi.dtype
+
+    T = jnp.zeros((S, m, m), dtype)
+    if p:
+        T = T.at[:, :p, 0].set(phi)
+    if m > 1:
+        idx = jnp.arange(m - 1)
+        T = T.at[:, idx, idx + 1].set(1.0)
+    R = jnp.zeros((S, m), dtype).at[:, 0].set(1.0)
+    if q:
+        R = R.at[:, 1:q + 1].set(theta)
+    Q = jnp.einsum("si,sj->sij", R, R)
+    Z = jnp.zeros((S, m), dtype).at[:, 0].set(1.0)
+    c_vec = jnp.zeros((S, m), dtype)
+    if c is not None:
+        c_vec = c_vec.at[:, 0].set(jnp.asarray(c, dtype).reshape(S))
+    return StateSpace(T=T, Z=Z, c=c_vec, d=jnp.zeros((S,), dtype),
+                      H=jnp.zeros((S,), dtype), Q=Q,
+                      gain=jnp.zeros((S, m), dtype))
+
+
+def _arima_like(model, family: str) -> Tuple[StateSpace, SSMeta]:
+    p, d, q = model.p, model.d, model.q
+    coefs = jnp.asarray(model.coefficients)
+    if coefs.ndim == 1:
+        coefs = coefs[None]
+    icpt = 1 if model.has_intercept else 0
+    c = coefs[:, 0] if icpt else jnp.zeros((coefs.shape[0],), coefs.dtype)
+    phi = coefs[:, icpt:icpt + p]
+    theta = coefs[:, icpt + p:icpt + p + q]
+    ssm = companion_arma(phi, theta, c)
+    return ssm, SSMeta(family, "exact", int(d), ssm.state_dim)
+
+
+def _ar_like(model, family: str) -> Tuple[StateSpace, SSMeta]:
+    coefs = jnp.asarray(model.coefficients)
+    if coefs.ndim == 1:
+        coefs = coefs[None]
+    S, p = coefs.shape
+    if family == "arx":
+        p = int(model.y_max_lag)
+        phi = coefs[:, :p]
+    else:
+        phi = coefs
+    c = jnp.asarray(model.c).reshape(-1)
+    c = jnp.broadcast_to(c, (coefs.shape[0],))
+    ssm = companion_arma(phi, jnp.zeros((coefs.shape[0], 0), coefs.dtype),
+                         c)
+    return ssm, SSMeta(family, "exact", 0, ssm.state_dim)
+
+
+def _ewma(model) -> Tuple[StateSpace, SSMeta]:
+    alpha = jnp.atleast_1d(jnp.asarray(model.smoothing))
+    S = alpha.shape[0]
+    dtype = alpha.dtype
+    one = jnp.ones((S, 1, 1), dtype)
+    ssm = StateSpace(T=one, Z=jnp.ones((S, 1), dtype),
+                     c=jnp.zeros((S, 1), dtype),
+                     d=jnp.zeros((S,), dtype),
+                     H=jnp.ones((S,), dtype),
+                     Q=(alpha * alpha)[:, None, None],
+                     gain=alpha[:, None])
+    return ssm, SSMeta("ewma", "innovations", 0, 1)
+
+
+def _holt_winters(model) -> Tuple[StateSpace, SSMeta]:
+    if not model.additive:
+        raise NotImplementedError(
+            "multiplicative Holt-Winters has a state-nonlinear observation "
+            "(level·season); only the additive model has a linear "
+            "state-space form — refit with model_type='additive' or serve "
+            "multiplicative panels through batch refits")
+    period = int(model.period)
+    a = jnp.atleast_1d(jnp.asarray(model.alpha))
+    b = jnp.atleast_1d(jnp.asarray(model.beta))
+    g = jnp.atleast_1d(jnp.asarray(model.gamma))
+    S = a.shape[0]
+    dtype = a.dtype
+    m = 2 + period
+    T = jnp.zeros((S, m, m), dtype)
+    T = T.at[:, 0, 0].set(1.0).at[:, 0, 1].set(1.0)       # ℓ' = ℓ + b
+    T = T.at[:, 1, 1].set(1.0)                            # b' = b
+    idx = jnp.arange(period - 1)
+    T = T.at[:, 2 + idx, 3 + idx].set(1.0)                # ring rotation
+    T = T.at[:, 2 + period - 1, 2].set(1.0)               # tail <- old head
+    Z = jnp.zeros((S, m), dtype)
+    Z = Z.at[:, 0].set(1.0).at[:, 1].set(1.0).at[:, 2].set(1.0)
+    gain = jnp.zeros((S, m), dtype)
+    gain = gain.at[:, 0].set(a).at[:, 1].set(a * b) \
+        .at[:, 2 + period - 1].set(g * (1.0 - a))
+    ssm = StateSpace(T=T, Z=Z, c=jnp.zeros((S, m), dtype),
+                     d=jnp.zeros((S,), dtype),
+                     H=jnp.ones((S,), dtype),
+                     Q=jnp.einsum("si,sj->sij", gain, gain),
+                     gain=gain)
+    return ssm, SSMeta("holt_winters", "innovations", 0, m)
+
+
+def to_statespace(model) -> Tuple[StateSpace, SSMeta]:
+    """Express a fitted model pytree in state-space form.
+
+    Dispatches on the model class (``ARIMAModel``, ``ARModel``,
+    ``ARXModel``, ``EWMAModel``, ``HoltWintersModel``); scalar (single
+    series) models are normalized to a batch of one.  Returns the model
+    at **unit noise scale** — :func:`bootstrap` calibrates σ² from the
+    training history.
+    """
+    name = type(model).__name__
+    if name == "ARIMAModel":
+        return _arima_like(model, "arima")
+    if name == "ARModel":
+        return _ar_like(model, "ar")
+    if name == "ARXModel":
+        return _ar_like(model, "arx")
+    if name == "EWMAModel":
+        return _ewma(model)
+    if name == "HoltWintersModel":
+        return _holt_winters(model)
+    raise TypeError(
+        f"no state-space form for {name}; supported: ARIMAModel, ARModel, "
+        f"ARXModel, EWMAModel, HoltWintersModel (additive)")
+
+
+class Bootstrapped(NamedTuple):
+    """``to_statespace`` + a calibrated history filter pass: everything a
+    serving session needs.  ``sigma2`` is the per-lane concentrated
+    innovation-variance estimate the ssm/state were rescaled with."""
+    ssm: StateSpace
+    meta: SSMeta
+    state: FilterState
+    sigma2: jnp.ndarray
+
+
+def _rescale(ssm: StateSpace, state: FilterState, meta: SSMeta,
+             sigma2: jnp.ndarray) -> Tuple[StateSpace, FilterState]:
+    """Move the unit-scale filter to the calibrated σ²: Q (and H in
+    innovations mode) scale linearly, as does the predicted covariance;
+    gains and means are scale-invariant, so nothing else moves."""
+    s2q = sigma2[:, None, None]
+    ssm = ssm._replace(Q=ssm.Q * s2q,
+                       H=ssm.H * (sigma2 if meta.mode == "innovations"
+                                  else 1.0))
+    state = state._replace(P=state.P * s2q)
+    return ssm, state
+
+
+def bootstrap(model, history, *, offsets=None) -> Bootstrapped:
+    """Build the serving form of a fitted model: convert, filter the
+    training ``history (S, n)`` (NaNs are missing ticks), calibrate σ²
+    from the innovations, and return the rescaled
+    ``(ssm, meta, state, sigma2)``.
+
+    The returned state's ``loglik`` is the exact log-likelihood of the
+    history at the calibrated scale, so a session's running likelihood
+    continues seamlessly from its bootstrap.  ``offsets (S, n)`` carries
+    per-tick exogenous observation offsets for ARX models.
+    """
+    ssm, meta = to_statespace(model)
+    history = jnp.asarray(history)
+    if history.ndim == 1:
+        history = history[None]
+    if history.shape[0] != ssm.n_series:
+        if ssm.n_series == 1:
+            # scalar model over a panel: broadcast the parameters
+            import jax
+            ssm = jax.tree_util.tree_map(
+                lambda leaf: jnp.broadcast_to(
+                    leaf, (history.shape[0],) + leaf.shape[1:]), ssm)
+        else:
+            raise ValueError(
+                f"history has {history.shape[0]} series but the model is "
+                f"batched over {ssm.n_series}")
+    dtype = history.dtype
+    ssm = type(ssm)(*(jnp.asarray(leaf, dtype) for leaf in ssm))
+    state = initial_state(ssm, meta)
+
+    if offsets is not None:
+        offsets = jnp.asarray(offsets)
+
+    if meta.family == "ewma":
+        # S_0 = x_0 exactly (the model's own seed); filter from t = 1
+        first = history[:, 0]
+        state = state._replace(a=jnp.where(jnp.isfinite(first),
+                                           first, 0.0)[:, None])
+        res = filter_panel(ssm, state, history[:, 1:], meta,
+                           offsets=None if offsets is None
+                           else offsets[:, 1:])
+    elif meta.family == "holt_winters":
+        period = meta.m - 2
+        if history.shape[1] < 2 * period:
+            raise ValueError(
+                f"Holt-Winters bootstrap needs >= 2 periods of history "
+                f"({2 * period} obs), got {history.shape[1]}")
+        level0, trend0, season0 = model._init_components(history)
+        a0 = jnp.concatenate([level0[..., None], trend0[..., None],
+                              season0], axis=-1)
+        state = state._replace(a=jnp.asarray(a0, dtype))
+        res = filter_panel(ssm, state, history[:, period:], meta,
+                           offsets=None if offsets is None
+                           else offsets[:, period:])
+    else:
+        res = filter_panel(ssm, state, history, meta, offsets=offsets)
+
+    final = res.state
+    n = jnp.maximum(final.n_obs.astype(dtype), 1.0)
+    sigma2 = final.ssq / n
+    sigma2 = jnp.where(jnp.isfinite(sigma2) & (sigma2 > 0), sigma2, 1.0)
+    ssm, final = _rescale(ssm, final, meta, sigma2)
+    # the running loglik restated at the calibrated scale (the unit-scale
+    # pass measured Σlog F and Σv²/F; both shift by known σ² factors)
+    final = final._replace(
+        loglik=concentrated_loglik(final),
+        ssq=final.ssq / sigma2,
+        sumlogf=final.sumlogf
+        + final.n_obs.astype(dtype) * jnp.log(sigma2))
+    return Bootstrapped(ssm, meta, final, sigma2)
+
+
+def arma_concentrated_neg_ll(params: jnp.ndarray, diffed: jnp.ndarray,
+                             p: int, q: int, icpt: int,
+                             n_valid=None) -> jnp.ndarray:
+    """Negative σ²-concentrated *exact* ARMA log-likelihood of one lane —
+    the ``arima.fit(objective="exact")`` objective.
+
+    ``params (icpt+p+q,)`` in the fit's ``[c?, φ.., θ..]`` layout;
+    ``diffed (n,)`` the already-differenced series; ``n_valid`` (scalar)
+    restricts a left-aligned ragged lane to its valid window (steps past
+    it are skipped, matching the trimmed series).  Builds the companion
+    form at unit scale, runs the stationary-initialized filter, and
+    profiles σ² out — fully traced, autodiff-friendly, so the existing
+    ``ops.optimize`` minimizers drive it.
+    """
+    dtype = diffed.dtype
+    params = jnp.asarray(params, dtype)
+    c = params[0] if icpt else jnp.zeros((), dtype)
+    phi = params[icpt:icpt + p][None]
+    theta = params[icpt + p:icpt + p + q][None]
+    ssm = companion_arma(phi, theta, c[None])
+    meta = SSMeta("arima", "exact", 0, ssm.state_dim)
+    state = initial_state(ssm, meta)
+    weights = None
+    if n_valid is not None:
+        from ..ops.ragged import step_weights
+        weights = step_weights(diffed.shape[-1], jnp.asarray(n_valid),
+                               offset=0, dtype=dtype)[None]
+    res = filter_panel(ssm, state, diffed[None], meta, weights=weights)
+    return -concentrated_loglik(res.state)[0]

@@ -1,0 +1,401 @@
+"""Online serving sessions: O(1) per-tick ingest + forecast on warm state.
+
+The gap this closes (ROADMAP open item 3): every pre-existing path is
+batch — a new observation on an already-fitted series costs a full
+re-optimization through ``engine.stream_fit``.  A
+:class:`ServingSession` instead holds each series' *state-space filter
+state* (``statespace.ssm``: O(m²) floats per series, engine-bucketed
+device buffers) and makes ingest a single cached-executable Kalman step:
+
+- :meth:`update` — one tick for the whole panel.  The executable is a
+  module-level ``jax.jit`` keyed by ``(bucket, state dim, SSMeta)``, so
+  every session of the same family/shape shares one compiled program;
+  :meth:`warmup` (or ``engine.warmup``-style pre-warming with
+  ``STS_COMPILE_CACHE`` armed) compiles it ahead of traffic, after which
+  updates trigger **zero** XLA compiles — pinned by
+  ``tests/test_statespace.py`` exactly as ``tests/test_engine.py`` pins
+  the fit engine.  There is no fit/optimizer call anywhere in the tick
+  path: per-tick work is O(m²) per series, independent of history
+  length.
+- :meth:`forecast` — h-step point forecasts straight off the filtered
+  state (mean propagation + d-order integration through the raw
+  difference ring), one cached executable per horizon.
+- :meth:`checkpoint` / :meth:`restore` — the whole session (SSM, filter
+  state, meta, tick counters) through ``utils.checkpoint``'s atomic
+  pytree writer, so a serving process restarts where it stopped.
+
+Metrics: ``serving.sessions`` / ``serving.ticks`` / ``serving.updates``
+/ ``serving.forecasts`` counters, a ``serving.update`` span (p50/p95
+land in bench's ``serving_demo`` block and gate the per-tick SLO in
+``tools/bench_gate.py``), and a ``serving.state_bytes`` gauge.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from ..utils import checkpoint as _checkpoint
+from ..utils import metrics as _metrics
+from .convert import Bootstrapped, bootstrap
+from .kalman import filter_step_panel
+from .ssm import FilterState, SSMeta, StateSpace, state_nbytes
+
+__all__ = ["ServingSession", "TickResult", "start_session",
+           "warmup_update", "WARMUP_FAMILIES"]
+
+_CHECKPOINT_FORMAT = 1
+
+# families warmup_update can synthesize an executable-shaped SSM for
+# without a fitted model (the serving-capable subset of ENGINE_FAMILIES)
+WARMUP_FAMILIES = ("arima", "ar", "arx", "ewma", "holt_winters")
+
+
+class TickResult(NamedTuple):
+    """One :meth:`ServingSession.update`'s per-series outcome (real lanes
+    only): the innovations ``v`` (NaN where the tick was missing), their
+    predictive variances ``F``, and the per-series log-likelihood
+    increment of the tick."""
+    innovations: np.ndarray
+    variances: np.ndarray
+    loglik_inc: np.ndarray
+
+
+# ---------------------------------------------------------------------------
+# module-level jitted kernels (one function object per program shape, so
+# every session shares jax's jit cache — the STS006 discipline)
+# ---------------------------------------------------------------------------
+
+def _update_impl(meta: SSMeta, ssm: StateSpace, state: FilterState,
+                 y, offset):
+    state2, (v, f) = filter_step_panel(ssm, state, y, offset, meta)
+    ll_inc = state2.loglik - state.loglik
+    return state2, v, f, ll_inc
+
+
+def _forecast_impl(meta: SSMeta, horizon: int, ssm: StateSpace,
+                   state: FilterState, offsets):
+    """h-step point forecasts from the predicted state: mean propagation
+    ``x ← T(x + offset·Z) + c`` with zero future innovations, each step's
+    observation integrated back to the raw scale through the difference
+    ring."""
+    import jax
+    import jax.numpy as jnp
+
+    d_order = meta.d_order
+
+    def one_lane(ssm_l, a, ring, offs):
+        def step(carry, off):
+            x, lasts = carry
+            z = ssm_l.d + ssm_l.Z @ x + off
+            if d_order:
+                vals = []
+                cur = z
+                for j in range(d_order - 1, -1, -1):
+                    cur = cur + lasts[j]
+                    vals.append(cur)
+                y_out = cur
+                lasts = jnp.stack(vals[::-1])
+            else:
+                y_out = z
+            x = ssm_l.T @ (x + off * ssm_l.Z) + ssm_l.c
+            return (x, lasts), y_out
+
+        _, ys = jax.lax.scan(step, (a, ring), offs, length=horizon)
+        return ys
+
+    return jax.vmap(one_lane)(ssm, state.a, state.ring, offsets)
+
+
+_jit_lock = threading.Lock()
+_jit_cache: dict = {}
+
+
+def _jitted(kind: str):
+    """Lazily-built module-level jits (imports jax on first use so merely
+    importing the package never initializes a backend).  Arms the
+    engine's persistent compile cache first, so a serving process that
+    never builds a ``FitEngine`` still honors ``STS_COMPILE_CACHE`` —
+    its first update deserializes instead of compiling."""
+    with _jit_lock:
+        fn = _jit_cache.get(kind)
+        if fn is None:
+            import jax
+
+            from ..engine import configure_compile_cache
+            configure_compile_cache()
+            if kind == "update":
+                fn = jax.jit(_update_impl, static_argnums=(0,))
+            else:
+                fn = jax.jit(_forecast_impl, static_argnums=(0, 1))
+            _jit_cache[kind] = fn
+        return fn
+
+
+def _pad_lanes(tree, bucket: int, n_real: int):
+    """Pad every batched leaf to the series bucket by replicating lane 0
+    (finite, harmless — padded lanes only ever see NaN ticks, which the
+    filter skips)."""
+    import jax
+    import jax.numpy as jnp
+
+    pad = bucket - n_real
+    if pad == 0:
+        return tree
+
+    def grow(leaf):
+        return jnp.concatenate(
+            [leaf, jnp.broadcast_to(leaf[:1], (pad,) + leaf.shape[1:])])
+
+    return jax.tree_util.tree_map(grow, tree)
+
+
+class ServingSession:
+    """Warm per-series filter state + cached tick/forecast executables.
+
+    Build one with :meth:`start` (fitted model + its training history) or
+    :meth:`restore` (a checkpoint).  Not thread-safe per instance — one
+    session is one logical stream; shard across sessions for parallel
+    ingest (the compiled programs are shared through the jit cache).
+    """
+
+    def __init__(self, ssm: StateSpace, meta: SSMeta, state: FilterState,
+                 n_series: int, *, ticks_seen: int = 0,
+                 registry=None):
+        from ..engine import series_bucket
+
+        self._reg = registry if registry is not None \
+            else _metrics.get_registry()
+        self.meta = meta
+        self.n_series = int(n_series)
+        self._bucket = series_bucket(self.n_series)
+        self.ticks_seen = int(ticks_seen)
+        if ssm.n_series == self._bucket:       # already bucketed (restore)
+            self._ssm, self._state = ssm, state
+        else:
+            self._ssm = _pad_lanes(ssm, self._bucket, ssm.n_series)
+            self._state = _pad_lanes(state, self._bucket, state.a.shape[0])
+        self._dtype = np.dtype(self._ssm.T.dtype)
+        self._reg.inc("serving.sessions")
+        self._reg.set_gauge("serving.state_bytes",
+                            state_nbytes(self._state))
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def start(cls, model, history, *, offsets=None,
+              registry=None) -> "ServingSession":
+        """Open a session from a fitted model pytree and the history it
+        was fitted on: converts to state-space form
+        (``statespace.convert.to_statespace``), filters the history to a
+        warm state, calibrates σ², and buckets the per-series buffers.
+        ``history (n_series, n_obs)`` (NaNs are missing ticks);
+        ``offsets`` carries ARX per-tick exogenous observation offsets.
+        """
+        import jax.numpy as jnp
+
+        history = jnp.asarray(history)
+        if history.ndim == 1:
+            history = history[None]
+        boot: Bootstrapped = bootstrap(model, history, offsets=offsets)
+        return cls(boot.ssm, boot.meta, boot.state, history.shape[0],
+                   ticks_seen=int(history.shape[1]), registry=registry)
+
+    # -- serving ------------------------------------------------------------
+
+    def update(self, ticks, offset=None) -> TickResult:
+        """Ingest one tick per series — a single cached-executable Kalman
+        step, O(1) work per tick per series.
+
+        ``ticks (n_series,)`` raw observations (NaN = missing: the lane's
+        state predicts forward and contributes no likelihood);
+        ``offset (n_series,)`` the ARX exogenous observation offsets for
+        this tick.  Returns the per-series :class:`TickResult`.
+        """
+        host = np.asarray(ticks, self._dtype).reshape(-1)
+        if host.shape[0] != self.n_series:
+            raise ValueError(
+                f"update expects one tick per series ({self.n_series}), "
+                f"got {host.shape[0]}")
+        y = np.full((self._bucket,), np.nan, self._dtype)
+        y[:self.n_series] = host
+        off = np.zeros((self._bucket,), self._dtype)
+        if offset is not None:
+            off[:self.n_series] = np.asarray(offset, self._dtype) \
+                .reshape(-1)
+        fn = _jitted("update")
+        with _metrics.span("serving.update"):
+            state2, v, f, ll_inc = fn(self.meta, self._ssm, self._state,
+                                      y, off)
+            # materialize inside the span: the p50/p95 the bench gate
+            # SLOs must cover the real per-tick latency, not the async
+            # dispatch alone
+            out = TickResult(
+                np.asarray(v[:self.n_series]),
+                np.asarray(f[:self.n_series]),
+                np.asarray(ll_inc[:self.n_series]))
+        self._state = state2
+        self.ticks_seen += 1
+        self._reg.inc("serving.updates")
+        self._reg.inc("serving.ticks", self.n_series)
+        return out
+
+    def forecast(self, horizon: int, offsets=None) -> np.ndarray:
+        """``(n_series, horizon)`` point forecasts from the current
+        filtered state — mean propagation with zero future innovations,
+        integrated back through the raw-difference ring for d > 0
+        families.  ``offsets (n_series, horizon)`` adds known future
+        exogenous contributions (ARX)."""
+        horizon = int(horizon)
+        if horizon < 1:
+            raise ValueError("forecast needs horizon >= 1")
+        offs = np.zeros((self._bucket, horizon), self._dtype)
+        if offsets is not None:
+            offs[:self.n_series] = np.asarray(offsets, self._dtype)
+        fn = _jitted("forecast")
+        with _metrics.span("serving.forecast"):
+            out = np.asarray(fn(self.meta, horizon, self._ssm,
+                                self._state, offs))
+        self._reg.inc("serving.forecasts")
+        return out[:self.n_series]
+
+    def warmup(self) -> None:
+        """Compile the update executable ahead of traffic (the forecast
+        executable is per-horizon — the first :meth:`forecast` at a new
+        horizon compiles).  Functionally a no-op: the filter is pure, so
+        the warmup result is simply discarded and the state is untouched.
+        With ``STS_COMPILE_CACHE`` armed the compile also persists, and
+        the next process deserializes instead of compiling."""
+        y = np.full((self._bucket,), np.nan, self._dtype)
+        off = np.zeros((self._bucket,), self._dtype)
+        fn = _jitted("update")
+        with _metrics.span("serving.warmup"):
+            _, v, f, ll = fn(self.meta, self._ssm, self._state, y, off)
+            # also warm the real-lane result slices update materializes
+            # (tiny per-(bucket, n_series) device programs of their own —
+            # without this the first tick would compile them)
+            np.asarray(v[:self.n_series])
+            np.asarray(f[:self.n_series])
+            np.asarray(ll[:self.n_series])
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def loglik(self) -> np.ndarray:
+        """Running exact log-likelihood per series (history + ticks)."""
+        return np.asarray(self._state.loglik[:self.n_series])
+
+    @property
+    def state_bytes(self) -> int:
+        return state_nbytes(self._state)
+
+    def describe(self) -> dict:
+        return {"family": self.meta.family, "mode": self.meta.mode,
+                "n_series": self.n_series, "bucket": self._bucket,
+                "state_dim": self.meta.m, "d_order": self.meta.d_order,
+                "ticks_seen": self.ticks_seen,
+                "state_bytes": self.state_bytes,
+                "dtype": str(self._dtype)}
+
+    # -- persistence --------------------------------------------------------
+
+    def checkpoint(self, path: str) -> None:
+        """Atomically persist the whole session (``utils.checkpoint``
+        tmp+fsync+rename pytree writer): SSM, filter state, meta, and
+        tick counters — :meth:`restore` resumes serving exactly here."""
+        _checkpoint.save_pytree_atomic(path, {
+            "format": _CHECKPOINT_FORMAT,
+            "meta": self.meta,
+            "n_series": self.n_series,
+            "ticks_seen": self.ticks_seen,
+            "ssm": self._ssm,
+            "state": self._state,
+        })
+        self._reg.inc("serving.checkpoints")
+
+    @classmethod
+    def restore(cls, path: str, *, registry=None) -> "ServingSession":
+        """Rebuild a session from :meth:`checkpoint` output (validated
+        restore — a torn or mismatched checkpoint raises
+        ``CheckpointMismatchError`` instead of serving garbage)."""
+        blob = _checkpoint.load_pytree(path)
+        fmt = blob.get("format")
+        if fmt != _CHECKPOINT_FORMAT:
+            raise ValueError(
+                f"serving checkpoint format {fmt!r} is not supported "
+                f"(expected {_CHECKPOINT_FORMAT})")
+        import jax.numpy as jnp
+
+        ssm = StateSpace(*(jnp.asarray(leaf) for leaf in blob["ssm"]))
+        state = FilterState(*(jnp.asarray(leaf)
+                              for leaf in blob["state"]))
+        return cls(ssm, blob["meta"], state, blob["n_series"],
+                   ticks_seen=blob["ticks_seen"], registry=registry)
+
+
+def start_session(model, history, **kwargs) -> ServingSession:
+    """Module-level convenience for :meth:`ServingSession.start`."""
+    return ServingSession.start(model, history, **kwargs)
+
+
+def _warmup_meta(family: str, p: int, d: int, q: int,
+                 period: int) -> SSMeta:
+    """The :class:`SSMeta` a session of the given family/order would
+    carry — the static half of the update executable's cache key."""
+    if family == "arima":
+        return SSMeta("arima", "exact", int(d), max(p, q + 1))
+    if family in ("ar", "arx"):
+        return SSMeta(family, "exact", 0, max(int(p), 1))
+    if family == "ewma":
+        return SSMeta("ewma", "innovations", 0, 1)
+    if family == "holt_winters":
+        return SSMeta("holt_winters", "innovations", 0, 2 + int(period))
+    raise ValueError(f"no serving form for family {family!r}; expected "
+                     f"one of {WARMUP_FAMILIES}")
+
+
+def warmup_update(family: str = "arima", n_series: int = 1024, *,
+                  dtype=None, p: int = 2, d: int = 1, q: int = 2,
+                  period: int = 12) -> dict:
+    """Compile the per-tick update executable for a family/shape ahead of
+    any session existing — no fitted model, no data.
+
+    The executable is keyed by ``(series bucket, state dim, SSMeta)``
+    only, so a zeros-valued SSM of the right shape compiles the exact
+    program every later :meth:`ServingSession.update` of that
+    family/order/bucket runs (``engine.warmup`` for the serving tier;
+    ``python -m spark_timeseries_tpu.engine --serving`` and bench's
+    serving demo both route here).  With ``STS_COMPILE_CACHE`` armed the
+    compile persists, and the next serving process deserializes instead
+    of compiling.  Returns a summary dict.
+    """
+    import jax.numpy as jnp
+
+    from ..engine import series_bucket
+
+    if dtype is None:
+        dtype = jnp.float32
+    meta = _warmup_meta(family, p, d, q, period)
+    bucket = series_bucket(int(n_series))
+    m = meta.m
+    zeros = jnp.zeros((bucket,), dtype)
+    ssm = StateSpace(T=jnp.zeros((bucket, m, m), dtype),
+                     Z=jnp.zeros((bucket, m), dtype),
+                     c=jnp.zeros((bucket, m), dtype),
+                     d=zeros, H=jnp.ones((bucket,), dtype),
+                     Q=jnp.zeros((bucket, m, m), dtype),
+                     gain=jnp.zeros((bucket, m), dtype))
+    state = FilterState(a=jnp.zeros((bucket, m), dtype),
+                        P=jnp.zeros((bucket, m, m), dtype),
+                        ring=jnp.zeros((bucket, meta.d_order), dtype),
+                        loglik=zeros, ssq=zeros, sumlogf=zeros,
+                        n_obs=jnp.zeros((bucket,), jnp.int32))
+    y = jnp.full((bucket,), jnp.nan, dtype)
+    fn = _jitted("update")
+    with _metrics.span("serving.warmup"):
+        fn(meta, ssm, state, y, zeros)
+    return {"family": family, "bucket": bucket, "state_dim": m,
+            "mode": meta.mode, "d_order": meta.d_order,
+            "dtype": str(np.dtype(dtype))}

@@ -1,0 +1,183 @@
+"""Batched linear-Gaussian state-space representation.
+
+The serving tier's common currency (ROADMAP open item 3): every classical
+family the framework fits — ARIMA, AR/ARX, EWMA, Holt-Winters — can be
+expressed as a linear-Gaussian state-space model
+
+    y_t = d + Z·α_t (+ offset_t) + ε_t,   ε_t ~ N(0, H)
+    α_t = c + T·α_{t-1} + η_t,            η_t ~ N(0, Q)
+
+over a small hidden state α (dimension ``m``: ``max(p, q+1)`` for ARMA,
+``2 + period`` for Holt-Winters).  Once a series lives in this form, a
+new observation is one O(m²) Kalman-filter step — constant work per tick,
+independent of history length — instead of a full re-optimization through
+``engine.stream_fit``, and the *exact* Gaussian likelihood (an accuracy
+upgrade over the CSS objective, which drops the first ``max(p, q)``
+residuals and ignores the stationary initial distribution) falls out of
+the same recursion.
+
+Two filter modes share one step (``statespace.kalman``):
+
+- ``"exact"``: the textbook covariance-propagating filter.  Used by the
+  ARMA-family converters (observation noise H = 0; all noise enters the
+  state through the Harvey companion form) and by
+  ``arima.fit(objective="exact")``.  State cov ``P`` starts at the
+  stationary solution of the Lyapunov equation ``P = T P Tᵀ + Q``.
+- ``"innovations"``: the single-source-of-error (ETS) form with the gain
+  pinned to the model's own smoothing vector.  The Holt-Winters and EWMA
+  recursions ARE this filter — the per-tick update reproduces the
+  fitted model's recurrence bit-for-bit, with ``P`` degenerate (the
+  innovation variance is the constant ``H = σ²``).
+
+Everything here is a pytree of arrays with a leading ``(n_series,)``
+batch dim, so sessions vmap/jit over whole panels; the static facts a
+trace must specialize on (mode, state dim, differencing order) live in
+:class:`SSMeta`, a hashable NamedTuple passed as a static jit argument —
+never inside the traced pytrees.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+__all__ = ["StateSpace", "SSMeta", "FilterState", "initial_state",
+           "stationary_covariance", "stationary_mean", "state_nbytes"]
+
+
+class StateSpace(NamedTuple):
+    """One family's batched state-space parameters (arrays only — static
+    metadata lives in :class:`SSMeta` so the pytree jits cleanly).
+
+    ``gain`` is the pinned predictive-form Kalman gain for
+    ``mode="innovations"`` (the ETS smoothing vector); zeros — and unused
+    — in ``mode="exact"``, where the gain comes from ``P`` each step.
+    """
+    T: jnp.ndarray       # (S, m, m) state transition
+    Z: jnp.ndarray       # (S, m)    observation row vector
+    c: jnp.ndarray       # (S, m)    state intercept
+    d: jnp.ndarray       # (S,)      observation intercept
+    H: jnp.ndarray       # (S,)      observation noise variance (σ² in
+    #                                innovations mode; 0 for ARMA forms)
+    Q: jnp.ndarray       # (S, m, m) state noise covariance
+    gain: jnp.ndarray    # (S, m)    pinned gain (innovations mode)
+
+    @property
+    def n_series(self) -> int:
+        return self.T.shape[0]
+
+    @property
+    def state_dim(self) -> int:
+        return self.T.shape[-1]
+
+
+class SSMeta(NamedTuple):
+    """Static (hashable) facts about a :class:`StateSpace` — the jit keys.
+
+    ``d_order`` is the integration order the converter folded out of the
+    family (ARIMA's ``d``): the filter runs on the d-times-differenced
+    series and carries a length-``d_order`` ring of the last raw
+    differences so ticks arrive — and forecasts leave — on the raw scale.
+    """
+    family: str          # "arima" | "ar" | "arx" | "ewma" | "holt_winters"
+    mode: str            # "exact" | "innovations"
+    d_order: int         # integration order handled outside the filter
+    m: int               # state dimension
+
+
+class FilterState(NamedTuple):
+    """Per-series filter carry — the whole of a serving session's mutable
+    state (one small pytree of device buffers, O(m²) floats per series).
+
+    ``a``/``P`` are the one-step *predicted* state mean/cov (the
+    prediction-form filter: ``a = E[α_t | y_{1..t-1}]``), so the next
+    tick's innovation and the h-step forecast both read straight off the
+    carry.  ``ring[j] = Δʲ y_last`` (j < d_order) reconstructs raw-scale
+    differences and integrations.  ``ssq`` (Σ v²/F), ``sumlogf``
+    (Σ log F) and ``n_obs`` accumulate the pieces of the concentrated
+    Gaussian likelihood in-graph; ``loglik`` is the running exact
+    log-likelihood at the model's own noise scale.
+    """
+    a: jnp.ndarray        # (S, m)
+    P: jnp.ndarray        # (S, m, m)
+    ring: jnp.ndarray     # (S, d_order)
+    loglik: jnp.ndarray   # (S,)
+    ssq: jnp.ndarray      # (S,)
+    sumlogf: jnp.ndarray  # (S,)
+    n_obs: jnp.ndarray    # (S,) int32
+
+
+def stationary_covariance(T: jnp.ndarray, Q: jnp.ndarray,
+                          fallback_scale: float = 1e6) -> jnp.ndarray:
+    """Batched stationary state covariance: solve ``P = T P Tᵀ + Q`` via
+    the vec trick ``(I - T⊗T) vec(P) = vec(Q)`` (m² × m² solve — m is
+    tiny, so this is a batched matmul-sized problem).
+
+    Non-stationary lanes (unit/explosive roots make ``I - T⊗T``
+    singular) fall back to a large diagonal ``fallback_scale · I`` — the
+    standard quasi-diffuse initialization — instead of poisoning the
+    batch with NaN.
+    """
+    T = jnp.asarray(T)
+    Q = jnp.asarray(Q)
+    m = T.shape[-1]
+    batch = T.shape[:-2]
+    # T ⊗ T, batched: (..., m, m, m, m) -> (..., m², m²)
+    kron = jnp.einsum("...ij,...kl->...ikjl", T, T)
+    kron = kron.reshape(*batch, m * m, m * m)
+    eye = jnp.eye(m * m, dtype=T.dtype)
+    vec_p = jnp.linalg.solve(eye - kron, Q.reshape(*batch, m * m, 1))
+    P = vec_p.reshape(*batch, m, m)
+    P = 0.5 * (P + jnp.swapaxes(P, -1, -2))     # symmetrize f-noise away
+    ok = jnp.all(jnp.isfinite(P), axis=(-1, -2), keepdims=True)
+    diffuse = fallback_scale * jnp.eye(m, dtype=T.dtype) \
+        * (1.0 + jnp.abs(jnp.einsum("...ii->...", Q))[..., None, None])
+    return jnp.where(ok, jnp.where(ok, P, 0.0), diffuse)
+
+
+def stationary_mean(T: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
+    """Batched stationary state mean ``(I - T)⁻¹ c``; non-stationary
+    lanes fall back to ``c`` itself (the zero-history prior)."""
+    T = jnp.asarray(T)
+    c = jnp.asarray(c)
+    m = T.shape[-1]
+    eye = jnp.eye(m, dtype=T.dtype)
+    mu = jnp.linalg.solve(eye - T, c[..., None])[..., 0]
+    ok = jnp.all(jnp.isfinite(mu), axis=-1, keepdims=True)
+    return jnp.where(ok, jnp.where(ok, mu, 0.0), c)
+
+
+def initial_state(ssm: StateSpace, meta: SSMeta) -> FilterState:
+    """Pre-data filter state: stationary mean/cov for ``mode="exact"``
+    (the exact-likelihood prior), zero mean and degenerate cov for
+    ``mode="innovations"`` (the converters overwrite ``a`` with the
+    model's own initial components)."""
+    S = ssm.n_series
+    m = ssm.state_dim
+    dtype = ssm.T.dtype
+    zeros = jnp.zeros((S,), dtype)
+    if meta.mode == "exact":
+        a0 = stationary_mean(ssm.T, ssm.c)
+        p0 = stationary_covariance(ssm.T, ssm.Q)
+    else:
+        a0 = jnp.zeros((S, m), dtype)
+        p0 = jnp.zeros((S, m, m), dtype)
+    return FilterState(a=a0, P=p0,
+                       ring=jnp.zeros((S, meta.d_order), dtype),
+                       loglik=zeros, ssq=zeros, sumlogf=zeros,
+                       n_obs=jnp.zeros((S,), jnp.int32))
+
+
+def state_nbytes(tree) -> int:
+    """Total bytes of the array leaves of a pytree — the
+    ``serving.state_bytes`` gauge's source."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None and hasattr(leaf, "size"):
+            nbytes = leaf.size * leaf.dtype.itemsize
+        total += int(nbytes or 0)
+    return total

@@ -47,7 +47,43 @@ __all__ = ["fit_cost_report", "representative_fit", "hlo_op_counts",
 N_XREG = 2
 
 COST_FAMILIES = ("arima", "arimax", "ar", "arx", "ewma", "garch",
-                 "argarch", "egarch", "holt_winters", "regression_arima")
+                 "argarch", "egarch", "holt_winters", "regression_arima",
+                 "serving_update")
+
+
+def _serving_update_representative(n_series: int,
+                                   dtype) -> Tuple[Callable, Tuple]:
+    """The serving tier's per-tick program: one Kalman update across a
+    panel of ARIMA(2,1,2)-shaped state-space lanes — exactly what
+    ``statespace.serving.ServingSession.update`` jits, traced from its
+    flat array leaves (the ``SSMeta`` statics closed over).  ``n_obs``
+    does not apply: the whole point of the serving tier is that a tick
+    is O(1) in history length."""
+    import jax
+
+    from ..statespace.serving import _update_impl
+    from ..statespace.ssm import FilterState, SSMeta, StateSpace
+
+    md = 3                               # max(p, q+1) for ARIMA(2,1,2)
+    meta = SSMeta("arima", "exact", 1, md)
+    s = n_series
+
+    def sd(*shape, dt=dtype):
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    import jax.numpy as jnp
+    args = (sd(s, md, md), sd(s, md), sd(s, md), sd(s), sd(s),
+            sd(s, md, md), sd(s, md),                       # StateSpace
+            sd(s, md), sd(s, md, md), sd(s, meta.d_order), sd(s), sd(s),
+            sd(s), sd(s, dt=jnp.int32),                     # FilterState
+            sd(s), sd(s))                                   # y, offset
+
+    def update(*leaves):
+        ssm = StateSpace(*leaves[:7])
+        state = FilterState(*leaves[7:14])
+        return _update_impl(meta, ssm, state, leaves[14], leaves[15])
+
+    return update, args
 
 
 def representative_fit(family: str, n_series: int, n_obs: int,
@@ -96,10 +132,15 @@ def representative_fit(family: str, n_series: int, n_obs: int,
             lambda ts, xr: m.regression_arima.fit(
                 ts, xr, "cochrane-orcutt"), (v, x)),
     }
-    if family not in table:
+    if family == "serving_update":
+        # built only on request: the classic families' reports must not
+        # depend on the statespace package importing
+        fit_fn, args = _serving_update_representative(n_series, dtype)
+    elif family in table:
+        fit_fn, args = table[family]
+    else:
         raise ValueError(f"unknown model family {family!r}; expected one "
-                         f"of {sorted(table)}")
-    fit_fn, args = table[family]
+                         f"of {sorted(table) + ['serving_update']}")
     return arrays_only(fit_fn), args
 
 

@@ -17,6 +17,15 @@ from spark_timeseries_tpu.models import arima
 from spark_timeseries_tpu.ops import pallas_arma
 from spark_timeseries_tpu.ops.optimize import minimize_least_squares
 
+# jax 0.4.37 has no jax.shard_map (it landed as a top-level API in
+# 0.4.x-later/0.6); the sharded-wrap tests cannot even build their
+# reference on this jax — skip, don't fail, until the ROADMAP item-5
+# JAX upgrade lands (the unsharded kernel tests below still run)
+requires_shard_map = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="jax.shard_map unavailable in this jax "
+           f"({jax.__version__}); sharded Pallas wrap needs it")
+
 
 def _panel(rng, S, n, phi=(0.25, 0.35), theta=(0.3, 0.1)):
     e = rng.normal(size=(S, n + 16))
@@ -234,6 +243,7 @@ def test_auto_fit_panel_forced_pallas_matches_xla(monkeypatch):
     assert np.median(dx) < 5e-3
 
 
+@requires_shard_map
 def test_forced_kernel_composes_with_shard_map(monkeypatch, mesh):
     # the documented mesh workflow: a sharded panel keeps the XLA path
     # by default, and forcing STS_PALLAS=1 INSIDE a shard_map region is
@@ -371,6 +381,7 @@ def test_route_mode_sharded_default(monkeypatch, mesh):
         big, n_valid=jnp.full((8192,), 100)) == "xla"
 
 
+@requires_shard_map
 def test_default_route_shard_map_equivalence(monkeypatch, mesh):
     # the verdict-#4 pin: shard_map-Pallas == unsharded-Pallas ==
     # unsharded-XLA through the PUBLIC fit, with fit itself choosing the
@@ -477,6 +488,7 @@ def test_route_mode_ragged(monkeypatch):
     assert pallas_arma.route_mode(y, nv) == "xla"
 
 
+@requires_shard_map
 def test_sharded_ragged_fit_matches_unsharded(monkeypatch, mesh):
     # the full routing matrix corner: a series-sharded AND NaN-padded
     # panel — fit must thread the per-lane windows through the shard_map

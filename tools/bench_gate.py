@@ -26,6 +26,8 @@ automated check (``make gate``):
   engine_cache_misses    ``metrics.engine["engine.cache_misses"]``   higher
   engine_chunk_failures  ``metrics.engine["engine.chunk_failures"]`` higher
   engine_dead_chunks     ``metrics.engine["engine.dead_chunks"]``    higher
+  serving_update_p50     ``metrics.spans["serving.update"]`` p50     higher
+  serving_update_p95     ``metrics.spans["serving.update"]`` p95     higher
   =====================  ==========================================  ======
 
   (``engine_cache_misses`` is the streaming engine's executable-cache
@@ -38,7 +40,14 @@ automated check (``make gate``):
   registry counters only materialize on first increment — so a history
   of zeros flags ANY newly nonzero round via the zero-baseline rule
   below, exactly the "a chunk silently started dying every round"
-  regression the durability tier exists to prevent.)
+  regression the durability tier exists to prevent.
+
+  ``serving_update_p50``/``p95`` are the serving tier's per-tick
+  latency SLO (ISSUE 7): the ``serving.update`` span wraps exactly one
+  cached-executable Kalman step *including* result materialization, so
+  a >25% jump over the trailing median means tick ingest itself got
+  slower — a recompile leaking into the hot path, a bucket policy
+  change, or per-tick work that stopped being O(1).)
 
 - prints a pass/fail table with signed percentage deltas and exits 1 on
   any regression, 0 otherwise.  A newest round that crashed (``rc != 0``)
@@ -77,6 +86,8 @@ METRICS = [
     ("engine_cache_misses", "lower_better", 50.0),
     ("engine_chunk_failures", "lower_better", 50.0),
     ("engine_dead_chunks", "lower_better", 50.0),
+    ("serving_update_p50", "lower_better", 25.0),
+    ("serving_update_p95", "lower_better", 25.0),
 ]
 
 
@@ -133,6 +144,19 @@ def load_history(directory: str, pattern: str = DEFAULT_GLOB
     return [load_round(p) for p in paths]
 
 
+def _leaf_span(spans: Dict[str, Any], leaf: str) -> Optional[dict]:
+    """The span entry whose path ends at ``leaf`` (exact key, or nested
+    ``".../<leaf>"``); ties go to the highest count."""
+    best = None
+    for key, val in spans.items():
+        if key != leaf and not key.endswith("/" + leaf):
+            continue
+        if isinstance(val, dict) and val.get("count"):
+            if best is None or val["count"] > best["count"]:
+                best = val
+    return best
+
+
 def extract_metrics(headline: Optional[dict]) -> Dict[str, float]:
     """The gated metric values present in one headline record.  Absent
     sources (pre-PR-1 artifacts without a metrics block) are simply
@@ -150,6 +174,18 @@ def extract_metrics(headline: Optional[dict]) -> Dict[str, float]:
             if isinstance(fit, dict) and fit.get("count"):
                 out["fit_wall_s"] = float(fit.get("p50_s",
                                                   fit.get("mean_s", 0.0)))
+            # per-tick serving latency SLO: the serving.update span is
+            # one warmed Kalman step incl. materialization; absent in
+            # rounds that predate the serving tier (no fabricated zeros).
+            # Spans nest under their enclosing scope ("a/b/serving.update"
+            # when bench drives the session), so match by path leaf —
+            # the busiest entry when several scopes ticked sessions.
+            upd = _leaf_span(spans, "serving.update")
+            if isinstance(upd, dict) and upd.get("count"):
+                if isinstance(upd.get("p50_s"), (int, float)):
+                    out["serving_update_p50"] = float(upd["p50_s"])
+                if isinstance(upd.get("p95_s"), (int, float)):
+                    out["serving_update_p95"] = float(upd["p95_s"])
         if isinstance(m.get("compile_s_total"), (int, float)):
             out["compile_s_total"] = float(m["compile_s_total"])
         if isinstance(m.get("jit_compiles"), (int, float)):
