@@ -14,8 +14,8 @@ WARMUP_SERVING ?=
 STS_COMPILE_CACHE ?=
 
 .PHONY: help verify compileall tier1 verify-faults verify-durability \
-	verify-perf verify-serving gate trace lint lint-baseline contracts \
-	verify-static warmup
+	verify-perf verify-serving verify-long gate trace lint lint-baseline \
+	contracts verify-static warmup
 
 help:
 	@echo "Targets:"
@@ -32,6 +32,8 @@ help:
 	@echo "                quarantine/backoff, OOM degradation) under every fault mode"
 	@echo "  verify-serving state-space/Kalman serving-tier suite (O(1) tick updates,"
 	@echo "                exact-likelihood ARIMA, session checkpoint/restore, 0-recompile pin)"
+	@echo "  verify-long   ultra-long-series suite (DARIMA split-and-combine: segmentation,"
+	@echo "                AR-truncation combiner, journaled segment streams, exact forecast)"
 	@echo "  verify-perf   perf gate: newest BENCH_r*.json vs trailing-median baseline"
 	@echo "  gate          same as verify-perf (tools/bench_gate.py; exit 1 on regression)"
 	@echo "  trace         run a small demo workload, write trace.json (open in ui.perfetto.dev)"
@@ -111,6 +113,16 @@ verify-durability:
 # and the zero-recompile pin on warmed per-tick updates
 verify-serving:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m serving \
+		--continue-on-collection-errors -p no:cacheprovider \
+		-p no:xdist -p no:randomly
+
+# ultra-long-series gate (ISSUE 8): the `long`-marked subset — split
+# geometry, AR(∞) truncation algebra, combiner-vs-direct-fit agreement
+# on synthetic AR(2)/ARMA(1,1), journaled/resumable segment streams,
+# and the exact forecast-origin pin against the sequential Kalman
+# filter; includes the slow 10⁶-obs end-to-end case tier-1 skips
+verify-long:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m long \
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly
 

@@ -738,6 +738,54 @@ def main():
             # failure must not void the already-measured curve
             serving_demo = {"error": f"{type(e).__name__}: {e}"}
 
+    # ultra-long demo (ISSUE 8): one 10⁶-observation synthetic ARMA
+    # series fitted end-to-end through the DARIMA split-and-combine tier
+    # — global differencing, obs-axis segmentation, segments streamed as
+    # a batch through engine.stream_fit (bucketed executables, chunk
+    # isolation), in-graph WLS combination, and one exact forecast off
+    # the affine-recurrence origin recovery.  `obs_per_s` is the tier's
+    # headline throughput; tools/bench_gate.py guards it (long_obs_per_s,
+    # 25% lower-is-regression) once two rounds carry it.
+    long_demo = None
+    if error is None and os.environ.get("BENCH_LONG", "1") == "1":
+        try:
+            from spark_timeseries_tpu import longseries
+            from spark_timeseries_tpu.ops.scan_parallel import ar1_filter
+
+            long_n = int(os.environ.get("BENCH_LONG_OBS", "1000000"))
+            rng = np.random.default_rng(11)
+            e = rng.standard_normal(long_n + 1).astype(np_dtype)
+            # ARMA(1,1): MA part vectorized, AR(1) via the associative
+            # scan (the subsystem's own O(log n) primitive)
+            x = e[1:] + np_dtype(0.4) * e[:-1]
+            series = np.asarray(ar1_filter(jnp.asarray(x), 0.1, 0.6),
+                                np_dtype)
+            with metrics.span("bench.long_demo"):
+                t0 = time.perf_counter()
+                lf = longseries.fit_long(series, order=(1, 0, 1),
+                                         warn=False)
+                fit_s = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                fc = lf.forecast(24)
+                forecast_s = time.perf_counter() - t0
+            long_demo = {
+                "n_obs": long_n,
+                "n_segments": lf.plan.n_segments,
+                "seg_len": lf.plan.seg_len,
+                "segments_weighted": lf.combined.n_weighted,
+                "used_wls": lf.combined.used_wls,
+                "coefficients_head": [round(float(v), 4) for v in
+                                      np.asarray(lf.coefficients)[:4]],
+                "sigma2": round(float(lf.sigma2), 4),
+                "fit_s": round(fit_s, 3),
+                "obs_per_s": round(lf.plan.n_used / fit_s, 1),
+                "forecast_s_incl_origin": round(forecast_s, 3),
+                "forecast_finite": bool(np.all(np.isfinite(fc))),
+            }
+        except Exception as e:  # noqa: BLE001 — optional extra; its
+            # failure must not void the already-measured curve
+            long_demo = {"error": f"{type(e).__name__}: {e}"}
+
     # compiled-program cost accounting (ISSUE 3): ask XLA what one
     # compiled fit of the benched chunk shape costs — FLOPs, bytes, peak
     # memory, HLO op mix — per family in BENCH_COST_FAMILIES (default:
@@ -852,6 +900,7 @@ def main():
         "refit_demo": refit_demo,
         "resilience_demo": resilience_demo,
         "serving_demo": serving_demo,
+        "long_demo": long_demo,
         "cost_reports": cost_reports,
         "baseline_emulation": {
             "kind": "per-series scipy Powell on the same CSS objective",

@@ -19,10 +19,10 @@ import logging as _logging
 # utils.observability.configure_logging(level).
 _logging.getLogger(__name__).addHandler(_logging.NullHandler())
 
-from . import engine, io, models, ops, parallel, stats  # noqa: F401,E402
-from . import statespace, time, utils  # noqa: F401,E402
+from . import engine, io, longseries, models, ops  # noqa: F401,E402
+from . import parallel, stats, statespace, time, utils  # noqa: F401,E402
 from .panel import Panel, lagged_pair_key, lagged_string_key  # noqa: F401
 
-__all__ = ["engine", "io", "models", "ops", "parallel", "stats",
-           "statespace", "time", "utils", "Panel", "lagged_pair_key",
-           "lagged_string_key", "__version__"]
+__all__ = ["engine", "io", "longseries", "models", "ops", "parallel",
+           "stats", "statespace", "time", "utils", "Panel",
+           "lagged_pair_key", "lagged_string_key", "__version__"]

@@ -801,6 +801,7 @@ class FitEngine:
                    donate: Optional[bool] = None,
                    collect: bool = False,
                    journal: Optional[str] = None,
+                   job_meta: Optional[Dict[str, Any]] = None,
                    deadline_s: Optional[float] = None,
                    retry=None,
                    degrade: bool = True,
@@ -839,7 +840,12 @@ class FitEngine:
           died with bitwise-identical results.  A journal written by a
           different job spec refuses to resume
           (:class:`JournalSpecMismatch`); a corrupt entry is detected,
-          moved to ``quarantine/``, and its chunk refit.
+          moved to ``quarantine/``, and its chunk refit.  ``job_meta``
+          (any JSON-serializable dict) is folded into the hashed spec —
+          callers that derive the panel from something richer (the
+          longseries tier's segmentation geometry: seg_len, overlap, d,
+          AR-truncation order) record it here so a geometry change
+          refuses resume instead of silently combining stale segments.
         - ``deadline_s`` (default: ``STS_CHUNK_DEADLINE_S``, unset =
           off): a watchdog thread arms a timer around each chunk's
           dispatch and result materialization; a chunk that outlives it
@@ -902,14 +908,24 @@ class FitEngine:
         floor = SERIES_BUCKET_FLOOR if degrade_floor is None \
             else max(1, int(degrade_floor))
 
+        if job_meta is not None:
+            import json as _json
+            try:
+                _json.dumps(job_meta)
+            except (TypeError, ValueError) as e:
+                raise ValueError(
+                    f"job_meta must be JSON-serializable (it is content-"
+                    f"hashed into the journal spec): {e}") from None
         jr = None
         if journal:
             # the job spec the journal is content-hashed against: any
             # change to what a committed chunk MEANS (family, statics,
-            # dtype, bucket policy, chunk partition, the panel's bytes)
-            # must refuse resume — same-shape different data would
-            # otherwise silently restore a previous job's results
-            jr = _durability.ChunkJournal.open(journal, {
+            # dtype, bucket policy, chunk partition, the panel's bytes,
+            # and the caller's job_meta — e.g. the longseries tier's
+            # segmentation geometry) must refuse resume — same-shape
+            # different data would otherwise silently restore a previous
+            # job's results
+            spec = {
                 "format": 1,
                 "family": family,
                 "statics": repr(statics),
@@ -919,7 +935,10 @@ class FitEngine:
                 "chunk_size": int(chunk),
                 "bucket_policy": [SERIES_BUCKET_FLOOR, OBS_BUCKET_MULTIPLE],
                 "data_sha256": _durability.array_digest(host),
-            })
+            }
+            if job_meta is not None:
+                spec["job"] = job_meta
+            jr = _durability.ChunkJournal.open(journal, spec)
         keep_models = collect or jr is not None
 
         conv = 0
@@ -1072,7 +1091,7 @@ class FitEngine:
                         "corrupt_journal", idx) is not None:
                     jr.corrupt_entry(start, stop)
             if collect:
-                collected[start] = model
+                collected[start] = (stop, model)
 
         def _failure_kind(e: Exception) -> str:
             if isinstance(e, ChunkDeadlineExceeded):
@@ -1201,7 +1220,8 @@ class FitEngine:
             for pmeta, model in loaded:
                 conv += int(pmeta.get("n_conv", 0))
                 if collect:
-                    collected[int(pmeta["start"])] = model
+                    collected[int(pmeta["start"])] = (int(pmeta["stop"]),
+                                                      model)
             # one hit per restored CHUNK (a degraded chunk's sub-entry
             # tiling is still one chunk skipped), so journal_hits +
             # journal_commits + dead data/quarantine chunks reconcile
@@ -1295,8 +1315,18 @@ class FitEngine:
         }
         if jr is not None:
             stats["journal_path"] = jr.path
-        models = [collected[k] for k in sorted(collected)] if collect \
-            else None
+        models = None
+        if collect:
+            # models come back with their row ranges (stats
+            # "collected_ranges", aligned index-for-index with the models
+            # list), so a consumer can place each pytree against the
+            # source rows even when failed chunks leave gaps or a
+            # degraded chunk contributes several sub-range models — the
+            # longseries tier aligns per-segment coefficients this way
+            keys = sorted(collected)
+            models = [collected[k][1] for k in keys]
+            stats["collected_ranges"] = [[int(k), int(collected[k][0])]
+                                         for k in keys]
         return StreamResult(n_series, max(n_series - dead_series, 0), conv,
                             wall, len(partition), failures, models,
                             stats)

@@ -1,0 +1,35 @@
+"""Ultra-long series tier: DARIMA split-and-combine (ROADMAP item 2).
+
+A single series with 10⁶–10⁸ observations (telemetry, tick data) cannot
+be fitted by any batch path — the CSS MA recursion is sequential in t
+and every engine tier scales the *series* axis only.  This subsystem
+opens that workload class by changing the axis (PAPERS.md "Distributed
+ARIMA Models for Ultra-long Time Series", arXiv 2007.09577):
+
+- :mod:`split` — partition the obs axis into contiguous (optionally
+  overlapping) windows and reshape them into an ``(n_segments, window)``
+  panel, so segments stream through ``engine.stream_fit`` unchanged —
+  bucketed executables, donation, journal/resume, deadlines, and
+  OOM-adaptive halving all apply to the obs axis for free;
+- :mod:`combine` — the DARIMA combiner: map each segment's ARMA estimate
+  into the common truncated-AR(∞) space
+  (``models.arima.ar_truncation``), then combine with inverse-covariance
+  (design-gram WLS) weights, in-graph per chunk of segments;
+- :mod:`api` — :func:`fit_long` plus exact forecasting: the combined
+  model converts via ``statespace.to_statespace`` and the forecast-
+  origin filter state over the FULL series is recovered through
+  ``ops.scan_parallel.affine_recurrence`` in O(log chunk) depth
+  (``statespace.kalman.filter_forecast_origin``), so ``forecast(h)`` is
+  exact, not segment-local.
+
+See docs/design.md §8.
+"""
+
+from . import api, combine, split  # noqa: F401
+from .api import LongSeriesFit, fit_long  # noqa: F401
+from .combine import CombinedResult, combine_segments  # noqa: F401
+from .split import segment_panel, segment_plan, tail_ring  # noqa: F401
+
+__all__ = ["api", "combine", "split", "fit_long", "LongSeriesFit",
+           "combine_segments", "CombinedResult", "segment_panel",
+           "segment_plan", "tail_ring"]

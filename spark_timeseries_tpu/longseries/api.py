@@ -1,0 +1,393 @@
+"""`fit_long`: the ultra-long-series front door.
+
+One call turns a single 10⁶–10⁸-observation series into work the
+existing machinery already knows how to do, end to end:
+
+1. **difference globally** (``split.difference`` — one common ``d``, so
+   every segment estimates a pure ARMA in one parameter space);
+2. **split the obs axis** (``split.segment_panel`` via
+   ``stats.segment_plan``) into an ``(n_segments, window)`` panel;
+3. **fit segments as a batch** — either through
+   ``engine.stream_fit`` (chunked, shape-bucketed executables, buffer
+   donation, crash-consistent journal + resume, per-chunk deadlines,
+   quarantine/backoff retries, OOM-adaptive halving: the whole
+   durability tier applies to the obs axis for free) or, with
+   ``auto=True``, through ``models.arima.auto_fit_panel`` (per-segment
+   (p, q) order selection in one fused dispatch — DARIMA's
+   heterogeneous-order mode);
+4. **combine by WLS** in the common AR-truncation space
+   (``longseries.combine`` — in-graph per chunk of segments);
+5. **forecast exactly** — the combined AR model converts through
+   ``statespace.to_statespace`` and the forecast-origin filter state
+   over the FULL series is recovered in O(log chunk) depth by
+   ``statespace.kalman.filter_forecast_origin``
+   (``ops.scan_parallel.affine_recurrence``), so
+   :meth:`LongSeriesFit.forecast` agrees with the sequential Kalman
+   filter run over every observation — not a segment-local
+   approximation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..stats import SegmentPlan, segment_plan
+from ..utils import metrics as _metrics
+from . import combine as _combine
+from . import split as _split
+
+__all__ = ["fit_long", "LongSeriesFit"]
+
+# default AR-truncation length when the order carries an MA part: the
+# tail decays at the MA root rate, so 12 terms put the truncation error
+# below f32 resolution for |θ| ≲ 0.4 and below statistical noise for
+# anything invertible; pure-AR orders map exactly at n_ar = p
+DEFAULT_MA_TRUNCATION = 12
+
+# segments per streamed chunk: big enough to amortize dispatch, small
+# enough that chunk × window stays a few hundred MB at 10⁶-obs scale
+DEFAULT_CHUNK_SEGMENTS = 512
+
+
+class LongSeriesFit:
+    """A combined ultra-long fit: the global AR model, the split
+    geometry, per-segment accounting, and exact forecasting.
+
+    ``model`` is a standard
+    :class:`~spark_timeseries_tpu.models.arima.ARIMAModel` —
+    AR(``n_ar``) with the original ``d`` — so everything a fitted model
+    can do (likelihoods, statespace conversion, serving sessions) works
+    on the combined estimate unchanged.
+    """
+
+    def __init__(self, model, plan: SegmentPlan,
+                 combined: _combine.CombinedResult,
+                 diffed: np.ndarray, ring: np.ndarray,
+                 stream_stats: Optional[Dict[str, Any]] = None,
+                 segment_orders: Optional[np.ndarray] = None,
+                 warm: int = 512, origin_chunk: int = 65536):
+        self.model = model
+        self.plan = plan
+        self.combined = combined
+        self.sigma2 = combined.sigma2
+        self.stream_stats = stream_stats
+        self.segment_orders = segment_orders
+        self._diffed = diffed
+        self._dtype = diffed.dtype
+        self._ring = ring
+        self._warm = int(warm)
+        self._origin_chunk = int(origin_chunk)
+        self._origin_cache = None
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def coefficients(self):
+        return self.model.coefficients
+
+    @property
+    def diagnostics(self):
+        return self.model.diagnostics
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "order": (self.model.p, self.model.d, self.model.q),
+            "n_obs": int(self.plan.head_drop + self.plan.n_used
+                         + self.model.d),
+            "n_segments": self.plan.n_segments,
+            "seg_len": self.plan.seg_len,
+            "overlap": self.plan.overlap,
+            "head_drop": self.plan.head_drop,
+            "segments_weighted": self.combined.n_weighted,
+            "segments_finite": self.combined.n_finite,
+            "segments_converged": self.combined.n_converged,
+            "used_wls": self.combined.used_wls,
+            "sigma2": self.sigma2,
+        }
+
+    # -- exact forecasting --------------------------------------------------
+
+    def forecast_origin(self):
+        """The exact forecast-origin
+        :class:`~spark_timeseries_tpu.statespace.ssm.FilterState` of the
+        combined model over the **full** differenced series — recovered
+        once (cached) via
+        :func:`~spark_timeseries_tpu.statespace.kalman.filter_forecast_origin`:
+        a short sequential covariance burn-in, then pinned-gain
+        ``affine_recurrence`` chunks in O(log chunk) depth.  Its ``a`` is
+        the one-step-predicted state the next tick would filter against;
+        its ``ring`` already holds the raw-difference seeds, so the state
+        is forecast-ready on the raw scale."""
+        if self._origin_cache is not None:
+            return self._origin_cache
+        import jax.numpy as jnp
+
+        from ..statespace import to_statespace
+        from ..statespace.kalman import filter_forecast_origin
+        from ..statespace.ssm import SSMeta, initial_state
+
+        ssm, meta = to_statespace(self.model)
+        meta0 = SSMeta(meta.family, meta.mode, 0, meta.m)
+        state0 = initial_state(ssm, meta0)
+        with _metrics.span("longseries.forecast_origin"):
+            origin = filter_forecast_origin(
+                ssm, state0, self._diffed[None, :], meta0,
+                warm=self._warm, chunk=self._origin_chunk)
+        origin = origin._replace(ring=jnp.asarray(self._ring[None, :]))
+        self._origin_cache = (ssm, meta, origin)
+        # the differenced series is only needed to recover the origin;
+        # at this tier's own scale (10⁶–10⁸ obs) keeping it alive would
+        # double the fit handle's resident memory for nothing
+        self._diffed = None
+        return self._origin_cache
+
+    def forecast(self, horizon: int) -> np.ndarray:
+        """``(horizon,)`` point forecasts from the exact forecast-origin
+        state — mean propagation with zero future innovations, integrated
+        through the raw-difference ring (the same compiled program
+        serving sessions use).  Exact, not segment-local: the origin
+        state conditions on every observation in the series."""
+        horizon = int(horizon)
+        if horizon < 1:
+            raise ValueError("forecast needs horizon >= 1")
+        import jax.numpy as jnp
+
+        from ..statespace.serving import _jitted
+
+        ssm, meta, origin = self.forecast_origin()
+        offs = jnp.zeros((1, horizon), self._dtype)
+        with _metrics.span("longseries.forecast"):
+            out = np.asarray(_jitted("forecast")(meta, horizon, ssm,
+                                                 origin, offs))
+        return out[0]
+
+    @property
+    def loglik(self) -> float:
+        """Exact σ²-concentrated Gaussian log-likelihood of the combined
+        model over the differenced series (a by-product of the origin
+        recovery).  The filter runs at unit noise scale — `to_statespace`
+        builds the SSM uncalibrated — so the raw accumulated loglik is
+        NOT the model likelihood; σ² is profiled out in closed form from
+        the carried (ssq, sumlogf, n_obs) instead
+        (``kalman.concentrated_loglik``), the same convention as
+        ``ARIMAModel.log_likelihood_exact`` (pinned by test)."""
+        from ..statespace.kalman import concentrated_loglik
+
+        _, _, origin = self.forecast_origin()
+        return float(concentrated_loglik(origin)[0])
+
+    def __repr__(self) -> str:
+        return (f"LongSeriesFit(AR({self.model.p}), d={self.model.d}, "
+                f"segments={self.plan.n_segments}x{self.plan.window}, "
+                f"weighted={self.combined.n_weighted})")
+
+
+def _collect_segment_coefs(result, n_segments: int, dim: int,
+                           dtype) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-segment coefficient rows + converged flags from a
+    ``StreamResult``, aligned through ``stats["collected_ranges"]`` —
+    failed chunks leave NaN rows (weight 0 in the combiner), degraded
+    chunks contribute per-sub-range models."""
+    coefs = np.full((n_segments, dim), np.nan, dtype)
+    conv = np.zeros((n_segments,), bool)
+    ranges = result.stats.get("collected_ranges") or []
+    for (start, stop), model in zip(ranges, result.models):
+        rows = np.asarray(model.coefficients, dtype).reshape(-1, dim)
+        coefs[start:stop] = rows
+        diag = model.diagnostics
+        if diag is not None:
+            conv[start:stop] = np.asarray(diag.converged).reshape(-1)
+    return coefs, conv
+
+
+def fit_long(ts, order: Tuple[int, int, int] = (2, 1, 2),
+             auto: bool = False, *,
+             seg_len: Optional[int] = None, overlap: int = 0,
+             n_ar: Optional[int] = None,
+             max_p: int = 5, max_q: int = 5,
+             engine=None, chunk_segments: int = DEFAULT_CHUNK_SEGMENTS,
+             journal: Optional[str] = None,
+             deadline_s: Optional[float] = None,
+             chunk_retry=None, degrade: bool = True,
+             combine_chunk: int = 256,
+             warm: int = 512, origin_chunk: int = 65536,
+             **fit_kwargs) -> LongSeriesFit:
+    """Fit one ultra-long series by DARIMA split-and-combine.
+
+    ``ts (n,)`` — a single fully-observed series (NaNs raise: impute
+    first; the series axis is what this tier refuses to be bound by, not
+    data quality).  ``order = (p, d, q)``: ``d`` is applied globally
+    before splitting; segments fit ARMA(p, q).  With ``auto=True`` each
+    segment instead selects its own (p, q) ≤ (``max_p``, ``max_q``) via
+    the fused ``auto_fit_panel`` grid — heterogeneous orders combine
+    fine because combination happens in the common AR-truncation space.
+
+    Split geometry: ``seg_len``/``overlap`` feed
+    :func:`~spark_timeseries_tpu.stats.segment_plan` (default: power of
+    two near ``8·sqrt(n)``).  ``n_ar`` is the AR-truncation length of
+    the combined model (default: ``p`` for pure-AR orders — exact — else
+    ``max(p + q, 12)``).
+
+    Streaming knobs (rejected under ``auto=True``, which is one fused
+    dispatch that never touches ``stream_fit`` — a journal that will
+    never commit must fail loudly, not at the post-crash resume):
+    ``engine`` (a
+    :class:`~spark_timeseries_tpu.engine.FitEngine`; default the process
+    engine), ``chunk_segments`` segments per streamed chunk,
+    ``journal=path`` for crash-consistent per-chunk commits + validated
+    resume (the journal spec content-hashes the segmentation geometry
+    via ``job_meta``, so a changed split refuses resume),
+    ``deadline_s``/``chunk_retry``/``degrade`` the engine's per-chunk
+    watchdog / quarantine-retry / OOM-halving controls.  ``fit_kwargs``
+    (``method``, ``max_iter``, ``include_intercept``) pass through to
+    the per-segment ``arima.fit``; the *optimizer* multi-start
+    ``retry=`` is not routable here (``stream_fit`` reserves the name
+    for chunk-level retries — ``chunk_retry`` is this tier's failure
+    recovery, and a failed segment already combines at weight zero).
+
+    Returns a :class:`LongSeriesFit` whose ``model`` is the combined
+    AR(``n_ar``) :class:`~spark_timeseries_tpu.models.arima.ARIMAModel`
+    (original ``d`` reattached) and whose :meth:`~LongSeriesFit.forecast`
+    is exact over the full series.
+    """
+    host = np.asarray(ts)
+    if host.ndim != 1:
+        raise ValueError(
+            f"fit_long fits ONE ultra-long series, got shape "
+            f"{host.shape}; for panels of normal-length series use "
+            f"engine.stream_fit / fit_panel")
+    if not np.issubdtype(host.dtype, np.floating):
+        host = host.astype(np.float32)
+    if np.isnan(host).any():
+        raise ValueError(
+            "fit_long needs a fully-observed series; impute missing "
+            "ticks first (Panel.fill / ops.fill_ts) — the segment "
+            "combiner and the exact forecast-origin recovery both "
+            "assume dense observations")
+    p, d, q = (int(v) for v in order)
+    if "retry" in fit_kwargs:
+        raise ValueError(
+            "fit_long does not take retry=: stream_fit reserves the "
+            "name for chunk-level quarantine retries (pass chunk_retry=)"
+            "; per-segment optimizer restarts are not routable through "
+            "the streamed path — a failed segment combines at weight "
+            "zero instead")
+    warn = bool(fit_kwargs.pop("warn", True))
+    include_intercept = bool(fit_kwargs.get("include_intercept", True))
+    icpt = 1 if include_intercept else 0
+
+    reg = _metrics.get_registry()
+    with _metrics.span("longseries.fit_long"):
+        diffed = _split.difference(host, d)
+        plan = segment_plan(diffed.size, p if not auto else max_p,
+                            q if not auto else max_q,
+                            seg_len=seg_len, overlap=overlap)
+        panel = _split.segment_panel(diffed, plan)
+        K = plan.n_segments
+
+        segment_orders = None
+        stream_stats = None
+        if auto:
+            import jax.numpy as jnp
+
+            from ..models.arima import auto_fit_panel
+            bad_kw = set(fit_kwargs) - {"max_iter", "screen_max_iter"}
+            if bad_kw:
+                raise ValueError(
+                    f"auto=True routes segments through auto_fit_panel, "
+                    f"which takes only max_iter/screen_max_iter; got "
+                    f"{sorted(bad_kw)} (the grid always fits with an "
+                    f"intercept and its own optimizer config)")
+            # the auto path is one fused dispatch that never touches
+            # stream_fit: a streaming knob would be silently dead — in
+            # particular a journal that never commits must fail loudly
+            # now, not at the post-crash resume that finds nothing
+            dead = [name for name, on in (
+                ("journal", journal is not None),
+                ("deadline_s", deadline_s is not None),
+                ("chunk_retry", chunk_retry is not None),
+                ("engine", engine is not None),
+                ("degrade", degrade is not True),
+                ("chunk_segments",
+                 chunk_segments != DEFAULT_CHUNK_SEGMENTS)) if on]
+            if dead:
+                raise ValueError(
+                    f"auto=True fits every segment in one fused "
+                    f"auto_fit_panel dispatch; the streaming knobs "
+                    f"{dead} have no effect there — drop them or use "
+                    f"auto=False")
+            # one fused dispatch: per-segment (p, q) selection on the
+            # already-differenced panel (max_d=0 — d is global here)
+            pf = auto_fit_panel(jnp.asarray(panel), max_p=max_p, max_d=0,
+                                max_q=max_q, **fit_kwargs)
+            cp, cq, c_icpt = max_p, max_q, True
+            coefs = np.array(pf.coefficients, panel.dtype)
+            conv = np.isfinite(np.asarray(pf.aic))
+            # no-admissible-candidate lanes come back with aic=+inf but
+            # ZERO coefficients — finite, so the gram weighting would
+            # count them at full weight and drag the combination toward
+            # zero; NaN them so the combiner's ok-mask drops them like
+            # the stream path's failed chunks
+            coefs[~conv] = np.nan
+            segment_orders = pf.orders
+        else:
+            from ..engine import default_engine
+            eng = engine if engine is not None else default_engine()
+            cp, cq, c_icpt = p, q, include_intercept
+            meta = {"tier": "longseries",
+                    "order": [p, d, q],
+                    "seg_len": plan.seg_len,
+                    "overlap": plan.overlap,
+                    "head_drop": plan.head_drop}
+            result = eng.stream_fit(
+                panel, "arima", chunk_size=int(chunk_segments),
+                collect=True, journal=journal, job_meta=meta,
+                deadline_s=deadline_s, retry=chunk_retry,
+                degrade=degrade, p=p, d=0, q=q, **fit_kwargs)
+            stream_stats = dict(result.stats)
+            stream_stats["n_chunks"] = result.n_chunks
+            stream_stats["chunk_failures"] = len(result.chunk_failures)
+            coefs, conv = _collect_segment_coefs(
+                result, K, icpt + p + q, panel.dtype)
+
+        if n_ar is None:
+            if auto:
+                n_ar = max(max_p + max_q, DEFAULT_MA_TRUNCATION)
+            else:
+                n_ar = p if q == 0 else max(p + q, DEFAULT_MA_TRUNCATION)
+        n_ar = int(n_ar)
+
+        combined = _combine.combine_segments(
+            panel, coefs, conv, p=cp, q=cq,
+            include_intercept=bool(c_icpt), n_ar=n_ar,
+            overlap=plan.overlap, chunk_segments=int(combine_chunk))
+
+        import jax.numpy as jnp
+
+        from ..models.arima import ARIMAModel
+        from ..models.base import FitDiagnostics
+        n_w = combined.n_weighted
+        diags = FitDiagnostics(
+            converged=jnp.asarray(n_w > 0
+                                  and 2 * combined.n_converged > n_w),
+            n_iter=jnp.asarray(0, jnp.int32),
+            fun=jnp.asarray(combined.sigma2, panel.dtype))
+        model = ARIMAModel(n_ar, d, 0,
+                           jnp.asarray(combined.coefficients),
+                           bool(c_icpt), diagnostics=diags)
+        reg.inc("longseries.fits")
+        reg.inc("longseries.segments", K)
+        reg.set_gauge("longseries.last_n_obs", float(host.size))
+    _warn(model, warn)
+    return LongSeriesFit(model, plan, combined, diffed,
+                         _split.tail_ring(host, d),
+                         stream_stats=stream_stats,
+                         segment_orders=segment_orders,
+                         warm=warm, origin_chunk=origin_chunk)
+
+
+def _warn(model, warn: bool) -> None:
+    from ..models.arima import _warn_stationarity_invertibility
+    _warn_stationarity_invertibility(model, bool(warn))

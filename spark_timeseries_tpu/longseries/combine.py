@@ -1,0 +1,213 @@
+"""The DARIMA combiner: segment estimates → one global model, by WLS.
+
+Per-segment ARMA estimates live in incompatible parameter spaces the
+moment segments choose different orders (the ``auto`` path) — and even
+at a common order, averaging raw ``(φ, θ)`` ignores how unequally
+segments determine them.  DARIMA's answer (PAPERS.md, arXiv 2007.09577;
+the DLSA scheme) is adopted here in two moves, both **in-graph**:
+
+1. **Common space** — every segment's ``(c, φ, θ)`` maps to its
+   truncated AR(∞) representation ``(c_π, π₁..π_{n_ar})``
+   (:func:`~spark_timeseries_tpu.models.arima.ar_truncation`; the
+   mapping is exact for pure AR and geometric-tail-accurate for
+   invertible MA parts), so heterogeneous segment orders become
+   comparable coordinates of one linear model
+   ``y_t = c_π + Σ π_j y_{t-j} + e_t``.
+2. **Inverse-covariance weights** — in that linear model the segment
+   estimator's asymptotic precision is its design information
+   ``X_kᵀX_k / σ̂²_k`` (``X_k`` the segment's lag design, ``σ̂²_k`` its
+   AR-residual variance), so the weighted-least-squares combination
+
+       θ* = (Σ_k X_kᵀX_k/σ̂²_k)⁻¹ Σ_k (X_kᵀX_k/σ̂²_k) θ_k
+
+   is one tiny SPD solve after a sum of per-segment gram products.
+
+Everything per-segment is one jitted program over a *chunk* of segments
+(:func:`_combine_chunk_impl` — the ``long_combine`` cost/contract
+family): the host only crosses between chunks, accumulating the ``(D,D)``
+information sum and ``(D,)`` weighted-estimate sum, then performs one
+final ridge-guarded solve.  Segments with non-finite estimates, grams,
+or variances get weight zero; if nothing is weightable the result falls
+back to the plain mean of finite segment estimates, mirroring
+``arima.fit_long``'s quarantine-to-init behavior.
+
+Overlapping windows (``split.segment_panel`` with ``overlap > 0``)
+double-cover ``overlap`` observations per boundary; the ``burn`` static
+(``max(n_ar, overlap)``) zero-weights each window's leading rows so
+every observation contributes to exactly one segment's gram.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from ..utils import metrics as _metrics
+
+__all__ = ["combine_segments", "CombinedResult"]
+
+
+class CombinedResult(NamedTuple):
+    """Outcome of one WLS combination.
+
+    ``coefficients (D,)`` in the fit layout ``[c_π?, π₁..π_{n_ar}]``;
+    ``sigma2`` the ok-segment mean AR-residual variance (the combined
+    model's innovation-variance estimate); ``used_wls`` False when no
+    segment was weightable and the mean-of-finite fallback produced the
+    coefficients."""
+    coefficients: np.ndarray
+    sigma2: float
+    n_segments: int
+    n_finite: int
+    n_weighted: int
+    n_converged: int
+    used_wls: bool
+
+
+def _combine_chunk_impl(segs, coefs, conv, p: int, q: int, icpt: int,
+                        n_ar: int, burn: int):
+    """One chunk of segments → its summed combination pieces.
+
+    ``segs (K, L)`` segment windows, ``coefs (K, icpt+p+q)`` per-segment
+    ARMA estimates (NaN rows = failed segments), ``conv (K,)`` their
+    converged flags.  Statics: the common order layout, the AR-truncation
+    length, and the burn-in row count (``max(n_ar, overlap)`` — also
+    de-duplicates overlapped observations).  Returns per-chunk sums:
+    ``(A (D,D), b (D,), n_ok, theta_sum (D,), n_finite, sigma2_sum,
+    n_conv)``.  Fully traced — no host callbacks, no value-dependent
+    branching — so the whole combination is ``n_chunks`` dispatches.
+    """
+    import jax.numpy as jnp
+
+    from ..models.arima import _split_params, ar_truncation
+    from ..ops.lag import lag_stack
+
+    dtype = segs.dtype
+    K, L = segs.shape
+    D = icpt + n_ar
+    c, phi, theta = _split_params(coefs, p, q, icpt)
+    c_pi, pi = ar_truncation(c, phi, theta, n_ar)            # (K,), (K,n_ar)
+    if icpt:
+        th = jnp.concatenate([c_pi[:, None], pi], axis=-1)   # (K, D)
+    else:
+        th = pi
+
+    X = lag_stack(segs, n_ar)                                # (K, n_ar, R)
+    rows = L - n_ar
+    if icpt:
+        X = jnp.concatenate([jnp.ones((K, 1, rows), dtype), X], axis=-2)
+    y_t = segs[..., n_ar:]
+    # row r targets window index n_ar + r; burn rows carry weight 0 (the
+    # 0/1 weights square to themselves, so weighting one gram side is
+    # exact — the ols_gram rule)
+    w = ((n_ar + jnp.arange(rows)) >= burn).astype(dtype)    # (R,)
+    Xw = X * w[None, None, :]
+    G = jnp.einsum("kpn,kqn->kpq", Xw, X)                    # (K, D, D)
+    resid = (y_t - jnp.einsum("kpn,kp->kn", X, th)) * w[None, :]
+    n_live = jnp.sum(w)
+    dof = jnp.maximum(n_live - D, 1.0)
+    sigma2 = jnp.sum(resid * resid, axis=-1) / dof           # (K,)
+
+    finite = jnp.all(jnp.isfinite(th), axis=-1)
+    ok = (finite & jnp.isfinite(sigma2) & (sigma2 > 0)
+          & jnp.all(jnp.isfinite(G), axis=(-2, -1)))
+    # zero unusable segments with where (NaN·0 is NaN — a poisoned
+    # segment must not leak through the sums)
+    Wk = jnp.where(ok[:, None, None],
+                   G / jnp.where(ok, sigma2, 1.0)[:, None, None], 0.0)
+    th_ok = jnp.where(ok[:, None], th, 0.0)
+    A = jnp.sum(Wk, axis=0)
+    b = jnp.sum(jnp.einsum("kpq,kq->kp", Wk, th_ok), axis=0)
+    theta_sum = jnp.sum(jnp.where(finite[:, None], th, 0.0), axis=0)
+    sig_sum = jnp.sum(jnp.where(ok, sigma2, 0.0))
+    n_conv = jnp.sum(ok & jnp.asarray(conv))
+    return (A, b, jnp.sum(ok), theta_sum, jnp.sum(finite), sig_sum,
+            n_conv)
+
+
+# module-level jit (STS006): every chunk of every combination shares one
+# function object, so same-shape chunks hit the jit cache
+def _jitted_chunk():
+    import jax
+
+    fn = _jitted_chunk.__dict__.get("fn")
+    if fn is None:
+        fn = jax.jit(_combine_chunk_impl, static_argnums=(3, 4, 5, 6, 7))
+        _jitted_chunk.fn = fn
+    return fn
+
+
+def combine_segments(segs: np.ndarray, coefs: np.ndarray,
+                     converged: Optional[np.ndarray] = None, *,
+                     p: int, q: int, include_intercept: bool = True,
+                     n_ar: int, overlap: int = 0,
+                     chunk_segments: int = 256,
+                     ridge: float = 1e-8) -> CombinedResult:
+    """Combine per-segment ARMA estimates into one global AR(``n_ar``)
+    model by design-gram WLS (module docstring has the algebra).
+
+    ``segs (K, L)`` the segment panel (``split.segment_panel``), ``coefs
+    (K, icpt+p+q)`` per-segment estimates in the fit layout (NaN rows =
+    dead segments — weight 0), ``converged (K,)`` optional per-segment
+    convergence flags (reporting only).  ``chunk_segments`` bounds how
+    many segments one jitted accumulation dispatch sees — the only
+    host crossings are between chunks.
+    """
+    segs = np.asarray(segs)
+    coefs = np.asarray(coefs, segs.dtype)
+    K, L = segs.shape
+    if coefs.shape[0] != K:
+        raise ValueError(
+            f"{coefs.shape[0]} coefficient rows for {K} segments")
+    icpt = 1 if include_intercept else 0
+    n_ar = int(n_ar)
+    if L <= max(n_ar, overlap) + n_ar + icpt:
+        raise ValueError(
+            f"segment window {L} too short for an AR({n_ar}) design "
+            f"with burn-in {max(n_ar, overlap)}")
+    conv = np.ones((K,), bool) if converged is None \
+        else np.asarray(converged, bool).reshape(K)
+    burn = max(n_ar, int(overlap))
+    D = icpt + n_ar
+    fn = _jitted_chunk()
+
+    # host-side accumulators in f64: chunk sums arrive in the panel
+    # dtype, but the cross-chunk reduction is host arithmetic
+    A = np.zeros((D, D), np.float64)
+    b = np.zeros((D,), np.float64)
+    theta_sum = np.zeros((D,), np.float64)
+    n_ok = n_finite = n_conv = 0
+    sig_sum = 0.0
+    step = max(1, int(chunk_segments))
+    with _metrics.span("longseries.combine"):
+        for s in range(0, K, step):
+            part = segs[s:s + step]
+            out = fn(part, coefs[s:s + step], conv[s:s + step],
+                     int(p), int(q), icpt, n_ar, burn)
+            A += np.asarray(out[0], np.float64)
+            b += np.asarray(out[1], np.float64)
+            n_ok += int(out[2])
+            theta_sum += np.asarray(out[3], np.float64)
+            n_finite += int(out[4])
+            sig_sum += float(out[5])
+            n_conv += int(out[6])
+
+    used_wls = False
+    combined = np.zeros((D,), np.float64)
+    if n_ok:
+        scale = max(float(np.max(np.abs(np.diag(A)))), 1.0)
+        solved = np.linalg.solve(A + ridge * scale * np.eye(D), b)
+        if np.all(np.isfinite(solved)):
+            combined = solved
+            used_wls = True
+    if not used_wls and n_finite:
+        combined = theta_sum / n_finite
+    sigma2 = sig_sum / n_ok if n_ok else float("nan")
+    reg = _metrics.get_registry()
+    reg.inc("longseries.segments_combined", n_ok)
+    reg.inc("longseries.segments_dropped", K - n_ok)
+    return CombinedResult(
+        coefficients=combined.astype(segs.dtype),
+        sigma2=sigma2, n_segments=K, n_finite=n_finite,
+        n_weighted=n_ok, n_converged=n_conv, used_wls=used_wls)

@@ -442,6 +442,64 @@ def _psi_half_widths(params: jnp.ndarray, ts: jnp.ndarray, h: int,
     return normal_quantile(conf, ts.dtype) * jnp.sqrt(var_h)
 
 
+def ar_truncation(c: jnp.ndarray, phi: jnp.ndarray, theta: jnp.ndarray,
+                  n_terms: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Truncated AR(∞) representation of a (batched) ARMA.
+
+    With the fit's sign conventions (``y_t = c + Σφ_i y_{t-i} + e_t +
+    Σθ_i e_{t-i}``), the AR polynomial ``Π(B) = φ(B)/θ(B) = 1 - Σπ_j Bʲ``
+    satisfies ``φ(B) = Π(B)θ(B)``; matching coefficients of ``Bᵏ`` gives
+    the recursion
+
+        π_k = φ_k + θ_k - Σ_{i=1..min(k-1, q)} θ_i π_{k-i}
+
+    (taps beyond the order are zero), and the AR-form intercept is
+    ``c_pi = c / θ(1) = c / (1 + Σθ_i)`` (both forms share the process
+    mean ``μ = c/φ(1) = c_pi/Π(1)``).  Truncation error decays at the MA
+    root rate, so an invertible model's tail is geometric — the mapping
+    every DARIMA segment estimate goes through before combination.
+
+    ``phi (..., p)``, ``theta (..., q)``, ``c (...)``; returns
+    ``(c_pi (...), pi (..., n_terms))``.  Fully traced (a ``lax.scan``
+    with a length-``q`` ring carry), batched over leading dims.
+    """
+    phi = jnp.asarray(phi)
+    theta = jnp.asarray(theta)
+    dtype = phi.dtype
+    c = jnp.asarray(c, dtype)
+    batch = phi.shape[:-1]
+    p, q = phi.shape[-1], theta.shape[-1]
+    n_terms = int(n_terms)
+    if n_terms < 1:
+        raise ValueError(f"ar_truncation needs n_terms >= 1, got {n_terms}")
+
+    def taps(x, k):
+        if k >= n_terms:
+            return x[..., :n_terms]
+        return jnp.concatenate(
+            [x, jnp.zeros((*batch, n_terms - k), dtype)], axis=-1)
+
+    phi_ext = taps(phi, p)
+    c_pi = c / (1.0 + jnp.sum(theta, axis=-1))
+    if q == 0:
+        return c_pi, phi_ext
+    th_ext = taps(theta, q)
+
+    def step(ring, inp):
+        # ring is newest-first: π_{k-1} .. π_{k-q} (zeros for k-i < 1)
+        ph_k, th_k = inp
+        pi_k = ph_k + th_k - jnp.einsum("...q,...q->...", theta, ring)
+        ring = jnp.concatenate([pi_k[..., None], ring[..., :-1]], axis=-1)
+        return ring, pi_k
+
+    ring0 = jnp.zeros((*batch, q), dtype)
+    _, pis = lax.scan(step, ring0,
+                      (jnp.moveaxis(phi_ext, -1, 0),
+                       jnp.moveaxis(th_ext, -1, 0)),
+                      unroll=scan_unroll())
+    return c_pi, jnp.moveaxis(pis, 0, -1)
+
+
 def _batched(fn_one, params: jnp.ndarray, ts: jnp.ndarray, *args):
     """vmap ``fn_one(params_1d, ts_1d, *args)`` over an optional shared
     leading batch dim of ``params`` / ``ts``."""
@@ -735,6 +793,50 @@ class ARIMAModel(NamedTuple):
         (ref ``ARIMA.scala:826-830``)."""
         ll = self.log_likelihood_css(ts)
         return -2.0 * ll + 2.0 * (self.p + self.q + self._icpt)
+
+    # -- distributed-combination exports (the longseries tier) --------------
+
+    def ar_inf_coefficients(self, n_terms: int) -> Tuple[jnp.ndarray,
+                                                         jnp.ndarray]:
+        """The model's AR(∞) representation truncated at ``n_terms``:
+        ``(c_pi, pi)`` with ``pi (..., n_terms)`` such that
+
+            y_t ≈ c_pi + Σ_{j=1..n_terms} pi_j · y_{t-j} + e_t
+
+        on the d-times-differenced scale (``d`` is not expanded here —
+        the AR form lives where the ARMA does).  This is the common
+        coefficient space the DARIMA combiner
+        (``longseries.combine``) maps every segment estimate into; see
+        :func:`ar_truncation`."""
+        coefs = jnp.asarray(self.coefficients)
+        phi = coefs[..., self._icpt:self._icpt + self.p]
+        theta = coefs[..., self._icpt + self.p:self._icpt + self.p + self.q]
+        c = self.intercept
+        return ar_truncation(c, phi, theta, n_terms)
+
+    def coefficient_precision(self, ts: jnp.ndarray,
+                              assume_differenced: bool = False
+                              ) -> jnp.ndarray:
+        """Observed-information export: the (batched) Hessian of the
+        negative CSS log-likelihood at the fitted coefficients — the
+        asymptotic precision (inverse covariance) of the CSS estimator,
+        which is what inverse-covariance combination schemes
+        (``fit_long``, the DARIMA combiner) weight by.
+
+        ``ts`` the series the model was fitted on (``(n,)`` or matching
+        batch); ``assume_differenced=True`` skips the order-``d``
+        differencing when ``ts`` is already on the ARMA scale.  Returns
+        ``(..., k, k)`` with ``k = icpt + p + q``."""
+        y = jnp.asarray(ts)
+        if not assume_differenced:
+            y = differences_of_order_d(y, self.d)[..., self.d:]
+        p, q, icpt = self.p, self.q, self._icpt
+
+        def neg_ll(prm, yy):
+            return -_log_likelihood_css_arma(prm, yy, p, q, icpt)
+
+        return _batched(jax.hessian(neg_ll),
+                        jnp.asarray(self.coefficients), y)
 
 
 # ---------------------------------------------------------------------------
@@ -1271,6 +1373,15 @@ def fit_long(p: int, d: int, q: int, ts: jnp.ndarray,
     :func:`fit` (``method``, ``max_iter``, ``include_intercept``, ...);
     ``warn`` keeps :func:`fit`'s default (warnings evaluated once, on the
     combined model).
+
+    This is the *in-memory* combiner (everything fits in one batched
+    solve, combination in the raw ARMA parameter space).  For series too
+    long for one dispatch — or when the segments should stream through
+    the engine's journaled/deadlined/OOM-degradable chunk pipeline and
+    the result should carry an exact state-space forecast — use the
+    ultra-long tier, :func:`spark_timeseries_tpu.longseries.fit_long`
+    (DARIMA: combination in the common AR-truncation space with design-
+    gram WLS weights, docs/design.md §8).
     """
     ts = jnp.asarray(ts)
     single = ts.ndim == 1
@@ -1298,11 +1409,9 @@ def fit_long(p: int, d: int, q: int, ts: jnp.ndarray,
     theta = m.coefficients.reshape(batch, n_segments, dim)
 
     # per-segment precision: Hessian of the segment's negative CSS
-    # log-likelihood at the optimum (tiny dim x dim, batched)
-    def neg_ll(prm, y):
-        return -_log_likelihood_css_arma(prm, y, p, q, icpt)
-
-    H = jax.vmap(jax.hessian(neg_ll))(m.coefficients, segs)
+    # log-likelihood at the optimum (tiny dim x dim, batched — the same
+    # observed-information export the longseries combiner weights by)
+    H = m.coefficient_precision(segs, assume_differenced=True)
     H = H.reshape(batch, n_segments, dim, dim)
 
     # weightable = finite estimate + finite, PD-ish Hessian.  A segment
@@ -1774,7 +1883,11 @@ def auto_fit_panel(values: jnp.ndarray, max_p: int = 5, max_d: int = 2,
     d_ok = np.asarray(d_ok)
     if short_np is not None:
         d_ok = d_ok | short_np      # short lanes quarantine, never raise
-    if not d_ok.all():
+    if not d_ok.all() and max_d > 0:
+        # max_d == 0 pins d: there is nothing to select, so a KPSS
+        # rejection is a finite-sample false positive on an already-
+        # differenced series (the longseries auto path differences
+        # globally), not a failure — the grid fits stand either way
         bad = int(np.sum(~d_ok))
         raise ValueError(
             f"stationarity not achieved with differencing order <= {max_d} "

@@ -419,6 +419,29 @@ class Panel:
             eng = engine if engine is not None else default_engine()
             return eng.fit_resilient(self.values, family, *args, **kwargs)
 
+    def auto_fit(self, max_p: int = 5, max_d: int = 2, max_q: int = 5,
+                 **kwargs):
+        """Batched automatic ARIMA order selection over the whole panel —
+        the :func:`~spark_timeseries_tpu.models.arima.auto_fit_panel`
+        front door (ROADMAP item 1): per-series d by batched KPSS, the
+        full (p, q) candidate grid fitted in one fused dispatch, on-device
+        admissibility screening and AIC argmin, then a full-budget
+        refinement of each series' winner.
+
+        NaN-padded ragged panels (the ``from_observations`` + ``union``
+        ingestion shape) auto-fit directly — each lane's valid window
+        drives its d-selection, init, masked solve, and AIC sample size;
+        lanes too short for the grid quarantine (NaN coefficients, +inf
+        aic, orders (0,0,0)) instead of failing the panel.  ``kwargs``
+        pass through (``max_iter``, ``screen_max_iter``).  Returns a
+        :class:`~spark_timeseries_tpu.models.arima.PanelARIMAFit`;
+        ``.model_for(i)`` materializes one series' winner as a standalone
+        model."""
+        from .models import arima
+        with _metrics.span("panel.auto_fit"):
+            return arima.auto_fit_panel(self.values, max_p=max_p,
+                                        max_d=max_d, max_q=max_q, **kwargs)
+
     def stream_fit(self, family: str = "arima", *, engine=None, **kwargs):
         """Stream this panel's series through the engine's chunked fit
         pipeline (:meth:`~spark_timeseries_tpu.engine.FitEngine.stream_fit`):

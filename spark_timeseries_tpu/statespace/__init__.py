@@ -22,8 +22,9 @@ Layout: :mod:`ssm` (representation + filter-state pytrees), :mod:`kalman`
 from . import convert, kalman, serving, ssm  # noqa: F401
 from .convert import Bootstrapped, bootstrap, to_statespace  # noqa: F401
 from .kalman import (FilterResult, concentrated_loglik,  # noqa: F401
-                     filter_panel, filter_panel_parallel,
-                     filter_step_panel)
+                     filter_forecast_origin, filter_panel,
+                     filter_panel_parallel, filter_step_panel,
+                     forecast_mean)
 from .serving import ServingSession, TickResult, start_session  # noqa: F401
 from .ssm import (FilterState, SSMeta, StateSpace,  # noqa: F401
                   initial_state, state_nbytes)
@@ -32,6 +33,7 @@ __all__ = [
     "ssm", "kalman", "convert", "serving",
     "StateSpace", "SSMeta", "FilterState", "initial_state", "state_nbytes",
     "filter_step_panel", "filter_panel", "filter_panel_parallel",
+    "filter_forecast_origin", "forecast_mean",
     "concentrated_loglik", "FilterResult",
     "to_statespace", "bootstrap", "Bootstrapped",
     "ServingSession", "TickResult", "start_session",

@@ -47,7 +47,8 @@ from jax import lax
 from .ssm import FilterState, SSMeta, StateSpace
 
 __all__ = ["filter_step_one", "filter_step_panel", "filter_panel",
-           "filter_panel_parallel", "concentrated_loglik", "FilterResult"]
+           "filter_panel_parallel", "concentrated_loglik", "FilterResult",
+           "forecast_mean", "steady_gain", "filter_forecast_origin"]
 
 
 class FilterResult(NamedTuple):
@@ -213,6 +214,151 @@ def concentrated_loglik(state: FilterState) -> jnp.ndarray:
     two_pi = jnp.asarray(2.0 * math.pi, state.ssq.dtype)
     ll = -0.5 * n * (jnp.log(two_pi * sigma2) + 1.0) - 0.5 * state.sumlogf
     return jnp.where(state.n_obs > 0, ll, jnp.nan)
+
+
+def forecast_mean(meta: SSMeta, horizon: int, ssm: StateSpace,
+                  a: jnp.ndarray, ring: jnp.ndarray,
+                  offsets: jnp.ndarray) -> jnp.ndarray:
+    """h-step point forecasts from a predicted state: mean propagation
+    ``x ← T(x + offset·Z) + c`` with zero future innovations, each step's
+    observation integrated back to the raw scale through the
+    ``d_order``-length raw-difference ring.
+
+    ``a (S, m)`` the one-step-predicted state mean, ``ring (S, d_order)``
+    the last raw differences, ``offsets (S, horizon)`` known future
+    exogenous observation offsets (zeros when none).  Returns
+    ``(S, horizon)``.  The single forecast program shared by
+    ``ServingSession.forecast`` and the longseries tier's exact
+    forecast-from-combined-model path — one math, every consumer.
+    """
+    d_order = meta.d_order
+
+    def one_lane(ssm_l, a_l, ring_l, offs):
+        def step(carry, off):
+            x, lasts = carry
+            z = ssm_l.d + ssm_l.Z @ x + off
+            if d_order:
+                vals = []
+                cur = z
+                for j in range(d_order - 1, -1, -1):
+                    cur = cur + lasts[j]
+                    vals.append(cur)
+                y_out = cur
+                lasts = jnp.stack(vals[::-1])
+            else:
+                y_out = z
+            x = ssm_l.T @ (x + off * ssm_l.Z) + ssm_l.c
+            return (x, lasts), y_out
+
+        _, ys = lax.scan(step, (a_l, ring_l), offs, length=horizon)
+        return ys
+
+    return jax.vmap(one_lane)(ssm, a, ring, offsets)
+
+
+def steady_gain(ssm: StateSpace, P: jnp.ndarray
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The prediction-form gain (and innovation variance) a converged
+    predicted covariance implies: ``F = Z P Zᵀ + H``, ``K = T P Zᵀ / F``.
+    ``P (S, m, m)``; returns ``(K (S, m), F (S,))``.  The exact filter's
+    covariance recursion is data-independent and converges to its
+    Riccati fixed point geometrically, so the ``P`` after a few hundred
+    steps pins the gain every later step uses — the fact
+    :func:`filter_forecast_origin` exploits."""
+    pz = jnp.einsum("sij,sj->si", P, ssm.Z)
+    F = jnp.einsum("si,si->s", ssm.Z, pz) + ssm.H
+    K = jnp.einsum("sij,sj->si", ssm.T, pz) / F[:, None]
+    return K, F
+
+
+# module-level traced chunk kernel (STS006: one function object, so
+# repeated chunks share the jit cache — at most two compiles per run,
+# the full chunk shape and the tail)
+def _origin_chunk_full(A, K, F, Z, c, d, ys, x0):
+    """One time chunk of the pinned-gain state recursion in O(log k)
+    depth: ``x_t = A x_{t-1} + c + K (y_t - d)`` with constant per-lane
+    ``A = T - K Z``, evaluated by associative scan; innovations and the
+    likelihood pieces follow elementwise off the prefix states.  Returns
+    ``(x_last, ll_sum, ssq_sum, sumlogf_sum)`` per lane."""
+    from ..ops.scan_parallel import affine_recurrence
+
+    k = ys.shape[1]
+    dtype = ys.dtype
+    b = c[None] + K[None] * (ys.T - d[None])[..., None]      # (k, S, m)
+    A_t = jnp.broadcast_to(A[None], (k,) + A.shape)
+    xs = affine_recurrence(A_t, b, x0=x0)                    # (k, S, m)
+    preds = jnp.concatenate([x0[None], xs[:-1]], axis=0)
+    v = ys.T - d[None] - jnp.einsum("sm,tsm->ts", Z, preds)  # (k, S)
+    two_pi = jnp.asarray(2.0 * math.pi, dtype)
+    ll = jnp.sum(-0.5 * (jnp.log(two_pi * F)[None] + v * v / F[None]),
+                 axis=0)
+    ssq = jnp.sum(v * v / F[None], axis=0)
+    sumlogf = jnp.asarray(k, dtype) * jnp.log(F)
+    return xs[-1], ll, ssq, sumlogf
+
+
+_origin_chunk = jax.jit(_origin_chunk_full)
+
+
+def filter_forecast_origin(ssm: StateSpace, state: FilterState, ys,
+                           meta: SSMeta, *, warm: int = 512,
+                           chunk: int = 65536) -> FilterState:
+    """Exact-mode forecast-origin state over an ultra-long series
+    without an O(n) sequential scan.
+
+    The exact filter's gain sequence is data-independent and converges
+    geometrically to its Riccati fixed point, so: (1) filter the first
+    ``warm`` observations with the full covariance-propagating scan
+    (:func:`filter_panel` — tiny, sequential), (2) pin the converged
+    gain (:func:`steady_gain`) and evaluate the remaining state-mean
+    recursion — now the affine map ``x_t = (T - KZ) x_{t-1} + c +
+    K(y_t - d)`` — chunk by chunk through
+    :func:`~spark_timeseries_tpu.ops.scan_parallel.affine_recurrence`
+    in O(log chunk) depth, with only chunk boundaries crossing the host.
+    Matches the sequential filter to float rounding once ``warm`` covers
+    the covariance burn-in (a few hundred steps for stationary models);
+    this is the longseries tier's forecast-origin recovery
+    (docs/design.md §8).
+
+    ``ys (S, n)`` must be fully observed (no NaN) — missing ticks
+    perturb the gain sequence, which only the sequential
+    :func:`filter_panel` tracks.  Likelihood accumulators on the
+    returned state use the pinned innovation variance past ``warm``
+    (equal to the sequential filter's to the same rounding).  ``P`` on
+    the returned state is the converged predicted covariance.
+    """
+    if meta.mode != "exact":
+        raise ValueError(
+            "filter_forecast_origin is the exact-mode fast path; pinned-"
+            "gain models already have filter_panel_parallel")
+    if meta.d_order != 0:
+        raise ValueError(
+            "filter_forecast_origin runs on the filter scale; difference "
+            "the series first (d_order must be 0)")
+    n = ys.shape[1]
+    w = min(int(warm), n)
+    head = jnp.asarray(ys[:, :w])
+    res = filter_panel(ssm, state, head, meta)
+    origin = res.state
+    if w == n:
+        return origin
+    K, F = steady_gain(ssm, origin.P)
+    gz = jnp.einsum("si,sj->sij", K, ssm.Z)
+    A = ssm.T - gz
+    x = origin.a
+    ll, ssq, slf = origin.loglik, origin.ssq, origin.sumlogf
+    n_obs = origin.n_obs
+    step = max(1, int(chunk))
+    for s in range(w, n, step):
+        part = jnp.asarray(ys[:, s:s + step])
+        x, ll_c, ssq_c, slf_c = _origin_chunk(A, K, F, ssm.Z, ssm.c,
+                                              ssm.d, part, x)
+        ll = ll + ll_c
+        ssq = ssq + ssq_c
+        slf = slf + slf_c
+        n_obs = n_obs + jnp.asarray(part.shape[1], n_obs.dtype)
+    return FilterState(a=x, P=origin.P, ring=origin.ring, loglik=ll,
+                       ssq=ssq, sumlogf=slf, n_obs=n_obs)
 
 
 def filter_panel_parallel(ssm: StateSpace, state: FilterState,

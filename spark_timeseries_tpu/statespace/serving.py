@@ -77,36 +77,15 @@ def _update_impl(meta: SSMeta, ssm: StateSpace, state: FilterState,
 
 def _forecast_impl(meta: SSMeta, horizon: int, ssm: StateSpace,
                    state: FilterState, offsets):
-    """h-step point forecasts from the predicted state: mean propagation
-    ``x ← T(x + offset·Z) + c`` with zero future innovations, each step's
-    observation integrated back to the raw scale through the difference
-    ring."""
-    import jax
-    import jax.numpy as jnp
+    """h-step point forecasts from the predicted state — the shared
+    mean-propagation program (``kalman.forecast_mean``: ``x ←
+    T(x + offset·Z) + c`` with zero future innovations, observations
+    integrated back to the raw scale through the difference ring), so a
+    serving session and the longseries exact-forecast path compile the
+    identical executable."""
+    from .kalman import forecast_mean
 
-    d_order = meta.d_order
-
-    def one_lane(ssm_l, a, ring, offs):
-        def step(carry, off):
-            x, lasts = carry
-            z = ssm_l.d + ssm_l.Z @ x + off
-            if d_order:
-                vals = []
-                cur = z
-                for j in range(d_order - 1, -1, -1):
-                    cur = cur + lasts[j]
-                    vals.append(cur)
-                y_out = cur
-                lasts = jnp.stack(vals[::-1])
-            else:
-                y_out = z
-            x = ssm_l.T @ (x + off * ssm_l.Z) + ssm_l.c
-            return (x, lasts), y_out
-
-        _, ys = jax.lax.scan(step, (a, ring), offs, length=horizon)
-        return ys
-
-    return jax.vmap(one_lane)(ssm, state.a, state.ring, offsets)
+    return forecast_mean(meta, horizon, ssm, state.a, state.ring, offsets)
 
 
 _jit_lock = threading.Lock()

@@ -48,7 +48,37 @@ N_XREG = 2
 
 COST_FAMILIES = ("arima", "arimax", "ar", "arx", "ewma", "garch",
                  "argarch", "egarch", "holt_winters", "regression_arima",
-                 "serving_update")
+                 "serving_update", "long_combine")
+
+# the long_combine representative's statics: ARIMA(2,?,2) segment
+# estimates mapped into a 12-term AR truncation — the fit_long defaults
+LONG_COMBINE_N_AR = 12
+
+
+def _long_combine_representative(n_series: int, n_obs: int,
+                                 dtype) -> Tuple[Callable, Tuple]:
+    """The longseries tier's per-chunk combination program: one chunk of
+    ``n_series`` segments of ``n_obs`` observations each, AR(∞)-mapped
+    and gram/variance-weighted in-graph — exactly what
+    ``longseries.combine.combine_segments`` dispatches between chunk
+    boundaries (``_combine_chunk_impl`` with the ``fit_long`` default
+    statics)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..longseries.combine import _combine_chunk_impl
+
+    p, q, icpt = 2, 2, 1
+    n_ar = LONG_COMBINE_N_AR
+    args = (jax.ShapeDtypeStruct((n_series, n_obs), dtype),
+            jax.ShapeDtypeStruct((n_series, icpt + p + q), dtype),
+            jax.ShapeDtypeStruct((n_series,), jnp.bool_))
+
+    def chunk(segs, coefs, conv):
+        return _combine_chunk_impl(segs, coefs, conv, p, q, icpt,
+                                   n_ar, n_ar)
+
+    return chunk, args
 
 
 def _serving_update_representative(n_series: int,
@@ -136,11 +166,14 @@ def representative_fit(family: str, n_series: int, n_obs: int,
         # built only on request: the classic families' reports must not
         # depend on the statespace package importing
         fit_fn, args = _serving_update_representative(n_series, dtype)
+    elif family == "long_combine":
+        fit_fn, args = _long_combine_representative(n_series, n_obs, dtype)
     elif family in table:
         fit_fn, args = table[family]
     else:
-        raise ValueError(f"unknown model family {family!r}; expected one "
-                         f"of {sorted(table) + ['serving_update']}")
+        raise ValueError(
+            f"unknown model family {family!r}; expected one of "
+            f"{sorted(table) + ['serving_update', 'long_combine']}")
     return arrays_only(fit_fn), args
 
 

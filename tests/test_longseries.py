@@ -1,0 +1,450 @@
+"""Ultra-long series tier (``spark_timeseries_tpu.longseries``).
+
+The DARIMA contract checked here (ISSUE 8 acceptance): the split
+geometry is exact and tail-aligned; the AR(∞) truncation mapping matches
+closed forms; ``fit_long`` agrees with a direct full-series ``arima.fit``
+within statistical tolerance on synthetic AR(2) and ARMA(1,1); segment
+streams journal and resume bitwise, and a changed segmentation refuses
+resume; heterogeneous per-segment orders (``auto=True``) combine; and
+``forecast`` off the affine-recurrence origin recovery agrees with the
+sequential Kalman filter run over the full series to rounding.
+
+Everything here is ``long``-marked (``make verify-long``); the
+10⁶-observation end-to-end case is additionally ``slow``-marked so the
+tier-1 sweep skips it.
+"""
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from spark_timeseries_tpu import longseries
+from spark_timeseries_tpu.longseries import combine as ls_combine
+from spark_timeseries_tpu.longseries import split as ls_split
+from spark_timeseries_tpu.models import arima
+from spark_timeseries_tpu.stats import segment_plan
+
+pytestmark = pytest.mark.long
+
+
+def _arma(n, phi=(), theta=(), c=0.0, seed=0, d=0, dtype=np.float64):
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=n + 8)
+    y = np.zeros(n)
+    p, q = len(phi), len(theta)
+    for t in range(max(p, q, 1), n):
+        y[t] = (c + sum(phi[i] * y[t - 1 - i] for i in range(p))
+                + e[t + 8] + sum(theta[j] * e[t + 7 - j] for j in range(q)))
+    for _ in range(d):
+        y = np.cumsum(y)
+    return y.astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# split geometry
+# ---------------------------------------------------------------------------
+
+def test_segment_plan_geometry():
+    p = segment_plan(1_000_000, 2, 2)
+    assert p.n_segments * p.seg_len + p.overlap == p.n_used
+    assert p.head_drop + p.n_used == 1_000_000
+    assert p.seg_len & (p.seg_len - 1) == 0      # power of two
+    assert p.window == p.seg_len + p.overlap
+
+
+def test_segment_plan_respects_floor_and_raises_short():
+    with pytest.raises(ValueError, match="too short to segment"):
+        segment_plan(100, 2, 2)
+    with pytest.raises(ValueError, match="reliability floor"):
+        segment_plan(100_000, 2, 2, seg_len=8)
+
+
+def test_segment_panel_tail_aligned_and_overlapping():
+    y = np.arange(1000, dtype=np.float64)
+    plan = segment_plan(1000, 1, 0, seg_len=128, overlap=16,
+                        min_seg_len=128)
+    panel = ls_split.segment_panel(y, plan)
+    assert panel.shape == (plan.n_segments, 128 + 16)
+    # last window ends exactly at the series tail
+    assert panel[-1, -1] == y[-1]
+    # consecutive windows share their overlap region
+    np.testing.assert_array_equal(panel[0, -16:], panel[1, :16])
+    # stride between window starts is seg_len
+    assert panel[1, 0] - panel[0, 0] == 128
+
+
+def test_tail_ring_matches_differences():
+    y = _arma(256, phi=(0.5,), d=2, seed=4)
+    ring = ls_split.tail_ring(y, 2)
+    assert ring[0] == y[-1]
+    assert ring[1] == np.diff(y)[-1]
+    assert ls_split.tail_ring(y, 0).shape == (0,)
+
+
+# ---------------------------------------------------------------------------
+# AR(∞) truncation mapping (models/arima export)
+# ---------------------------------------------------------------------------
+
+def test_ar_truncation_closed_forms():
+    # MA(1): pi_j = -(-theta)^j
+    _, pi = arima.ar_truncation(jnp.asarray(0.0), jnp.zeros((0,)),
+                                jnp.asarray([0.4]), 5)
+    np.testing.assert_allclose(
+        np.asarray(pi), [-(-0.4) ** j for j in range(1, 6)], atol=1e-12)
+    # ARMA(1,1): pi_j = (phi+theta)(-theta)^(j-1)
+    _, pi = arima.ar_truncation(jnp.asarray(0.0), jnp.asarray([0.5]),
+                                jnp.asarray([0.4]), 6)
+    np.testing.assert_allclose(
+        np.asarray(pi), [0.9 * (-0.4) ** j for j in range(6)], atol=1e-12)
+    # pure AR maps exactly (zero tail)
+    cpi, pi = arima.ar_truncation(jnp.asarray(1.2),
+                                  jnp.asarray([0.5, -0.2]),
+                                  jnp.zeros((0,)), 6)
+    np.testing.assert_allclose(np.asarray(pi), [0.5, -0.2, 0, 0, 0, 0],
+                               atol=1e-12)
+    assert float(cpi) == pytest.approx(1.2)      # theta(1) = 1
+    # intercept map: c_pi = c / (1 + sum(theta))
+    cpi, _ = arima.ar_truncation(jnp.asarray(0.7), jnp.zeros((0,)),
+                                 jnp.asarray([0.4]), 3)
+    assert float(cpi) == pytest.approx(0.5)
+
+
+def test_model_ar_inf_and_precision_export():
+    m = arima.ARIMAModel(1, 0, 1, jnp.asarray([0.3, 0.5, 0.4]))
+    cpi, pi = m.ar_inf_coefficients(4)
+    np.testing.assert_allclose(
+        np.asarray(pi), [0.9 * (-0.4) ** j for j in range(4)], atol=1e-12)
+    y = jnp.asarray(_arma(512, phi=(0.5,), theta=(0.4,), seed=7))
+    H = m.coefficient_precision(y)
+    assert H.shape == (3, 3)
+    # observed information at a near-optimum is positive on the diagonal
+    assert np.all(np.diag(np.asarray(H)) > 0)
+
+
+# ---------------------------------------------------------------------------
+# combiner correctness (the acceptance pins)
+# ---------------------------------------------------------------------------
+
+def test_fit_long_ar2_matches_direct_fit():
+    y = _arma(65536, phi=(0.5, -0.2), c=0.3, seed=1)
+    fl = longseries.fit_long(y, order=(2, 0, 0), warn=False)
+    direct = arima.fit(2, 0, 0, jnp.asarray(y), warn=False)
+    # pure AR: the truncation map is exact, so [c, phi1, phi2] compare
+    # directly and the remaining AR slots must be ~0
+    np.testing.assert_allclose(np.asarray(fl.coefficients)[:3],
+                               np.asarray(direct.coefficients), atol=0.03)
+    assert fl.combined.used_wls
+    assert fl.combined.n_weighted == fl.plan.n_segments
+    assert bool(np.asarray(fl.diagnostics.converged))
+
+
+def test_fit_long_arma11_matches_direct_in_ar_space():
+    y = _arma(65536, phi=(0.6,), theta=(0.3,), c=0.1, seed=2)
+    fl = longseries.fit_long(y, order=(1, 0, 1), warn=False)
+    direct = arima.fit(1, 0, 1, jnp.asarray(y), warn=False)
+    cpi_d, pi_d = direct.ar_inf_coefficients(fl.model.p)
+    np.testing.assert_allclose(np.asarray(fl.coefficients)[0],
+                               float(cpi_d), atol=0.05)
+    np.testing.assert_allclose(np.asarray(fl.coefficients)[1:],
+                               np.asarray(pi_d), atol=0.05)
+
+
+def test_fit_long_with_differencing_recovers_arma_scale():
+    y = _arma(32768, phi=(0.5,), c=0.01, seed=3, d=1)
+    fl = longseries.fit_long(y, order=(1, 1, 0), warn=False)
+    direct = arima.fit(1, 1, 0, jnp.asarray(y), warn=False)
+    np.testing.assert_allclose(np.asarray(fl.coefficients)[:2],
+                               np.asarray(direct.coefficients), atol=0.05)
+    assert fl.model.d == 1
+
+
+def test_combiner_downweights_poisoned_segments():
+    y = _arma(16384, phi=(0.6,), seed=5)
+    plan = segment_plan(y.size, 1, 0, seg_len=1024)
+    panel = ls_split.segment_panel(y, plan)
+    good = arima.fit(1, 0, 0, jnp.asarray(panel), warn=False)
+    coefs = np.array(good.coefficients, np.float64)
+    coefs[3] = np.nan                      # a dead segment
+    res = ls_combine.combine_segments(panel, coefs, p=1, q=0,
+                                      include_intercept=True, n_ar=1)
+    assert res.n_weighted == plan.n_segments - 1
+    assert res.n_finite == plan.n_segments - 1
+    assert np.all(np.isfinite(res.coefficients))
+    assert res.used_wls
+
+
+def test_combiner_all_dead_falls_back_finite():
+    y = _arma(16384, phi=(0.6,), seed=6)
+    plan = segment_plan(y.size, 1, 0, seg_len=1024)
+    panel = ls_split.segment_panel(y, plan)
+    coefs = np.full((plan.n_segments, 2), np.nan)
+    res = ls_combine.combine_segments(panel, coefs, p=1, q=0,
+                                      include_intercept=True, n_ar=1)
+    assert not res.used_wls
+    assert res.n_weighted == 0
+    assert np.all(np.isfinite(res.coefficients))   # zero fallback
+
+
+# ---------------------------------------------------------------------------
+# exact forecasting (affine-recurrence origin recovery)
+# ---------------------------------------------------------------------------
+
+def test_forecast_origin_matches_sequential_filter():
+    from spark_timeseries_tpu.statespace import (filter_forecast_origin,
+                                                 filter_panel,
+                                                 to_statespace)
+    from spark_timeseries_tpu.statespace.ssm import SSMeta, initial_state
+
+    y = _arma(20000, phi=(0.5, -0.2), theta=(0.4,), c=0.3, seed=8)
+    model = arima.ARIMAModel(2, 0, 1, jnp.asarray([0.3, 0.5, -0.2, 0.4]))
+    ssm, meta = to_statespace(model)
+    meta0 = SSMeta(meta.family, meta.mode, 0, meta.m)
+    state0 = initial_state(ssm, meta0)
+    seq = filter_panel(ssm, state0, jnp.asarray(y[None]), meta0).state
+    fast = filter_forecast_origin(ssm, state0, y[None], meta0,
+                                  warm=256, chunk=4096)
+    np.testing.assert_allclose(np.asarray(fast.a), np.asarray(seq.a),
+                               rtol=1e-9, atol=1e-9)
+    np.testing.assert_allclose(float(fast.loglik[0]),
+                               float(seq.loglik[0]), rtol=1e-8)
+    assert int(fast.n_obs[0]) == int(seq.n_obs[0])
+
+
+def test_forecast_origin_rejects_wrong_modes():
+    from spark_timeseries_tpu.statespace import (filter_forecast_origin,
+                                                 to_statespace)
+    from spark_timeseries_tpu.statespace.ssm import SSMeta, initial_state
+
+    model = arima.ARIMAModel(1, 1, 0, jnp.asarray([0.1, 0.5]))
+    ssm, meta = to_statespace(model)
+    state0 = initial_state(ssm, SSMeta(meta.family, meta.mode, 0, meta.m))
+    with pytest.raises(ValueError, match="d_order"):
+        filter_forecast_origin(ssm, state0, np.zeros((1, 64)), meta)
+
+
+def test_fit_long_forecast_agrees_with_full_series_filter():
+    """The acceptance pin: fit_long(...).forecast(h) == the statespace
+    filter run sequentially over the full series, to rounding."""
+    from spark_timeseries_tpu.statespace import filter_panel, to_statespace
+    from spark_timeseries_tpu.statespace.serving import _jitted
+    from spark_timeseries_tpu.statespace.ssm import SSMeta, initial_state
+
+    y = _arma(32768, phi=(0.6,), theta=(0.3,), c=0.1, seed=2, d=1)
+    fl = longseries.fit_long(y, order=(1, 1, 1), warn=False)
+    h = 8
+    got = fl.forecast(h)
+    # the origin recovery releases the series-sized buffer once cached
+    assert fl._diffed is None
+
+    diffed = np.diff(y)
+    ssm, meta = to_statespace(fl.model)
+    meta0 = SSMeta(meta.family, meta.mode, 0, meta.m)
+    seq = filter_panel(ssm, initial_state(ssm, meta0),
+                       jnp.asarray(diffed[None]), meta0).state
+    seq = seq._replace(ring=jnp.asarray(fl._ring[None]))
+    want = np.asarray(_jitted("forecast")(
+        meta, h, ssm, seq, jnp.zeros((1, h), diffed.dtype)))[0]
+    np.testing.assert_allclose(got, want, rtol=1e-7, atol=1e-7)
+    # the reported likelihood is the σ²-concentrated exact loglik on
+    # the model's own convention — NOT the unit-scale filter total
+    want_ll = float(np.asarray(fl.model.log_likelihood_exact(
+        jnp.asarray(y))))
+    assert fl.loglik == pytest.approx(want_ll, rel=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# durability: journaled segment jobs resume; geometry changes refuse
+# ---------------------------------------------------------------------------
+
+def test_fit_long_journal_resume_bitwise(tmp_path):
+    y = _arma(32768, phi=(0.6,), theta=(0.3,), seed=9)
+    jd = str(tmp_path / "journal")
+    fl1 = longseries.fit_long(y, order=(1, 0, 1), journal=jd,
+                              chunk_segments=8, warn=False)
+    assert fl1.stream_stats["journal_commits"] > 0
+    fl2 = longseries.fit_long(y, order=(1, 0, 1), journal=jd,
+                              chunk_segments=8, warn=False)
+    assert fl2.stream_stats["journal_hits"] == fl1.stream_stats[
+        "journal_commits"]
+    np.testing.assert_array_equal(np.asarray(fl1.coefficients),
+                                  np.asarray(fl2.coefficients))
+
+
+def test_fit_long_geometry_change_refuses_resume(tmp_path):
+    from spark_timeseries_tpu.engine import JournalSpecMismatch
+
+    y = _arma(32768, phi=(0.6,), seed=10)
+    jd = str(tmp_path / "journal")
+    longseries.fit_long(y, order=(1, 0, 0), journal=jd, seg_len=1024,
+                        warn=False)
+    with pytest.raises(JournalSpecMismatch):
+        longseries.fit_long(y, order=(1, 0, 0), journal=jd, seg_len=2048,
+                            warn=False)
+    # same seg_len, different overlap: panel shape may collide but the
+    # job_meta hash still refuses
+    with pytest.raises(JournalSpecMismatch):
+        longseries.fit_long(y, order=(1, 0, 0), journal=jd, seg_len=1024,
+                            overlap=32, warn=False)
+
+
+def test_stream_fit_job_meta_must_be_json():
+    from spark_timeseries_tpu.engine import default_engine
+
+    with pytest.raises(ValueError, match="JSON-serializable"):
+        default_engine().stream_fit(
+            np.zeros((8, 64), np.float64), "ar", max_lag=1,
+            journal=None, job_meta={"bad": object()})
+
+
+def test_stream_fit_collected_ranges_align():
+    from spark_timeseries_tpu.engine import FitEngine
+
+    eng = FitEngine()
+    panel = _arma(64, phi=(0.5,), seed=11).reshape(1, -1) \
+        * np.ones((20, 1))
+    panel = panel + np.random.default_rng(0).normal(
+        scale=0.1, size=panel.shape)
+    res = eng.stream_fit(panel, "ar", max_lag=1, chunk_size=8,
+                         collect=True)
+    ranges = res.stats["collected_ranges"]
+    assert [tuple(r) for r in ranges] == [(0, 8), (8, 16), (16, 20)]
+    assert len(res.models) == len(ranges)
+    total = sum(b - a for a, b in ranges)
+    assert total == 20
+
+
+# ---------------------------------------------------------------------------
+# auto mode (heterogeneous per-segment orders)
+# ---------------------------------------------------------------------------
+
+def test_fit_long_auto_combines_heterogeneous_orders():
+    y = _arma(32768, phi=(0.6,), theta=(0.3,), c=0.1, seed=2)
+    fl = longseries.fit_long(y, order=(1, 0, 1), auto=True, max_p=2,
+                             max_q=2, warn=False)
+    assert fl.segment_orders is not None
+    assert fl.combined.used_wls
+    # pi_1 of ARMA(0.6, 0.3) is phi + theta = 0.9 regardless of which
+    # admissible order each segment picked
+    assert np.asarray(fl.coefficients)[1] == pytest.approx(0.9, abs=0.05)
+
+
+def test_fit_long_auto_drops_inadmissible_segments(monkeypatch):
+    # auto_fit_panel reports a no-admissible-candidate lane with
+    # aic=+inf but ZERO coefficients (finite!) — it must combine at
+    # weight zero, not drag the WLS estimate toward the zero model
+    from spark_timeseries_tpu.longseries import api as ls_api
+    from spark_timeseries_tpu.models import arima as _arima
+
+    y = _arma(32768, phi=(0.6,), seed=21)
+    real = _arima.auto_fit_panel
+
+    def poisoned(values, **kw):
+        pf = real(values, **kw)
+        aic = np.array(pf.aic)
+        aic[0] = np.inf                    # segment 0: "failed" lane
+        return pf._replace(aic=jnp.asarray(aic))
+
+    monkeypatch.setattr(ls_api, "auto_fit_panel", poisoned,
+                        raising=False)
+    monkeypatch.setattr(_arima, "auto_fit_panel", poisoned)
+    fl = longseries.fit_long(y, order=(1, 0, 0), auto=True, max_p=1,
+                             max_q=1, warn=False)
+    assert fl.combined.n_weighted == fl.plan.n_segments - 1
+    assert fl.combined.n_finite == fl.plan.n_segments - 1
+    # the surviving segments still recover phi
+    assert np.asarray(fl.coefficients)[1] == pytest.approx(0.6, abs=0.05)
+
+
+def test_fit_long_auto_rejects_non_auto_kwargs():
+    y = _arma(32768, phi=(0.6,), seed=22)
+    with pytest.raises(ValueError, match="auto_fit_panel"):
+        longseries.fit_long(y, auto=True, method="css-lm", warn=False)
+
+
+def test_fit_long_rejects_optimizer_retry_kwarg():
+    y = _arma(32768, phi=(0.6,), seed=23)
+    with pytest.raises(ValueError, match="chunk_retry"):
+        longseries.fit_long(y, order=(1, 0, 0), retry=2, warn=False)
+
+
+def test_fit_long_auto_rejects_dead_streaming_knobs(tmp_path):
+    # a journal under auto=True would never commit a chunk — the user
+    # believes the job is crash-consistent when nothing is written;
+    # every stream-only knob must fail loudly, not silently no-op
+    y = _arma(32768, phi=(0.6,), seed=24)
+    for kw in ({"journal": str(tmp_path / "j")}, {"deadline_s": 60.0},
+               {"chunk_retry": 2}, {"degrade": False},
+               {"chunk_segments": 16}):
+        with pytest.raises(ValueError, match="streaming knobs"):
+            longseries.fit_long(y, order=(1, 0, 0), auto=True,
+                                warn=False, **kw)
+
+
+def test_loglik_is_sigma2_concentrated():
+    # scale the series by 10 (sigma2 x100): the unit-scale filter total
+    # would be off by O(n·log sigma2); the concentrated loglik must keep
+    # matching the model's own exact-likelihood convention
+    y = 10.0 * _arma(16384, phi=(0.6,), seed=25)
+    fl = longseries.fit_long(y, order=(1, 0, 0), warn=False)
+    want = float(np.asarray(fl.model.log_likelihood_exact(
+        jnp.asarray(y))))
+    assert fl.loglik == pytest.approx(want, rel=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# input validation
+# ---------------------------------------------------------------------------
+
+def test_fit_long_rejects_bad_inputs():
+    with pytest.raises(ValueError, match="ONE ultra-long series"):
+        longseries.fit_long(np.zeros((4, 1000)), warn=False)
+    y = _arma(32768, phi=(0.5,), seed=12)
+    y[100] = np.nan
+    with pytest.raises(ValueError, match="fully-observed"):
+        longseries.fit_long(y, warn=False)
+    with pytest.raises(ValueError, match="too short to segment"):
+        longseries.fit_long(np.zeros(100), warn=False)
+
+
+def test_fit_long_metrics_accounting():
+    from spark_timeseries_tpu.utils import metrics
+
+    before = metrics.snapshot()["counters"].get("longseries.fits", 0)
+    y = _arma(16384, phi=(0.5,), seed=13)
+    fl = longseries.fit_long(y, order=(1, 0, 0), warn=False)
+    snap = metrics.snapshot()["counters"]
+    assert snap.get("longseries.fits", 0) == before + 1
+    assert snap.get("longseries.segments_combined", 0) >= \
+        fl.plan.n_segments
+
+
+# ---------------------------------------------------------------------------
+# the 10⁶-observation end-to-end case (slow; `make verify-long` runs it)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_fit_long_million_obs_end_to_end():
+    import time
+
+    from spark_timeseries_tpu.ops.scan_parallel import ar1_filter
+
+    n = int(os.environ.get("STS_TEST_LONG_OBS", "1000000"))
+    rng = np.random.default_rng(11)
+    e = rng.standard_normal(n + 1).astype(np.float32)
+    x = e[1:] + np.float32(0.4) * e[:-1]
+    y = np.asarray(ar1_filter(jnp.asarray(x), 0.1, 0.6), np.float32)
+
+    t0 = time.perf_counter()
+    fl = longseries.fit_long(y, order=(1, 0, 1), warn=False)
+    fit_s = time.perf_counter() - t0
+    obs_per_s = fl.plan.n_used / fit_s
+    assert fl.combined.used_wls
+    assert fl.combined.n_weighted >= fl.plan.n_segments - 1
+    # pi_1 = phi + theta = 1.0 for the generator above
+    assert float(np.asarray(fl.coefficients)[1]) == pytest.approx(
+        1.0, abs=0.05)
+    fc = fl.forecast(24)
+    assert fc.shape == (24,) and np.all(np.isfinite(fc))
+    assert obs_per_s > 0

@@ -314,3 +314,76 @@ class TestSharded:
         tgt = uniform("2015-04-09T00:00Z", 3, DayFrequency(1))
         r = p.resample(tgt, "mean")
         np.testing.assert_allclose(np.asarray(r.values), [[1.5, 3.5, 5.5]])
+
+
+class TestAutoFit:
+    """`Panel.auto_fit` — the batched automatic order search
+    (`models.arima.auto_fit_panel`, ROADMAP item 1) reached from the
+    Panel API, including the NaN-padded ragged ingestion shape."""
+
+    @staticmethod
+    def _ar_panel(n_series=6, n_obs=384, seed=0):
+        rng = np.random.RandomState(seed)
+        phis = np.linspace(0.3, 0.7, n_series)
+        vals = np.zeros((n_series, n_obs))
+        e = rng.randn(n_series, n_obs + 1)
+        for t in range(1, n_obs):
+            vals[:, t] = 0.2 + phis * vals[:, t - 1] + e[:, t + 1]
+        idx = uniform("2015-04-09T00:00Z", n_obs, DayFrequency(1))
+        return Panel(idx, vals, [f"k{i}" for i in range(n_series)])
+
+    def test_auto_fit_selects_orders_and_records_span(self):
+        from spark_timeseries_tpu.utils import metrics
+
+        p = self._ar_panel()
+        fit = p.auto_fit(max_p=2, max_d=1, max_q=1)
+        assert fit.orders.shape == (p.n_series, 3)
+        assert np.all(np.isfinite(fit.aic))
+        # AR(1) generators: every lane picks at least one AR/MA term;
+        # d stays within the bound (KPSS may pick 1 on a borderline-
+        # persistent lane — that is the test's own statistics, not a bug)
+        assert np.all(fit.orders[:, 0] + fit.orders[:, 2] >= 1)
+        assert np.all(fit.orders[:, 1] <= 1)
+        spans = metrics.snapshot()["spans"]
+        hits = [k for k in spans if k.split("/")[-1] == "panel.auto_fit"]
+        assert hits, f"panel.auto_fit span missing; saw {list(spans)[:8]}"
+        # model_for materializes a usable per-series winner
+        m = fit.model_for(0)
+        assert np.all(np.isfinite(np.asarray(m.coefficients)))
+
+    def test_auto_fit_matches_direct_auto_fit_panel(self):
+        from spark_timeseries_tpu.models import arima
+
+        p = self._ar_panel(seed=3)
+        via_panel = p.auto_fit(max_p=2, max_d=1, max_q=1)
+        direct = arima.auto_fit_panel(p.values, max_p=2, max_d=1, max_q=1)
+        np.testing.assert_array_equal(via_panel.orders, direct.orders)
+        np.testing.assert_allclose(via_panel.coefficients,
+                                   direct.coefficients)
+
+    def test_auto_fit_ragged_nan_padded_lane(self):
+        # the from_observations/union ingestion shape: leading/trailing
+        # NaN padding per lane must auto-fit like the trimmed series,
+        # and an all-NaN lane must quarantine instead of raising
+        p = self._ar_panel(n_series=4, n_obs=384, seed=5)
+        vals = np.array(p.values)
+        vals[1, :64] = np.nan              # leading padding
+        vals[2, 320:] = np.nan             # trailing padding
+        vals[3, :] = np.nan                # unfittable lane
+        ragged = Panel(p.index, vals, p.keys)
+        with pytest.warns(UserWarning):
+            fit = ragged.auto_fit(max_p=2, max_d=1, max_q=1)
+        # live lanes fitted
+        assert np.all(np.isfinite(fit.aic[:3]))
+        # the all-NaN lane quarantined: +inf aic, orders zeroed
+        assert not np.isfinite(fit.aic[3])
+        assert tuple(fit.orders[3]) == (0, 0, 0)
+        # trimmed-equivalence: the padded lane's winner matches an
+        # independent auto-fit of its trimmed series
+        from spark_timeseries_tpu.models import arima
+        trimmed = arima.auto_fit_panel(vals[1:2, 64:], max_p=2, max_d=1,
+                                       max_q=1)
+        np.testing.assert_array_equal(fit.orders[1], trimmed.orders[0])
+        np.testing.assert_allclose(fit.coefficients[1],
+                                   trimmed.coefficients[0], rtol=1e-4,
+                                   atol=1e-6)

@@ -28,6 +28,7 @@ automated check (``make gate``):
   engine_dead_chunks     ``metrics.engine["engine.dead_chunks"]``    higher
   serving_update_p50     ``metrics.spans["serving.update"]`` p50     higher
   serving_update_p95     ``metrics.spans["serving.update"]`` p95     higher
+  long_obs_per_s         headline ``long_demo.obs_per_s``            lower
   =====================  ==========================================  ======
 
   (``engine_cache_misses`` is the streaming engine's executable-cache
@@ -47,7 +48,17 @@ automated check (``make gate``):
   cached-executable Kalman step *including* result materialization, so
   a >25% jump over the trailing median means tick ingest itself got
   slower — a recompile leaking into the hot path, a bucket policy
-  change, or per-tick work that stopped being O(1).)
+  change, or per-tick work that stopped being O(1).
+
+  ``long_obs_per_s`` is the ultra-long tier's end-to-end throughput
+  (ISSUE 8): the bench's ``long_demo`` fits one 10⁶-observation
+  synthetic ARMA through the DARIMA split-and-combine path — global
+  differencing, obs-axis segmentation, segments streamed through
+  ``engine.stream_fit``, in-graph WLS combination — and reports
+  observations fitted per second.  A >25% drop means the obs-axis
+  pipeline regressed (segment streaming stopped sharing executables,
+  the combiner grew host round-trips, ...).  Like the serving SLO it
+  is absent in rounds that predate the tier — no fabricated zeros.)
 
 - prints a pass/fail table with signed percentage deltas and exits 1 on
   any regression, 0 otherwise.  A newest round that crashed (``rc != 0``)
@@ -88,6 +99,7 @@ METRICS = [
     ("engine_dead_chunks", "lower_better", 50.0),
     ("serving_update_p50", "lower_better", 25.0),
     ("serving_update_p95", "lower_better", 25.0),
+    ("long_obs_per_s", "higher_better", 25.0),
 ]
 
 
@@ -166,6 +178,12 @@ def extract_metrics(headline: Optional[dict]) -> Dict[str, float]:
         return out
     if isinstance(headline.get("value"), (int, float)):
         out["throughput"] = float(headline["value"])
+    # ultra-long tier throughput: absent in rounds that predate the
+    # long_demo block (no fabricated zeros), like serving_update_*
+    ld = headline.get("long_demo")
+    if isinstance(ld, dict) and isinstance(ld.get("obs_per_s"),
+                                           (int, float)):
+        out["long_obs_per_s"] = float(ld["obs_per_s"])
     m = headline.get("metrics")
     if isinstance(m, dict):
         spans = m.get("spans")
