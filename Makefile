@@ -27,7 +27,8 @@ help:
 	@echo "  contracts     jaxpr/HLO contract checks for all ten fit families"
 	@echo "  verify-static lint + contracts (the full static-analysis gate)"
 	@echo "  verify-faults tier-1 sweep with STS_FAULT_INJECT=1 (retry/fallback paths forced),"
-	@echo "                plus the verify-durability subset"
+	@echo "                plus the verify-durability subset and the serving suite under"
+	@echo "                the serving-tier fault modes (tick corruption, state poison)"
 	@echo "  verify-durability durable-streaming suite (chunk journal + resume, deadlines,"
 	@echo "                quarantine/backoff, OOM degradation) under every fault mode"
 	@echo "  verify-serving state-space/Kalman serving-tier suite (O(1) tick updates,"
@@ -84,10 +85,17 @@ tier1:
 # fallback chain, which runs clean (fallback stages must be able to
 # SUCCEED here, or a regression in them would be invisible).  Plain fits
 # are unaffected; the bit-for-bit equivalence tests skip themselves
-# under this flag.
+# under this flag.  The serving-marked suite (including its slow cases —
+# the end-to-end poison -> quarantine -> heal scenario and the χ²-band
+# false-positive pin, which use the tick_corrupt_* / state_poison fault
+# modes) runs under the same env, so heal()'s batch refit exercises its
+# forced-retry path too.
 verify-faults: verify-durability
 	STS_FAULT_INJECT=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 		-m 'not slow' --continue-on-collection-errors \
+		-p no:cacheprovider -p no:xdist -p no:randomly
+	STS_FAULT_INJECT=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
+		-m serving --continue-on-collection-errors \
 		-p no:cacheprovider -p no:xdist -p no:randomly
 
 # durable-streaming gate (ISSUE 6): the `durability`-marked subset

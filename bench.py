@@ -714,6 +714,25 @@ def main():
                 for _ in range(fc_reps):
                     sess.forecast(horizon)
                 fc_s = time.perf_counter() - t0
+                # self-heal demo (ISSUE 9), after the timed ticks so it
+                # cannot contaminate the latency SLO — and on a PRIVATE
+                # registry session, so the deliberately injected
+                # divergences never feed the global serving.diverged
+                # counter the gate zero-baselines (that counter must
+                # stay a measurement of ORGANIC lane divergence; an
+                # always-poisoned baseline would mask real regressions).
+                # The serving.heal span is global: heal_p50 is a real
+                # latency however the divergence was provoked.
+                from spark_timeseries_tpu.utils import (
+                    resilience as _resil)
+                heal_sess = sstate.ServingSession.start(
+                    model, hist, registry=metrics.MetricsRegistry())
+                stride = max(1, demo_n // 8)
+                with _resil.fault_injection("state_poison",
+                                            lane_stride=stride):
+                    heal_sess.update(live[:, 0])
+                heal_sess.update(live[:, 1])
+                heal_report = heal_sess.heal()
             # the update span nests under this demo's scope
             # ("bench.serving_demo/serving.update") — resolve it with the
             # same leaf matcher the gate uses, so the reported and gated
@@ -733,6 +752,13 @@ def main():
                 "forecast_series_per_s": round(
                     fc_reps * demo_n / fc_s, 1),
                 "state_bytes": sess.state_bytes,
+                "heal": {"quarantined": heal_report.get("quarantined"),
+                         "healed": heal_report.get("healed"),
+                         "dead": heal_report.get("dead"),
+                         "heal_p50_ms": round(1e3 * (_leaf_span(
+                             metrics.snapshot()["spans"],
+                             "serving.heal") or {}).get("p50_s", 0.0),
+                             3)},
             }
         except Exception as e:  # noqa: BLE001 — optional extra; its
             # failure must not void the already-measured curve

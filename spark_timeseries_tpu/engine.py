@@ -722,7 +722,9 @@ class FitEngine:
         outcome = type(outcome)(
             None if outcome.params is None else outcome.params[:n_series],
             outcome.status[:n_series], outcome.attempts[:n_series],
-            outcome.fallback_used[:n_series], outcome.health[:n_series])
+            outcome.fallback_used[:n_series], outcome.health[:n_series],
+            None if outcome.orders is None
+            else outcome.orders[:n_series])
         return model, outcome
 
     @staticmethod
@@ -806,6 +808,7 @@ class FitEngine:
                    retry=None,
                    degrade: bool = True,
                    degrade_floor: Optional[int] = None,
+                   resilient: bool = False,
                    **kwargs) -> StreamResult:
         """Fit a panel larger than device memory by streaming chunks.
 
@@ -865,6 +868,19 @@ class FitEngine:
           counts the splits; at the floor the OOM quarantines like any
           other failure.
 
+        ``resilient=True`` routes every chunk through the family's
+        fail-soft fallback chain (:meth:`fit_resilient` — health
+        masking, multi-start retry, fallback stages, and for arima the
+        ``auto_order=`` searched-order stage, all passed through
+        ``kwargs``) instead of the AOT dense/ragged executables.  Chunks
+        run synchronously (the chain is host-orchestrated gather/scatter,
+        so there is no async dispatch to pipeline) but keep the full
+        durability scaffolding: deadline watchdog, journal commits and
+        validated resume, quarantine/backoff retries, and OOM halving.
+        Per-chunk ``FitOutcome`` statuses aggregate into
+        ``stats["resilient_statuses"]``; ``converged`` counts lanes whose
+        status is ok/retried/fallback.
+
         Timing covers dispatch through host materialization of every
         chunk's outputs — the real pipeline cost for out-of-core panels.
         """
@@ -872,12 +888,20 @@ class FitEngine:
 
         from .utils import resilience as _resilience
 
-        builder = _STATICS_BUILDERS.get(family)
-        if builder is None:
-            raise ValueError(
-                f"unknown engine family {family!r}; expected one of "
-                f"{sorted(_STATICS_BUILDERS)}")
-        statics = builder(**kwargs)
+        if resilient:
+            # validates the family; the resilient tier has its own
+            # (wider) family table and takes kwargs, not statics
+            self.resilient_dispatch(family)
+            statics = ("resilient",
+                       tuple(sorted((k, repr(v))
+                                    for k, v in kwargs.items())))
+        else:
+            builder = _STATICS_BUILDERS.get(family)
+            if builder is None:
+                raise ValueError(
+                    f"unknown engine family {family!r}; expected one of "
+                    f"{sorted(_STATICS_BUILDERS)}")
+            statics = builder(**kwargs)
         host = values if isinstance(values, np.ndarray) \
             else np.asarray(values)
         if host.ndim != 2:
@@ -1093,6 +1117,58 @@ class FitEngine:
             if collect:
                 collected[start] = (stop, model)
 
+        res_statuses: Dict[str, int] = {}
+
+        def _run_chunk_resilient(idx: int, start: int, stop: int) -> None:
+            """One synchronous resilient chunk: the family's fallback
+            chain under the deadline watchdog, then publish/journal.
+            Honors the streaming fault hooks (hang/oom at the full chunk
+            size) so the durability suite drives this path too."""
+            import jax.numpy as jnp
+
+            part = host[start:stop]
+            oom = _resilience.chunk_fault("oom_chunk", idx)
+            if oom is not None and (start, stop) == partition[idx]:
+                raise _resilience.InjectedOOM(
+                    "RESOURCE_EXHAUSTED: injected oom_chunk fault")
+
+            def work():
+                hang = _resilience.chunk_fault("hang_chunk", idx)
+                if hang is not None:
+                    time.sleep(hang.hang_s)
+                with _metrics.span("engine.dispatch"):
+                    return self.fit_resilient(jnp.asarray(part), family,
+                                              **kwargs)
+
+            model, outcome = _with_deadline(work, "resilient_fit",
+                                            start, stop)
+            nonlocal conv
+            ok = np.isin(outcome.status,
+                         (_resilience.STATUS_OK,
+                          _resilience.STATUS_RETRIED,
+                          _resilience.STATUS_FALLBACK))
+            conv += int(ok.sum())
+            for name, count in outcome.counts().items():
+                res_statuses[name] = res_statuses.get(name, 0) + count
+            self._reg.inc("engine.chunks")
+            if jr is not None:
+                jr.commit(start, stop, model if keep_models else None,
+                          {"n_real": int(stop - start),
+                           "n_conv": int(ok.sum()),
+                           "resilient": True,
+                           "statuses": outcome.counts()})
+                durex["journal_commits"] += 1
+                self._reg.inc("engine.journal_commits")
+                full = (start, stop) == partition[idx]
+                if full and _resilience.chunk_fault(
+                        "kill_after_chunk", idx) is not None:
+                    os.kill(os.getpid(), signal.SIGKILL)
+                if full and _resilience.chunk_fault(
+                        "corrupt_journal", idx) is not None:
+                    jr.corrupt_entry(start, stop)
+            if collect:
+                collected[start] = (stop, model)
+
         def _failure_kind(e: Exception) -> str:
             if isinstance(e, ChunkDeadlineExceeded):
                 return "deadline"
@@ -1173,8 +1249,11 @@ class FitEngine:
             materialization OOMs must recurse toward the floor exactly
             like a dispatch OOM."""
             try:
-                out, entry, n_real = _dispatch(idx, start, stop)
-                _materialize(out, entry, idx, start, stop, n_real)
+                if resilient:
+                    _run_chunk_resilient(idx, start, stop)
+                else:
+                    out, entry, n_real = _dispatch(idx, start, stop)
+                    _materialize(out, entry, idx, start, stop, n_real)
             except Exception as e:  # noqa: BLE001 — classified below
                 if _durability.is_oom(e) and degrade \
                         and (stop - start) > floor:
@@ -1219,6 +1298,9 @@ class FitEngine:
             nonlocal conv
             for pmeta, model in loaded:
                 conv += int(pmeta.get("n_conv", 0))
+                for name, count in (pmeta.get("statuses") or {}).items():
+                    res_statuses[name] = res_statuses.get(name, 0) \
+                        + int(count)
                 if collect:
                     collected[int(pmeta["start"])] = (int(pmeta["stop"]),
                                                       model)
@@ -1242,6 +1324,12 @@ class FitEngine:
         with _metrics.span("engine.stream"):
             for idx, (start, stop) in enumerate(partition):
                 if jr is not None and _resume_from_journal(start, stop):
+                    continue
+                if resilient:
+                    try:
+                        _run_sync(idx, start, stop)
+                    except Exception as e:  # noqa: BLE001 — isolation
+                        _route_failure(idx, start, stop, e)
                     continue
                 try:
                     out, entry, n_real = _dispatch(idx, start, stop)
@@ -1313,6 +1401,9 @@ class FitEngine:
             "retries": policy.max_retries,
             **durex,
         }
+        if resilient:
+            stats["resilient"] = True
+            stats["resilient_statuses"] = dict(res_statuses)
         if jr is not None:
             stats["journal_path"] = jr.path
         models = None

@@ -1260,6 +1260,62 @@ def fit_panel(panel, p: int, d: int, q: int, engine=None,
                    **kwargs)
 
 
+def _poly_roots_batched(coefs: np.ndarray) -> np.ndarray:
+    """Roots of each ascending-coefficient polynomial row: ``(S, k+1)`` →
+    complex ``(S, k)``.  Rows whose leading coefficient is ~0 (effective
+    lower degree) or non-finite get NaN roots — the caller treats those
+    lanes as not-detectable rather than guessing a deflation."""
+    coefs = np.asarray(coefs, dtype=np.float64)
+    S, k1 = coefs.shape
+    k = k1 - 1
+    # host-side eig screen, deliberate f64 (see find_roots)
+    roots = np.full((S, k), np.nan, np.complex128)  # sts: noqa[STS004]
+    ok = (np.abs(coefs[:, -1]) > 1e-8) \
+        & np.all(np.isfinite(coefs), axis=-1)
+    if k >= 1 and np.any(ok):
+        sub = coefs[ok]
+        comp = np.zeros((sub.shape[0], k, k))       # sts: noqa[STS004]
+        comp[:, k - 1, :] = -sub[:, :k] / sub[:, k:k + 1]
+        if k > 1:
+            comp[:, :k - 1, 1:] = np.eye(k - 1)     # sts: noqa[STS004]
+        roots[ok] = np.linalg.eigvals(comp)
+    return roots
+
+
+def _cancellation_suspects(model: ARIMAModel,
+                           tol: float = 0.15) -> np.ndarray:
+    """Per-lane common-factor cancellation detection, host-side: True
+    where some AR root sits within ``tol`` (relative to the root's
+    magnitude, floor 1) of some MA root.
+
+    A near-common factor means the lane is effectively a *lower-order*
+    ARMA wearing a (p, q) costume: the shared root direction is flat in
+    the likelihood, the optimizer plateaus on a ridge (the BENCH
+    ``refit_demo`` signature — 15.3% of series at the bench shape), and
+    the honest remedy is refitting at a searched lower order, which is
+    exactly what the ``auto_order`` fallback stage does.  Off the hot
+    path: batched companion eigvals over tiny (p, p)/(q, q) matrices.
+    """
+    p, q = model.p, model.q
+    coefs = np.asarray(model.coefficients, dtype=np.float64)
+    if coefs.ndim == 1:
+        coefs = coefs[None]
+    S = coefs.shape[0]
+    if p == 0 or q == 0:
+        return np.zeros(S, bool)
+    icpt = model._icpt
+    phi = coefs[:, icpt:icpt + p]
+    theta = coefs[:, icpt + p:icpt + p + q]
+    one = np.ones((S, 1))                           # sts: noqa[STS004]
+    # AR: 1 - φ₁z - ... ; MA: 1 + θ₁z + ...  (ascending coefficients)
+    ar = _poly_roots_batched(np.concatenate([one, -phi], axis=1))
+    ma = _poly_roots_batched(np.concatenate([one, theta], axis=1))
+    dist = np.abs(ar[:, :, None] - ma[:, None, :])          # (S, p, q)
+    scale = np.maximum(1.0, np.abs(ar))[:, :, None]
+    rel = np.where(np.isfinite(dist), dist / scale, np.inf)
+    return np.min(rel.reshape(S, -1), axis=-1) < tol
+
+
 def _pad_to_order(model: ARIMAModel, p: int, q: int) -> ARIMAModel:
     """Re-express a lower-order fit as an ARIMA(p, d, q) model by
     zero-filling the absent AR/MA slots — an AR(p') fit with θ = 0 *is* an
@@ -1275,26 +1331,84 @@ def _pad_to_order(model: ARIMAModel, p: int, q: int) -> ARIMAModel:
                       model.has_intercept, diagnostics=model.diagnostics)
 
 
+def _make_auto_order_stage(p: int, d: int, q: int,
+                           max_iter: Optional[int]):
+    """The ``auto_order`` fallback stage: re-select (p', q') ≤ (p, q) for
+    the gathered failing lanes via the batched order search
+    (:func:`auto_fit_panel` over the d-differenced lanes, ``max_d=0``
+    pinning the primary's d so every lane shares the merged model's
+    static layout), and embed each winner's zero-padded coefficients in
+    the primary [c, AR(p), MA(q)] slots.  A lane "converges" in this
+    stage when the search found an admissible winner (finite AIC); its
+    ``diagnostics.fun`` carries that AIC.  Returns a
+    :class:`~spark_timeseries_tpu.utils.resilience.StageResult` so the
+    selected per-lane (p', d, q') lands in ``FitOutcome.orders``."""
+
+    def stage(v: jnp.ndarray):
+        diffed = differences_of_order_d(v, d)[..., d:] if d else v
+        with warnings.catch_warnings():
+            # failing lanes routinely have no admissible candidate or a
+            # capped screen — that is this stage's normal diet, and the
+            # outcome is reported through status codes, not warnings
+            warnings.simplefilter("ignore")
+            sel = auto_fit_panel(diffed, max_p=p, max_d=0, max_q=q,
+                                 max_iter=max_iter)
+        dtype = v.dtype
+        coefs = jnp.asarray(np.asarray(sel.coefficients), dtype)
+        conv = np.isfinite(sel.aic) \
+            & np.all(np.isfinite(sel.coefficients), axis=-1)
+        n_sub = coefs.shape[0]
+        diag = FitDiagnostics(jnp.asarray(conv),
+                              jnp.zeros((n_sub,), jnp.int32),
+                              jnp.asarray(np.asarray(sel.aic), dtype))
+        model = ARIMAModel(p, d, q, coefs, True, diagnostics=diag)
+        orders = np.asarray(sel.orders, np.int32).copy()
+        orders[:, 1] = d                     # the search ran at the
+        #                                      primary's (pinned) d
+        return _resilience.StageResult(model, orders)
+
+    return stage
+
+
 @_metrics.instrument_fit("arima", record=False, name="arima.fit_resilient")
 def fit_resilient(ts: jnp.ndarray, p: int, d: int, q: int,
                   include_intercept: bool = True,
                   fallbacks: Sequence[str] = ("ar", "mean"),
                   retry: Optional[_resilience.RetryPolicy] = None,
+                  auto_order: bool = False,
+                  cancel_tol: float = 0.15,
                   **kwargs):
     """Fail-soft batched ARIMA over a panel: health masking, multi-start
     retry, and a declarative fallback chain — ARIMA(p, d, q) →
-    ``"ar"`` (AR(p) via the direct OLS fast path, θ = 0) → ``"mean"``
-    (intercept-only drift model on the d-differenced series).
+    [``auto_order``] → ``"ar"`` (AR(p) via the direct OLS fast path,
+    θ = 0) → ``"mean"`` (intercept-only drift model on the d-differenced
+    series).
 
     ``ts (n_series, n)``.  Returns ``(model, outcome)``: an
     :class:`ARIMAModel` in the full (p, d, q) layout whose per-lane
     parameters come from the first stage that converged for that lane, and
     a :class:`~spark_timeseries_tpu.utils.resilience.FitOutcome` with
-    per-series status / health / attempts / fallback indices.  Unfittable
-    lanes (all-NaN, inf, interior gaps, too short) are skipped with an
-    explicit status and NaN parameters instead of raising; healthy lanes
-    match :func:`fit` bit-for-bit.  ``kwargs`` pass through to the primary
+    per-series status / health / attempts / fallback indices, plus the
+    effective per-lane ``orders`` (p, d, q).  Unfittable lanes (all-NaN,
+    inf, interior gaps, too short) are skipped with an explicit status
+    and NaN parameters instead of raising; healthy lanes match
+    :func:`fit` bit-for-bit.  ``kwargs`` pass through to the primary
     :func:`fit` (``method``, ``max_iter``, ...).
+
+    ``auto_order=True`` (ROADMAP item 1's resilience wiring) inserts the
+    adaptive stage ahead of the hardcoded fallbacks: lanes whose primary
+    fit failed — or *converged but plateaued* on common-factor
+    cancellation (some AR root within ``cancel_tol`` of an MA root:
+    the lane is a lower-order ARMA on a likelihood ridge) — are re-fitted
+    through the batched order search (:func:`auto_fit_panel`) over the
+    full (p', q') ≤ (p, q) grid at the primary's d, and the per-series
+    AIC winner replaces the lane *only if admissible* (suspect lanes
+    keep their converged primary result otherwise).  The selected order
+    per series is recorded in ``outcome.orders``; lanes the auto stage
+    saw but nothing rescued count into
+    ``resilience.auto_fallback_dead`` (zero-baselined by the bench
+    gate).  ``auto_order=False`` (the default) leaves the pre-existing
+    chain — stages, routing, and results — bit-for-bit untouched.
 
     One routing caveat for the bit-for-bit claim: a restart budget forces
     css-lm onto the XLA solver, while a *plain* fit of a TPU panel large
@@ -1314,6 +1428,21 @@ def fit_resilient(ts: jnp.ndarray, p: int, d: int, q: int,
     chain = [("arima", lambda v: fit.__wrapped__(
         p, d, q, v, include_intercept=include_intercept, retry=retry,
         warn=False, **kwargs))]
+    suspect_fn = None
+    if auto_order:
+        if not include_intercept:
+            raise ValueError(
+                "auto_order=True requires include_intercept=True: the "
+                "batched order search always carries an intercept slot, "
+                "and its winners must embed into the primary layout")
+        if p == 0 and q == 0:
+            raise ValueError(
+                "auto_order=True needs p > 0 or q > 0: an ARIMA(0,d,0) "
+                "primary has no lower order to search")
+        chain.append(("auto_order", _make_auto_order_stage(
+            p, d, q, kwargs.get("max_iter"))))
+        if p > 0 and q > 0:
+            suspect_fn = lambda m: _cancellation_suspects(m, cancel_tol)  # noqa: E731
     for fb in fallbacks:
         if fb == "ar" and p > 0 and q > 0:
             chain.append(("ar", lambda v: _pad_to_order(
@@ -1328,8 +1457,33 @@ def fit_resilient(ts: jnp.ndarray, p: int, d: int, q: int,
         elif fb != "ar":
             raise ValueError(f"unknown arima fallback {fb!r}; "
                              f"expected 'ar' or 'mean'")
-    return _resilience.resilient_fit(ts, chain, min_len=min_len,
-                                     family="arima")
+    model, outcome = _resilience.resilient_fit(
+        ts, chain, min_len=min_len, family="arima",
+        suspect_fn=suspect_fn)
+
+    # back-fill the static per-stage orders so outcome.orders is total:
+    # auto_order lanes already carry their searched (p', d, q')
+    status = np.asarray(outcome.status)
+    n_series = status.shape[0]
+    orders = outcome.orders
+    if orders is None:
+        orders = np.full((n_series, 3), -1, np.int32)
+    static_order = {"arima": (p, d, q), "ar": (p, d, 0),
+                    "mean": (0, d, 0)}
+    unfilled = orders[:, 0] < 0
+    primary = unfilled & np.isin(
+        status, (_resilience.STATUS_OK, _resilience.STATUS_RETRIED,
+                 _resilience.STATUS_ABANDONED))
+    orders[primary] = (p, d, q)
+    fb_used = np.asarray(outcome.fallback_used)
+    for j, (name, _) in enumerate(chain):
+        so = static_order.get(name)
+        if so is None:
+            continue
+        mask = unfilled & (status == _resilience.STATUS_FALLBACK) \
+            & (fb_used == j)
+        orders[mask] = so
+    return model, outcome._replace(orders=orders)
 
 
 @_metrics.instrument_fit("arima")

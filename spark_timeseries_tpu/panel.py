@@ -390,8 +390,11 @@ class Panel:
         max_lag), ``"arx"`` (args: x, y_max_lag, x_max_lag), ``"ewma"``,
         ``"garch"``, ``"argarch"``, ``"egarch"``, ``"holt_winters"`` (args:
         period), ``"regression_arima"`` (args: regressors).  Extra args and
-        kwargs (including ``retry=RetryPolicy(...)`` and ``fallbacks=...``
-        where supported) pass through to the family's ``fit_resilient``.
+        kwargs (including ``retry=RetryPolicy(...)``, ``fallbacks=...``,
+        and arima's ``auto_order=True`` — the adaptive searched-order
+        fallback stage, whose per-lane selections come back in
+        ``FitOutcome.orders``) pass through to the family's
+        ``fit_resilient``.
 
         Returns ``(model, outcome)`` where ``outcome`` is a
         :class:`~spark_timeseries_tpu.utils.resilience.FitOutcome` with
@@ -451,9 +454,12 @@ class Panel:
         ``deadline_s=`` for the per-chunk watchdog
         (``STS_CHUNK_DEADLINE_S``), ``retry=`` for quarantine/backoff
         retries of failed chunks, and OOM-adaptive chunk halving
-        (``degrade=``).  ``chunk_size``/``prefetch``/``collect`` and the
-        family's static fit parameters pass through.  Returns the
-        engine's :class:`~spark_timeseries_tpu.engine.StreamResult`;
+        (``degrade=``).  ``resilient=True`` routes every chunk through
+        the family's fail-soft fallback chain (``auto_order=`` included
+        for arima) instead of the AOT executables, keeping the same
+        durability scaffolding.  ``chunk_size``/``prefetch``/``collect``
+        and the family's static fit parameters pass through.  Returns
+        the engine's :class:`~spark_timeseries_tpu.engine.StreamResult`;
         an explicit :class:`~spark_timeseries_tpu.engine.FitEngine`
         instance overrides the process default."""
         from .engine import default_engine

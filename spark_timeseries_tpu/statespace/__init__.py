@@ -16,25 +16,35 @@ Holt-Winters — as batched linear-Gaussian state-space models so that
 Layout: :mod:`ssm` (representation + filter-state pytrees), :mod:`kalman`
 (the step/scan/parallel-prefix filters and likelihood accumulation),
 :mod:`convert` (fitted model → state-space form + bootstrap calibration),
-:mod:`serving` (warm sessions, tick ingest, checkpoint/restore).
+:mod:`health` (per-lane in-graph divergence detection + quarantine),
+:mod:`serving` (warm sessions, tick ingest, lane healing,
+checkpoint/restore).
 """
 
-from . import convert, kalman, serving, ssm  # noqa: F401
+from . import convert, health, kalman, serving, ssm  # noqa: F401
 from .convert import Bootstrapped, bootstrap, to_statespace  # noqa: F401
+from .health import (LANE_DIVERGED, LANE_OK, LANE_SUSPECT,  # noqa: F401
+                     HealthPolicy, LaneHealth, initial_health,
+                     monitor_panel, monitored_step)
 from .kalman import (FilterResult, concentrated_loglik,  # noqa: F401
                      filter_forecast_origin, filter_panel,
                      filter_panel_parallel, filter_step_panel,
                      forecast_mean)
-from .serving import ServingSession, TickResult, start_session  # noqa: F401
+from .serving import (ServingRestoreMismatch, ServingSession,  # noqa: F401
+                      TickResult, start_session)
 from .ssm import (FilterState, SSMeta, StateSpace,  # noqa: F401
                   initial_state, state_nbytes)
 
 __all__ = [
-    "ssm", "kalman", "convert", "serving",
+    "ssm", "kalman", "convert", "health", "serving",
     "StateSpace", "SSMeta", "FilterState", "initial_state", "state_nbytes",
     "filter_step_panel", "filter_panel", "filter_panel_parallel",
     "filter_forecast_origin", "forecast_mean",
     "concentrated_loglik", "FilterResult",
     "to_statespace", "bootstrap", "Bootstrapped",
+    "HealthPolicy", "LaneHealth", "initial_health",
+    "monitored_step", "monitor_panel",
+    "LANE_OK", "LANE_SUSPECT", "LANE_DIVERGED",
     "ServingSession", "TickResult", "start_session",
+    "ServingRestoreMismatch",
 ]

@@ -1,0 +1,199 @@
+"""Per-lane in-graph serving health: divergence detection + quarantine.
+
+A numerically diverged serving lane — NaN-poisoned state, exploding
+covariance, a model that stopped describing its stream — silently emits
+garbage forecasts for the rest of the session's life unless something
+*watches* the filter.  This module is that watcher, and it lives INSIDE
+the single jitted per-tick update (``serving._update_impl``), so
+monitoring adds zero XLA compiles after warmup and zero host round-trips
+per tick: everything here is array math over the same ``(bucket,)``
+lanes the filter already touches.  It is the serving half of the
+failure state machine ``utils.resilience`` built for batch
+(docs/design.md §3b): classify → isolate → recover, but per tick
+instead of per fit.
+
+Three signals feed one per-lane status in the ``ok(0) < suspect(1) <
+diverged(2)`` lattice:
+
+- **standardized-innovation tracking**: for a well-specified lane the
+  standardized innovation ``ν²/F`` is χ²₁ (mean 1, variance 2).  An
+  exponentially-weighted mean of it (``ew' = (1−α)·ew + α·ν²/F``, missing
+  ticks hold) has standard deviation ``σ_ew ≈ sqrt(α/(2−α) · 2)`` at
+  stationarity, so fixed thresholds are calibrated z-scores against the
+  χ² band: the defaults (α = 0.02 → σ_ew ≈ 0.142) put ``suspect`` at
+  ≈ 1 + 8.5σ and ``diverged`` at ≈ 1 + 21σ — far enough out that a
+  5000-tick well-specified stream quarantines nothing (pinned by test),
+  close enough in that a poisoned state (whose first innovation is
+  astronomically out of band) trips in one tick.
+- **non-finite detection**: any NaN/Inf in the lane's predicted state,
+  covariance, or difference ring, a non-finite innovation on an observed
+  tick, or a non-positive/non-finite innovation variance → ``diverged``
+  immediately.
+- **covariance conditioning**: the exact-mode subtractive covariance
+  update can go indefinite under f32 round-off; ``HealthPolicy.joseph``
+  routes the step through the Joseph stabilized form
+  (``kalman.filter_step_one``), which is symmetric-PSD by construction —
+  prevention for the failure the other two signals detect.
+
+``diverged`` is **sticky** and quarantines the lane: its later ticks are
+masked to missing inside the same jitted step (predict-only — the lane
+contributes no likelihood and its poison cannot spread into the
+accumulators), until ``ServingSession.heal()`` refits it from the
+bounded per-lane history ring through the batch resilient path and
+splices a fresh state in.  ``suspect`` is advisory and self-clearing:
+the lane keeps serving, the EW score decides whether it escalates or
+recovers.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from .kalman import filter_step_panel
+from .ssm import FilterState, SSMeta, StateSpace
+
+__all__ = ["LANE_OK", "LANE_SUSPECT", "LANE_DIVERGED", "LANE_NAMES",
+           "HealthPolicy", "LaneHealth", "initial_health",
+           "monitored_step", "monitor_panel"]
+
+LANE_OK = 0        # EW standardized-innovation score inside the χ² band
+LANE_SUSPECT = 1   # score out of band but finite — advisory, self-clears
+LANE_DIVERGED = 2  # non-finite state/covariance or score far out of
+#                    band — sticky; quarantined (predict-only) until heal
+
+LANE_NAMES = {LANE_OK: "ok", LANE_SUSPECT: "suspect",
+              LANE_DIVERGED: "diverged"}
+
+
+class HealthPolicy(NamedTuple):
+    """Static (hashable) health knobs — part of the serving update's jit
+    key, like :class:`~spark_timeseries_tpu.statespace.ssm.SSMeta`.
+
+    ``ew_alpha`` is the EW weight of the standardized-innovation mean;
+    ``suspect_hi`` / ``diverged_hi`` are the band edges on that mean
+    (χ²₁ has mean 1 — see the module docstring for the z-score
+    calibration of the defaults); ``joseph`` selects the stabilized
+    covariance update for exact-mode lanes; ``forecast_policy`` is what
+    quarantined lanes report from ``forecast`` — ``"nan"`` (explicitly
+    absent) or ``"last_good"`` (mean propagation from the lane's last
+    pre-divergence state)."""
+    ew_alpha: float = 0.02
+    suspect_hi: float = 2.2
+    diverged_hi: float = 4.0
+    joseph: bool = True
+    forecast_policy: str = "nan"
+
+    def validate(self) -> "HealthPolicy":
+        if not 0.0 < self.ew_alpha <= 1.0:
+            raise ValueError(f"ew_alpha must be in (0, 1], "
+                             f"got {self.ew_alpha}")
+        if not 1.0 < self.suspect_hi < self.diverged_hi:
+            raise ValueError(
+                f"need 1 < suspect_hi < diverged_hi, got "
+                f"{self.suspect_hi} / {self.diverged_hi}")
+        if self.forecast_policy not in ("nan", "last_good"):
+            raise ValueError(
+                f"forecast_policy must be 'nan' or 'last_good', "
+                f"got {self.forecast_policy!r}")
+        return self
+
+
+class LaneHealth(NamedTuple):
+    """Per-lane monitor carry, riding next to ``FilterState`` in the
+    serving session's device buffers (O(m) extra floats per lane).
+
+    ``ew`` is the EW mean of ``ν²/F`` (starts at 1.0, the χ²₁ mean —
+    the monitor needs no warmup period); ``status`` the ``LANE_*`` code;
+    ``good_a`` / ``good_ring`` snapshot the last non-diverged predicted
+    state mean and raw-difference ring, the ``"last_good"`` forecast
+    source (they stop following a lane the tick it diverges, so they
+    are never poisoned)."""
+    ew: jnp.ndarray         # (S,)
+    status: jnp.ndarray     # (S,) int32
+    good_a: jnp.ndarray     # (S, m)
+    good_ring: jnp.ndarray  # (S, d_order)
+
+
+def initial_health(state: FilterState) -> LaneHealth:
+    """All-OK monitor state seeded from a (bootstrapped) filter state."""
+    S = state.a.shape[0]
+    dtype = state.a.dtype
+    return LaneHealth(ew=jnp.ones((S,), dtype),
+                      status=jnp.zeros((S,), jnp.int32),
+                      good_a=state.a,
+                      good_ring=state.ring)
+
+
+def monitored_step(ssm: StateSpace, state: FilterState,
+                   health: LaneHealth, y: jnp.ndarray,
+                   offset: jnp.ndarray, meta: SSMeta,
+                   policy: HealthPolicy
+                   ) -> Tuple[FilterState, LaneHealth,
+                              Tuple[jnp.ndarray, jnp.ndarray]]:
+    """One health-monitored tick across the panel — the serving tier's
+    traced kernel (``meta``/``policy`` static).  Fully fused with the
+    filter step: quarantined lanes see a masked (missing) tick and
+    predict forward; everyone else filters normally, then the three
+    detection signals update the lane status.  Returns
+    ``(state', health', (v, F))``.
+    """
+    dtype = y.dtype
+    quarantined = health.status == LANE_DIVERGED
+    nan = jnp.asarray(jnp.nan, dtype)
+    y_eff = jnp.where(quarantined, nan, y)
+    state2, (v, F) = filter_step_panel(ssm, state, y_eff, offset, meta,
+                                       joseph=policy.joseph)
+
+    observed = jnp.isfinite(y_eff)
+    score = v * v / F
+    score_ok = jnp.isfinite(score)
+    alpha = jnp.asarray(policy.ew_alpha, dtype)
+    ew = jnp.where(observed & score_ok,
+                   (1.0 - alpha) * health.ew + alpha * score,
+                   health.ew)
+
+    finite = (jnp.all(jnp.isfinite(state2.a), axis=-1)
+              & jnp.all(jnp.isfinite(state2.P), axis=(-2, -1))
+              & jnp.all(jnp.isfinite(state2.ring), axis=-1)
+              & jnp.isfinite(ew))
+    f_bad = observed & ~(jnp.isfinite(F) & (F > 0))
+    v_bad = observed & ~score_ok
+    bad_now = ~finite | f_bad | v_bad
+
+    status = jnp.where(ew > policy.suspect_hi, LANE_SUSPECT, LANE_OK)
+    status = jnp.where((ew > policy.diverged_hi) | bad_now | quarantined,
+                       LANE_DIVERGED, status).astype(jnp.int32)
+
+    good = status != LANE_DIVERGED
+    good_a = jnp.where(good[:, None], state2.a, health.good_a)
+    good_ring = jnp.where(good[:, None], state2.ring, health.good_ring) \
+        if meta.d_order else health.good_ring
+    return state2, LaneHealth(ew, status, good_a, good_ring), (v, F)
+
+
+def monitor_panel(ssm: StateSpace, state: FilterState,
+                  health: LaneHealth, ys: jnp.ndarray, meta: SSMeta,
+                  policy: HealthPolicy,
+                  offsets: Optional[jnp.ndarray] = None
+                  ) -> Tuple[FilterState, LaneHealth]:
+    """Stream a whole ``(S, n)`` panel of ticks through
+    :func:`monitored_step` as one ``lax.scan`` — the batch driver for
+    calibration/false-positive testing and for bulk catch-up ingest
+    (replaying a backlog through the exact per-tick semantics, health
+    transitions included, without n host round-trips)."""
+    ys = jnp.asarray(ys)
+    offs = jnp.zeros_like(ys) if offsets is None \
+        else jnp.asarray(offsets, ys.dtype)
+
+    def step(carry, inp):
+        st, h = carry
+        y, off = inp
+        st2, h2, _ = monitored_step(ssm, st, h, y, off, meta, policy)
+        return (st2, h2), None
+
+    (final_state, final_health), _ = lax.scan(
+        step, (state, health), (ys.T, offs.T))
+    return final_state, final_health

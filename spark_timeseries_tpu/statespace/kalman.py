@@ -86,11 +86,24 @@ def _diff_step(ring: jnp.ndarray, y: jnp.ndarray, d_order: int):
 
 def filter_step_one(ssm: StateSpace, meta: SSMeta, a: jnp.ndarray,
                     P: jnp.ndarray, y: jnp.ndarray,
-                    w: jnp.ndarray):
+                    w: jnp.ndarray, joseph: bool = False):
     """One prediction-form filter step for a single lane (vmapped by the
     panel drivers).  ``w`` (0/1) is the ragged/burn-in step weight; a NaN
     ``y`` or ``w == 0`` predicts without updating.  Returns
-    ``(a', P', v, F, ll_inc, observed)``."""
+    ``(a', P', v, F, ll_inc, observed)``.
+
+    ``joseph=True`` (exact mode only; trace-time static) replaces the
+    standard covariance update with the Joseph stabilized form
+    ``P_f = (I − K_f Z) P (I − K_f Z)ᵀ + K_f H K_fᵀ`` (filtered gain
+    ``K_f = P Z / F``) followed by the prediction
+    ``P' = T P_f Tᵀ + Q`` and an explicit symmetrization.  Algebraically
+    identical to the standard form, but symmetric-PSD by construction in
+    float arithmetic — the subtractive ``P − F·KKᵀ`` can go indefinite
+    under f32 round-off on ill-conditioned lanes, which is exactly the
+    covariance-degeneracy failure the serving health monitor guards
+    (docs/design.md §3b serving half).  ``joseph=False`` is the
+    pre-existing update bit-for-bit.
+    """
     dtype = a.dtype
     two_pi = jnp.asarray(2.0 * math.pi, dtype)
     v = y - ssm.d - ssm.Z @ a
@@ -105,9 +118,18 @@ def filter_step_one(ssm: StateSpace, meta: SSMeta, a: jnp.ndarray,
     v_eff = jnp.where(obs, v, jnp.zeros((), dtype))
     a_next = ssm.T @ a + ssm.c + K * v_eff
     if meta.mode == "exact":
-        p_pred = ssm.T @ P @ ssm.T.T + ssm.Q
-        P_next = p_pred - jnp.where(obs, F, jnp.zeros((), dtype)) \
-            * jnp.outer(K, K)
+        if joseph:
+            m = a.shape[-1]
+            kf = pz / F
+            imkz = jnp.eye(m, dtype=dtype) - jnp.outer(kf, ssm.Z)
+            p_filt = imkz @ P @ imkz.T + ssm.H * jnp.outer(kf, kf)
+            p_filt = jnp.where(obs, p_filt, P)
+            P_next = ssm.T @ p_filt @ ssm.T.T + ssm.Q
+            P_next = 0.5 * (P_next + P_next.T)
+        else:
+            p_pred = ssm.T @ P @ ssm.T.T + ssm.Q
+            P_next = p_pred - jnp.where(obs, F, jnp.zeros((), dtype)) \
+                * jnp.outer(K, K)
     else:
         P_next = P
     ll_inc = jnp.where(
@@ -117,7 +139,8 @@ def filter_step_one(ssm: StateSpace, meta: SSMeta, a: jnp.ndarray,
 
 
 def _tick_one(ssm: StateSpace, meta: SSMeta, state: FilterState,
-              y: jnp.ndarray, offset: jnp.ndarray, w: jnp.ndarray):
+              y: jnp.ndarray, offset: jnp.ndarray, w: jnp.ndarray,
+              joseph: bool = False):
     """One raw-scale tick for a single lane: difference through the ring,
     load the exogenous observation ``offset`` (ARX) into the state, run
     the filter step, accumulate the likelihood pieces.
@@ -131,7 +154,7 @@ def _tick_one(ssm: StateSpace, meta: SSMeta, state: FilterState,
     ring, z = _diff_step(state.ring, y, meta.d_order)
     a_in = state.a + offset * ssm.Z
     a, P, v, F, ll_inc, obs = filter_step_one(
-        ssm, meta, a_in, state.P, z, w)
+        ssm, meta, a_in, state.P, z, w, joseph)
     zero = jnp.zeros((), state.loglik.dtype)
     return FilterState(
         a=a, P=P, ring=ring,
@@ -143,14 +166,17 @@ def _tick_one(ssm: StateSpace, meta: SSMeta, state: FilterState,
 
 def filter_step_panel(ssm: StateSpace, state: FilterState,
                       y: jnp.ndarray, offset: jnp.ndarray,
-                      meta: SSMeta):
+                      meta: SSMeta, *, joseph: bool = False):
     """One tick across the whole panel: ``y (S,)`` raw observations,
     ``offset (S,)`` exogenous observation offsets (zeros when none).
     Returns ``(state', (v, F))``.  Pure function of arrays + the static
-    ``meta`` — the serving session jits it once per (bucket, m, meta)."""
+    ``meta`` (and the static ``joseph`` covariance-form flag — see
+    :func:`filter_step_one`) — the serving session jits it once per
+    (bucket, m, meta, policy)."""
     w = jnp.ones((), y.dtype)
     return jax.vmap(
-        lambda sl, stl, yl, ol: _tick_one(sl, meta, stl, yl, ol, w)
+        lambda sl, stl, yl, ol: _tick_one(sl, meta, stl, yl, ol, w,
+                                          joseph)
     )(ssm, state, y, offset)
 
 

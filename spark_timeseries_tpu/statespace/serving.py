@@ -8,59 +8,104 @@ state* (``statespace.ssm``: O(m²) floats per series, engine-bucketed
 device buffers) and makes ingest a single cached-executable Kalman step:
 
 - :meth:`update` — one tick for the whole panel.  The executable is a
-  module-level ``jax.jit`` keyed by ``(bucket, state dim, SSMeta)``, so
-  every session of the same family/shape shares one compiled program;
-  :meth:`warmup` (or ``engine.warmup``-style pre-warming with
-  ``STS_COMPILE_CACHE`` armed) compiles it ahead of traffic, after which
-  updates trigger **zero** XLA compiles — pinned by
+  module-level ``jax.jit`` keyed by ``(bucket, state dim, SSMeta,
+  HealthPolicy)``, so every session of the same family/shape shares one
+  compiled program; :meth:`warmup` (or ``engine.warmup``-style
+  pre-warming with ``STS_COMPILE_CACHE`` armed) compiles it ahead of
+  traffic, after which updates trigger **zero** XLA compiles — pinned by
   ``tests/test_statespace.py`` exactly as ``tests/test_engine.py`` pins
   the fit engine.  There is no fit/optimizer call anywhere in the tick
   path: per-tick work is O(m²) per series, independent of history
   length.
+- **lane health** (``statespace.health``, fused into the same jitted
+  step): standardized-innovation tracking against a χ² band, non-finite
+  state/covariance detection, and Joseph-form covariance conditioning
+  feed a per-lane ``ok / suspect / diverged`` status.  Diverged lanes
+  are quarantined in-graph — their later ticks are predict-only and
+  their forecasts read NaN (or last-good, per policy) — so one poisoned
+  lane can never leak garbage into the panel's accumulators or its own
+  downstream consumers.
+- :meth:`heal` — refit quarantined lanes from the session's bounded
+  per-lane history ring through the batch resilient path
+  (``engine.fit_resilient``, auto-order fallback included) and splice
+  the recovered state-space lanes back in; the session keeps serving
+  throughout.  Counters: ``serving.diverged`` / ``serving.quarantined``
+  / ``serving.healed``.
 - :meth:`forecast` — h-step point forecasts straight off the filtered
   state (mean propagation + d-order integration through the raw
   difference ring), one cached executable per horizon.
 - :meth:`checkpoint` / :meth:`restore` — the whole session (SSM, filter
-  state, meta, tick counters) through ``utils.checkpoint``'s atomic
-  pytree writer, so a serving process restarts where it stopped.
+  state, lane health, history ring, meta, tick counters) through
+  ``utils.checkpoint``'s atomic pytree writer, so a serving process
+  restarts where it stopped.  Restore validates the checkpoint's bucket
+  geometry and ``SSMeta`` against the restoring process' engine policy
+  and raises :class:`ServingRestoreMismatch` naming the differing
+  fields (the ``JournalSpecMismatch`` discipline).
 
 Metrics: ``serving.sessions`` / ``serving.ticks`` / ``serving.updates``
-/ ``serving.forecasts`` counters, a ``serving.update`` span (p50/p95
-land in bench's ``serving_demo`` block and gate the per-tick SLO in
-``tools/bench_gate.py``), and a ``serving.state_bytes`` gauge.
+/ ``serving.forecasts`` / ``serving.diverged`` / ``serving.quarantined``
+/ ``serving.healed`` counters, ``serving.update`` and ``serving.heal``
+spans (p50/p95 land in bench's ``serving_demo`` block and gate the
+per-tick SLO and heal latency in ``tools/bench_gate.py``), and
+``serving.state_bytes`` / ``serving.quarantined_lanes`` gauges.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import NamedTuple, Optional
+from typing import Any, Dict, NamedTuple, Optional
 
 import numpy as np
 
 from ..utils import checkpoint as _checkpoint
 from ..utils import metrics as _metrics
+from ..utils import resilience as _resilience
 from .convert import Bootstrapped, bootstrap
-from .kalman import filter_step_panel
+from .health import (LANE_DIVERGED, LANE_NAMES, LANE_OK, HealthPolicy,
+                     LaneHealth, initial_health, monitored_step)
 from .ssm import FilterState, SSMeta, StateSpace, state_nbytes
 
 __all__ = ["ServingSession", "TickResult", "start_session",
-           "warmup_update", "WARMUP_FAMILIES"]
+           "warmup_update", "WARMUP_FAMILIES", "ServingRestoreMismatch",
+           "DEFAULT_HISTORY_RING"]
 
-_CHECKPOINT_FORMAT = 1
+# format 2 = health-era checkpoints (lane health + history ring + heal
+# route); format-1 checkpoints predate the health machinery and cannot
+# be resumed into a monitored session
+_CHECKPOINT_FORMAT = 2
+
+# per-lane raw-tick history kept for heal() refits (a bounded ring — the
+# session's memory stays O(ring), never O(stream))
+DEFAULT_HISTORY_RING = 512
+
+# the huge-but-finite state corruption the state_poison fault writes:
+# representable in f32, instantly astronomically out of the χ² band
+_POISON_VALUE = 1e30
 
 # families warmup_update can synthesize an executable-shaped SSM for
 # without a fitted model (the serving-capable subset of ENGINE_FAMILIES)
 WARMUP_FAMILIES = ("arima", "ar", "arx", "ewma", "holt_winters")
 
 
+class ServingRestoreMismatch(ValueError):
+    """A serving checkpoint disagrees with the restoring process' engine
+    policy or its own internal geometry (bucket size vs
+    ``engine.series_bucket``, ``SSMeta`` vs the stored arrays' shapes).
+    Raised eagerly by :meth:`ServingSession.restore` with the differing
+    fields spelled out — resuming would serve garbage or recompile per
+    tick (mirrors ``utils.durability.JournalSpecMismatch``)."""
+
+
 class TickResult(NamedTuple):
     """One :meth:`ServingSession.update`'s per-series outcome (real lanes
-    only): the innovations ``v`` (NaN where the tick was missing), their
-    predictive variances ``F``, and the per-series log-likelihood
-    increment of the tick."""
+    only): the innovations ``v`` (NaN where the tick was missing or the
+    lane is quarantined), their predictive variances ``F``, the
+    per-series log-likelihood increment of the tick, and the per-lane
+    health ``status`` (``health.LANE_OK/SUSPECT/DIVERGED``)."""
     innovations: np.ndarray
     variances: np.ndarray
     loglik_inc: np.ndarray
+    status: np.ndarray
 
 
 # ---------------------------------------------------------------------------
@@ -68,24 +113,39 @@ class TickResult(NamedTuple):
 # every session shares jax's jit cache — the STS006 discipline)
 # ---------------------------------------------------------------------------
 
-def _update_impl(meta: SSMeta, ssm: StateSpace, state: FilterState,
-                 y, offset):
-    state2, (v, f) = filter_step_panel(ssm, state, y, offset, meta)
+def _update_impl(meta: SSMeta, policy: HealthPolicy, ssm: StateSpace,
+                 state: FilterState, health: LaneHealth, y, offset):
+    """The whole per-tick program: one health-monitored Kalman step
+    (``health.monitored_step`` — filter + χ²-band tracking + non-finite
+    detection + in-graph quarantine of diverged lanes), single-jitted
+    with ``meta``/``policy`` static."""
+    state2, health2, (v, f) = monitored_step(ssm, state, health, y,
+                                             offset, meta, policy)
     ll_inc = state2.loglik - state.loglik
-    return state2, v, f, ll_inc
+    return state2, health2, v, f, ll_inc
 
 
-def _forecast_impl(meta: SSMeta, horizon: int, ssm: StateSpace,
-                   state: FilterState, offsets):
+def _forecast_impl(meta: SSMeta, horizon: int, policy: HealthPolicy,
+                   ssm: StateSpace, state: FilterState,
+                   health: LaneHealth, offsets):
     """h-step point forecasts from the predicted state — the shared
-    mean-propagation program (``kalman.forecast_mean``: ``x ←
-    T(x + offset·Z) + c`` with zero future innovations, observations
-    integrated back to the raw scale through the difference ring), so a
-    serving session and the longseries exact-forecast path compile the
-    identical executable."""
+    mean-propagation program (``kalman.forecast_mean``), health-aware:
+    quarantined lanes report NaN (``policy.forecast_policy="nan"``) or
+    propagate from their last pre-divergence state (``"last_good"``)
+    instead of serving forecasts off a poisoned state."""
+    import jax.numpy as jnp
+
     from .kalman import forecast_mean
 
-    return forecast_mean(meta, horizon, ssm, state.a, state.ring, offsets)
+    quarantined = health.status == LANE_DIVERGED
+    if policy.forecast_policy == "last_good":
+        a = jnp.where(quarantined[:, None], health.good_a, state.a)
+        ring = jnp.where(quarantined[:, None], health.good_ring,
+                         state.ring) if meta.d_order else state.ring
+        return forecast_mean(meta, horizon, ssm, a, ring, offsets)
+    fc = forecast_mean(meta, horizon, ssm, state.a, state.ring, offsets)
+    return jnp.where(quarantined[:, None],
+                     jnp.asarray(jnp.nan, fc.dtype), fc)
 
 
 _jit_lock = threading.Lock()
@@ -106,9 +166,9 @@ def _jitted(kind: str):
             from ..engine import configure_compile_cache
             configure_compile_cache()
             if kind == "update":
-                fn = jax.jit(_update_impl, static_argnums=(0,))
+                fn = jax.jit(_update_impl, static_argnums=(0, 1))
             else:
-                fn = jax.jit(_forecast_impl, static_argnums=(0, 1))
+                fn = jax.jit(_forecast_impl, static_argnums=(0, 1, 2))
             _jit_cache[kind] = fn
         return fn
 
@@ -131,8 +191,30 @@ def _pad_lanes(tree, bucket: int, n_real: int):
     return jax.tree_util.tree_map(grow, tree)
 
 
+def _heal_spec_for(model) -> Optional[Dict[str, Any]]:
+    """The batch-refit route ``heal()`` takes for this model family —
+    the family name plus the static fit arguments, JSON-plain so it
+    checkpoints.  None when no ring-history refit exists (ARX: the
+    exogenous offsets are not ring-buffered)."""
+    name = type(model).__name__
+    if name == "ARIMAModel":
+        return {"family": "arima", "p": int(model.p), "d": int(model.d),
+                "q": int(model.q),
+                "include_intercept": bool(model.has_intercept)}
+    if name == "ARModel":
+        coefs = np.asarray(model.coefficients)
+        return {"family": "ar", "max_lag": int(coefs.shape[-1])}
+    if name == "EWMAModel":
+        return {"family": "ewma"}
+    if name == "HoltWintersModel":
+        return {"family": "holt_winters", "period": int(model.period)}
+    return None
+
+
 class ServingSession:
-    """Warm per-series filter state + cached tick/forecast executables.
+    """Warm per-series filter state + cached tick/forecast executables,
+    with per-lane health monitoring, divergence quarantine, and
+    :meth:`heal`-able lanes.
 
     Build one with :meth:`start` (fitted model + its training history) or
     :meth:`restore` (a checkpoint).  Not thread-safe per instance — one
@@ -142,12 +224,18 @@ class ServingSession:
 
     def __init__(self, ssm: StateSpace, meta: SSMeta, state: FilterState,
                  n_series: int, *, ticks_seen: int = 0,
-                 registry=None):
+                 registry=None, policy: Optional[HealthPolicy] = None,
+                 health: Optional[LaneHealth] = None,
+                 heal_spec: Optional[Dict[str, Any]] = None,
+                 history_ring: int = DEFAULT_HISTORY_RING,
+                 history_tail=None, _hist_state=None):
         from ..engine import series_bucket
 
         self._reg = registry if registry is not None \
             else _metrics.get_registry()
         self.meta = meta
+        self.policy = (policy if policy is not None
+                       else HealthPolicy()).validate()
         self.n_series = int(n_series)
         self._bucket = series_bucket(self.n_series)
         self.ticks_seen = int(ticks_seen)
@@ -157,21 +245,52 @@ class ServingSession:
             self._ssm = _pad_lanes(ssm, self._bucket, ssm.n_series)
             self._state = _pad_lanes(state, self._bucket, state.a.shape[0])
         self._dtype = np.dtype(self._ssm.T.dtype)
+        self._health = initial_health(self._state) if health is None \
+            else health
+        self._heal_spec = heal_spec
+        self._status_host = np.asarray(
+            self._health.status[:self.n_series]).copy()
+        self._poisoned_specs: set = set()
+
+        # bounded per-lane raw-tick ring (real lanes only): heal()'s
+        # refit history.  O(ring) memory however long the stream runs.
+        if _hist_state is not None:
+            self._hist, self._hist_pos, self._hist_fill = _hist_state
+            self._hist_len = self._hist.shape[1]
+        else:
+            self._hist_len = max(8, int(history_ring))
+            self._hist = np.full((self.n_series, self._hist_len),
+                                 np.nan, self._dtype)
+            self._hist_pos = 0
+            self._hist_fill = 0
+            if history_tail is not None:
+                tail = np.asarray(history_tail, self._dtype)
+                tail = tail[:, -self._hist_len:]
+                k = tail.shape[1]
+                self._hist[:, :k] = tail
+                self._hist_pos = k % self._hist_len
+                self._hist_fill = k
         self._reg.inc("serving.sessions")
         self._reg.set_gauge("serving.state_bytes",
-                            state_nbytes(self._state))
+                            state_nbytes((self._state, self._health)))
 
     # -- construction -------------------------------------------------------
 
     @classmethod
-    def start(cls, model, history, *, offsets=None,
-              registry=None) -> "ServingSession":
+    def start(cls, model, history, *, offsets=None, registry=None,
+              policy: Optional[HealthPolicy] = None,
+              history_ring: int = DEFAULT_HISTORY_RING
+              ) -> "ServingSession":
         """Open a session from a fitted model pytree and the history it
         was fitted on: converts to state-space form
         (``statespace.convert.to_statespace``), filters the history to a
         warm state, calibrates σ², and buckets the per-series buffers.
         ``history (n_series, n_obs)`` (NaNs are missing ticks);
         ``offsets`` carries ARX per-tick exogenous observation offsets.
+        ``policy`` tunes the health monitor (χ² band, Joseph form,
+        quarantined-forecast policy); ``history_ring`` bounds the
+        per-lane raw-tick ring :meth:`heal` refits from (seeded with the
+        history's tail).
         """
         import jax.numpy as jnp
 
@@ -180,24 +299,34 @@ class ServingSession:
             history = history[None]
         boot: Bootstrapped = bootstrap(model, history, offsets=offsets)
         return cls(boot.ssm, boot.meta, boot.state, history.shape[0],
-                   ticks_seen=int(history.shape[1]), registry=registry)
+                   ticks_seen=int(history.shape[1]), registry=registry,
+                   policy=policy, heal_spec=_heal_spec_for(model),
+                   history_ring=history_ring,
+                   history_tail=np.asarray(history))
 
     # -- serving ------------------------------------------------------------
 
     def update(self, ticks, offset=None) -> TickResult:
-        """Ingest one tick per series — a single cached-executable Kalman
-        step, O(1) work per tick per series.
+        """Ingest one tick per series — a single cached-executable
+        health-monitored Kalman step, O(1) work per tick per series.
 
         ``ticks (n_series,)`` raw observations (NaN = missing: the lane's
-        state predicts forward and contributes no likelihood);
-        ``offset (n_series,)`` the ARX exogenous observation offsets for
-        this tick.  Returns the per-series :class:`TickResult`.
+        state predicts forward and contributes no likelihood; an Inf tick
+        degrades to missing the same way — bad wire data must not poison
+        the state); ``offset (n_series,)`` the ARX exogenous observation
+        offsets for this tick.  Quarantined (diverged) lanes are
+        predict-only regardless of the tick.  Returns the per-series
+        :class:`TickResult`, whose ``status`` reports each lane's health
+        after the tick; lanes newly entering ``diverged`` are counted
+        (``serving.diverged`` / ``serving.quarantined``) and marked on
+        the trace timeline.
         """
         host = np.asarray(ticks, self._dtype).reshape(-1)
         if host.shape[0] != self.n_series:
             raise ValueError(
                 f"update expects one tick per series ({self.n_series}), "
                 f"got {host.shape[0]}")
+        host = self._apply_faults(host)
         y = np.full((self._bucket,), np.nan, self._dtype)
         y[:self.n_series] = host
         off = np.zeros((self._bucket,), self._dtype)
@@ -206,27 +335,89 @@ class ServingSession:
                 .reshape(-1)
         fn = _jitted("update")
         with _metrics.span("serving.update"):
-            state2, v, f, ll_inc = fn(self.meta, self._ssm, self._state,
-                                      y, off)
+            state2, health2, v, f, ll_inc = fn(
+                self.meta, self.policy, self._ssm, self._state,
+                self._health, y, off)
             # materialize inside the span: the p50/p95 the bench gate
             # SLOs must cover the real per-tick latency, not the async
             # dispatch alone
             out = TickResult(
                 np.asarray(v[:self.n_series]),
                 np.asarray(f[:self.n_series]),
-                np.asarray(ll_inc[:self.n_series]))
+                np.asarray(ll_inc[:self.n_series]),
+                np.asarray(health2.status[:self.n_series]))
         self._state = state2
+        self._health = health2
+        self._note_transitions(out.status)
+        # the ring normalizes non-finite arrivals to NaN (the filter
+        # already degrades inf to a missed tick; a verbatim inf would
+        # needlessly poison heal()'s refit window for ring-length ticks)
+        self._hist[:, self._hist_pos] = np.where(np.isfinite(host),
+                                                 host, np.nan)
+        self._hist_pos = (self._hist_pos + 1) % self._hist_len
+        self._hist_fill = min(self._hist_fill + 1, self._hist_len)
         self.ticks_seen += 1
         self._reg.inc("serving.updates")
         self._reg.inc("serving.ticks", self.n_series)
         return out
 
+    def _apply_faults(self, host: np.ndarray) -> np.ndarray:
+        """Serving-tier fault injection (``utils.resilience``), all
+        host-side: corrupt incoming ticks or poison filter state for
+        deterministic lanes — the testable stand-ins for bad wire data
+        and numerical divergence."""
+        spec = _resilience.serving_fault("tick_corrupt_nan")
+        if spec is None:
+            spec = _resilience.serving_fault("tick_corrupt_inf")
+        if spec is not None:
+            host = host.copy()
+            host[::spec.lane_stride] = np.nan \
+                if spec.mode == "tick_corrupt_nan" else np.inf
+        spec = _resilience.serving_fault("state_poison")
+        token = _resilience.fault_scope_token()
+        if spec is not None and token not in self._poisoned_specs:
+            # once per fault scope per session (keyed by the scope's
+            # never-reused token — id(spec) can be recycled across
+            # scopes): a poisoned state stays poisoned on its own —
+            # re-writing it every tick would defeat the
+            # heal-then-keep-serving scenario under test
+            import jax.numpy as jnp
+
+            self._poisoned_specs.add(token)
+            rows = np.arange(self.n_series)[::spec.lane_stride]
+            a = np.asarray(self._state.a).copy()
+            a[rows] = _POISON_VALUE
+            self._state = self._state._replace(a=jnp.asarray(a))
+            _metrics.trace_instant("serving.fault.state_poison",
+                                   {"lanes": int(rows.size)})
+        return host
+
+    def _note_transitions(self, status: np.ndarray) -> None:
+        newly = (status == LANE_DIVERGED) \
+            & (self._status_host != LANE_DIVERGED)
+        n_new = int(newly.sum())
+        if n_new:
+            # divergence IS quarantine: the same tick that flags the
+            # lane also masks it predict-only in-graph
+            self._reg.inc("serving.diverged", n_new)
+            self._reg.inc("serving.quarantined", n_new)
+            _metrics.trace_instant(
+                "serving.lane_diverged",
+                {"lanes": n_new, "tick": int(self.ticks_seen)})
+        if n_new or (self._status_host == LANE_DIVERGED).any():
+            self._reg.set_gauge(
+                "serving.quarantined_lanes",
+                int(np.sum(status == LANE_DIVERGED)))
+        self._status_host = status.copy()
+
     def forecast(self, horizon: int, offsets=None) -> np.ndarray:
         """``(n_series, horizon)`` point forecasts from the current
         filtered state — mean propagation with zero future innovations,
         integrated back through the raw-difference ring for d > 0
-        families.  ``offsets (n_series, horizon)`` adds known future
-        exogenous contributions (ARX)."""
+        families.  Quarantined lanes report NaN (or last-good, per
+        ``policy.forecast_policy``) instead of garbage.  ``offsets
+        (n_series, horizon)`` adds known future exogenous contributions
+        (ARX)."""
         horizon = int(horizon)
         if horizon < 1:
             raise ValueError("forecast needs horizon >= 1")
@@ -235,8 +426,9 @@ class ServingSession:
             offs[:self.n_series] = np.asarray(offsets, self._dtype)
         fn = _jitted("forecast")
         with _metrics.span("serving.forecast"):
-            out = np.asarray(fn(self.meta, horizon, self._ssm,
-                                self._state, offs))
+            out = np.asarray(fn(self.meta, horizon, self.policy,
+                                self._ssm, self._state, self._health,
+                                offs))
         self._reg.inc("serving.forecasts")
         return out[:self.n_series]
 
@@ -251,13 +443,205 @@ class ServingSession:
         off = np.zeros((self._bucket,), self._dtype)
         fn = _jitted("update")
         with _metrics.span("serving.warmup"):
-            _, v, f, ll = fn(self.meta, self._ssm, self._state, y, off)
+            _, health2, v, f, ll = fn(self.meta, self.policy, self._ssm,
+                                      self._state, self._health, y, off)
             # also warm the real-lane result slices update materializes
             # (tiny per-(bucket, n_series) device programs of their own —
             # without this the first tick would compile them)
             np.asarray(v[:self.n_series])
             np.asarray(f[:self.n_series])
             np.asarray(ll[:self.n_series])
+            np.asarray(health2.status[:self.n_series])
+
+    # -- health + healing ---------------------------------------------------
+
+    @property
+    def lane_status(self) -> np.ndarray:
+        """Per-series health codes (``health.LANE_OK/SUSPECT/DIVERGED``)
+        after the last tick."""
+        return np.asarray(self._health.status[:self.n_series])
+
+    def health_counts(self) -> Dict[str, int]:
+        """``{status_name: lane count}`` (only nonzero entries)."""
+        s = self.lane_status
+        return {name: int(np.sum(s == code))
+                for code, name in LANE_NAMES.items()
+                if int(np.sum(s == code))}
+
+    def _ring_history(self) -> np.ndarray:
+        """The ring's ticks in chronological order, ``(n_series, k)``
+        with ``k = min(ticks stored, ring capacity)``."""
+        if self._hist_fill < self._hist_len:
+            return self._hist[:, :self._hist_fill]
+        return np.roll(self._hist, -self._hist_pos, axis=1)
+
+    @staticmethod
+    def _gapfree_suffix(hist: np.ndarray) -> np.ndarray:
+        """Per lane, NaN out everything up to and including the last
+        non-finite tick, leaving the longest gap-free suffix as a
+        leading-NaN-padded (ragged) window — the shape the batch
+        resilient path fits directly.  Without this, ONE missing tick
+        anywhere in a lane's ring window would classify the lane
+        ``interior_gap``-unfittable and make it permanently unhealable;
+        with it, the lane heals from its clean recent history (or is
+        honestly reported dead when that suffix is too short)."""
+        bad = ~np.isfinite(hist)
+        out = np.where(bad, np.nan, hist)
+        any_bad = bad.any(axis=1)
+        if any_bad.any():
+            n = hist.shape[1]
+            last_bad = n - 1 - np.argmax(bad[:, ::-1], axis=1)
+            cols = np.arange(n)
+            out[any_bad[:, None]
+                & (cols[None, :] <= last_bad[:, None])] = np.nan
+        return out
+
+    def heal(self, *, auto_order: bool = True,
+             engine=None) -> Dict[str, Any]:
+        """Refit every quarantined lane from the bounded history ring
+        through the batch resilient path and splice the recovered lanes
+        back into the live session.
+
+        The refit is the full §3b machinery — health masking, multi-start
+        retry, fallback chains, and (``auto_order=True``, arima) the
+        searched-order fallback stage — so a lane that diverged because
+        its order stopped fitting its stream comes back at a *better*
+        order, not just a re-bootstrapped copy of the old one.  Healed
+        lanes get a fresh bootstrap (σ² recalibrated on the ring
+        history), their monitor state resets to OK, and the session keeps
+        serving through the same warmed executable (same bucket/meta/
+        policy — zero new tick-path compiles).  Lanes whose refit still
+        fails stay quarantined.
+
+        Returns ``{"quarantined", "healed", "dead", ...}``; counts land
+        in ``serving.healed`` / ``serving.heal_failed`` and the
+        ``serving.heal`` span times the whole operation (the bench
+        gate's ``heal_p50``).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        status = self.lane_status
+        rows = np.flatnonzero(status == LANE_DIVERGED)
+        report: Dict[str, Any] = {"quarantined": int(rows.size),
+                                  "healed": 0, "dead": int(rows.size)}
+        if rows.size == 0:
+            return report
+        if self._heal_spec is None:
+            raise NotImplementedError(
+                f"heal() has no batch refit route for family "
+                f"{self.meta.family!r} (its exogenous offsets are not "
+                f"ring-buffered); restart the session from a fresh fit")
+        hist = self._ring_history()
+        with _metrics.span("serving.heal"):
+            # refit (and re-bootstrap) from each lane's longest gap-free
+            # recent window, as leading-NaN ragged lanes
+            sub = self._gapfree_suffix(hist[rows])
+            try:
+                model, outcome = self._heal_refit(sub, auto_order,
+                                                  engine)
+            except Exception as e:  # noqa: BLE001 — a heal that cannot
+                # refit must leave the session serving (quarantine
+                # already contains the damage), not kill it
+                self._reg.inc("serving.heal_errors")
+                _metrics.trace_instant(
+                    "serving.heal_error", {"error": type(e).__name__})
+                report["error"] = f"{type(e).__name__}: {e}"
+                return report
+            ok = np.isin(outcome.status,
+                         (_resilience.STATUS_OK,
+                          _resilience.STATUS_RETRIED,
+                          _resilience.STATUS_FALLBACK))
+            healed_rows = rows[ok]
+            if healed_rows.size:
+                ok_idx = np.flatnonzero(ok)
+
+                def take(leaf):
+                    if hasattr(leaf, "ndim") \
+                            and getattr(leaf, "ndim", 0) >= 1 \
+                            and leaf.shape[0] == rows.size:
+                        return leaf[jnp.asarray(ok_idx)]
+                    return leaf
+
+                sub_model = jax.tree_util.tree_map(take, model)
+                boot = bootstrap(sub_model, jnp.asarray(sub[ok]))
+                if boot.meta != self.meta:
+                    raise ServingRestoreMismatch(
+                        f"heal refit produced meta {boot.meta}, session "
+                        f"serves {self.meta} — the heal route drifted "
+                        f"from the session's family/order")
+                self._splice(healed_rows, boot)
+            n_healed = int(healed_rows.size)
+            n_dead = int(rows.size - n_healed)
+            self._reg.inc("serving.healed", n_healed)
+            if n_dead:
+                self._reg.inc("serving.heal_failed", n_dead)
+            self._reg.set_gauge("serving.quarantined_lanes",
+                                int(np.sum(self.lane_status
+                                           == LANE_DIVERGED)))
+            _metrics.trace_instant(
+                "serving.heal", {"quarantined": int(rows.size),
+                                 "healed": n_healed, "dead": n_dead})
+        report.update(healed=n_healed, dead=n_dead)
+        if outcome.orders is not None:
+            report["orders"] = np.asarray(outcome.orders)[ok].tolist()
+        return report
+
+    def _heal_refit(self, values: np.ndarray, auto_order: bool, engine):
+        """Batch-resilient refit of the gathered quarantined lanes,
+        routed per family (the same table ``engine.resilient_dispatch``
+        serves)."""
+        import jax.numpy as jnp
+
+        from ..engine import default_engine
+
+        eng = engine if engine is not None else default_engine()
+        spec = dict(self._heal_spec)
+        family = spec.pop("family")
+        v = jnp.asarray(values)
+        if family == "arima":
+            icpt = spec["include_intercept"]
+            auto = bool(auto_order) and icpt \
+                and (spec["p"] > 0 or spec["q"] > 0)
+            return eng.fit_resilient(v, "arima", spec["p"], spec["d"],
+                                     spec["q"], include_intercept=icpt,
+                                     auto_order=auto)
+        if family == "ar":
+            return eng.fit_resilient(v, "ar", spec["max_lag"])
+        if family == "ewma":
+            return eng.fit_resilient(v, "ewma")
+        if family == "holt_winters":
+            return eng.fit_resilient(v, "holt_winters", spec["period"])
+        raise NotImplementedError(
+            f"no heal refit route for family {family!r}")
+
+    def _splice(self, rows: np.ndarray, boot: Bootstrapped) -> None:
+        """Scatter the re-bootstrapped lanes into the live device
+        buffers and reset their monitor state.  Off the tick path —
+        the warmed update executable is untouched."""
+        import jax
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(rows)
+
+        def scatter(full, sub):
+            arr = jnp.asarray(full)
+            return arr.at[idx].set(jnp.asarray(sub, arr.dtype))
+
+        self._ssm = jax.tree_util.tree_map(scatter, self._ssm, boot.ssm)
+        self._state = jax.tree_util.tree_map(scatter, self._state,
+                                             boot.state)
+        h = self._health
+        ones = jnp.ones((rows.size,), h.ew.dtype)
+        self._health = LaneHealth(
+            ew=h.ew.at[idx].set(ones),
+            status=h.status.at[idx].set(LANE_OK),
+            good_a=scatter(h.good_a, boot.state.a),
+            good_ring=scatter(h.good_ring, boot.state.ring)
+            if self.meta.d_order else h.good_ring)
+        self._status_host[rows] = LANE_OK
+        self._reg.set_gauge("serving.state_bytes",
+                            state_nbytes((self._state, self._health)))
 
     # -- introspection ------------------------------------------------------
 
@@ -268,7 +652,7 @@ class ServingSession:
 
     @property
     def state_bytes(self) -> int:
-        return state_nbytes(self._state)
+        return state_nbytes((self._state, self._health))
 
     def describe(self) -> dict:
         return {"family": self.meta.family, "mode": self.meta.mode,
@@ -276,42 +660,101 @@ class ServingSession:
                 "state_dim": self.meta.m, "d_order": self.meta.d_order,
                 "ticks_seen": self.ticks_seen,
                 "state_bytes": self.state_bytes,
+                "history_ring": self._hist_len,
                 "dtype": str(self._dtype)}
 
     # -- persistence --------------------------------------------------------
 
     def checkpoint(self, path: str) -> None:
         """Atomically persist the whole session (``utils.checkpoint``
-        tmp+fsync+rename pytree writer): SSM, filter state, meta, and
-        tick counters — :meth:`restore` resumes serving exactly here."""
+        tmp+fsync+rename pytree writer): SSM, filter state, lane health,
+        history ring, heal route, meta, and tick counters —
+        :meth:`restore` resumes serving (and healing) exactly here."""
         _checkpoint.save_pytree_atomic(path, {
             "format": _CHECKPOINT_FORMAT,
             "meta": self.meta,
+            "policy": self.policy,
             "n_series": self.n_series,
             "ticks_seen": self.ticks_seen,
+            "bucket": self._bucket,
             "ssm": self._ssm,
             "state": self._state,
+            "health": self._health,
+            "heal_spec": self._heal_spec,
+            "hist": self._hist,
+            "hist_pos": self._hist_pos,
+            "hist_fill": self._hist_fill,
         })
         self._reg.inc("serving.checkpoints")
 
     @classmethod
     def restore(cls, path: str, *, registry=None) -> "ServingSession":
-        """Rebuild a session from :meth:`checkpoint` output (validated
-        restore — a torn or mismatched checkpoint raises
-        ``CheckpointMismatchError`` instead of serving garbage)."""
+        """Rebuild a session from :meth:`checkpoint` output.
+
+        Validated twice: ``utils.checkpoint`` rejects torn/garbled files
+        (``CheckpointMismatchError``), then the checkpoint's geometry is
+        checked against the restoring process — the saved bucket must
+        equal what ``engine.series_bucket`` now produces for
+        ``n_series`` (an engine bucket-policy change would silently
+        recompile per tick or misalign pad lanes), and the saved
+        ``SSMeta`` must describe the stored arrays.  Any disagreement
+        raises :class:`ServingRestoreMismatch` listing the differing
+        fields, instead of serving garbage."""
         blob = _checkpoint.load_pytree(path)
         fmt = blob.get("format")
         if fmt != _CHECKPOINT_FORMAT:
             raise ValueError(
                 f"serving checkpoint format {fmt!r} is not supported "
-                f"(expected {_CHECKPOINT_FORMAT})")
+                f"(expected {_CHECKPOINT_FORMAT}; format-1 checkpoints "
+                f"predate lane-health monitoring — restart those "
+                f"sessions from a fresh fit)")
         import jax.numpy as jnp
+
+        from ..engine import series_bucket
 
         ssm = StateSpace(*(jnp.asarray(leaf) for leaf in blob["ssm"]))
         state = FilterState(*(jnp.asarray(leaf)
                               for leaf in blob["state"]))
-        return cls(ssm, blob["meta"], state, blob["n_series"],
-                   ticks_seen=blob["ticks_seen"], registry=registry)
+        health = LaneHealth(*(jnp.asarray(leaf)
+                              for leaf in blob["health"]))
+        meta = blob["meta"]
+        n_series = int(blob["n_series"])
+        saved_bucket = int(blob["bucket"])
+        hist = np.asarray(blob["hist"])
+
+        diffs = []
+
+        def check(field, saved, expected):
+            if saved != expected:
+                diffs.append(f"  {field}: checkpoint={saved!r} vs "
+                             f"restoring-process={expected!r}")
+
+        check("bucket(series_bucket policy)", saved_bucket,
+              series_bucket(n_series))
+        check("meta.m(state dim)", int(meta.m), int(ssm.state_dim))
+        check("meta.d_order(ring width)", int(meta.d_order),
+              int(state.ring.shape[1]))
+        check("ssm.n_series", int(ssm.n_series), saved_bucket)
+        check("state.rows", int(state.a.shape[0]), saved_bucket)
+        check("health.rows", int(health.status.shape[0]), saved_bucket)
+        check("hist.rows", int(hist.shape[0]), n_series)
+        if meta.family not in WARMUP_FAMILIES:
+            diffs.append(f"  meta.family: checkpoint={meta.family!r} vs "
+                         f"restoring-process={WARMUP_FAMILIES}")
+        if meta.mode not in ("exact", "innovations"):
+            diffs.append(f"  meta.mode: checkpoint={meta.mode!r} vs "
+                         f"restoring-process=('exact', 'innovations')")
+        if diffs:
+            raise ServingRestoreMismatch(
+                f"serving checkpoint at {path!r} disagrees with the "
+                f"restoring session's engine policy / its own geometry; "
+                f"differing fields:\n" + "\n".join(diffs))
+        return cls(ssm, meta, state, n_series,
+                   ticks_seen=int(blob["ticks_seen"]), registry=registry,
+                   policy=blob["policy"], health=health,
+                   heal_spec=blob.get("heal_spec"),
+                   _hist_state=(hist, int(blob["hist_pos"]),
+                                int(blob["hist_fill"])))
 
 
 def start_session(model, history, **kwargs) -> ServingSession:
@@ -337,18 +780,20 @@ def _warmup_meta(family: str, p: int, d: int, q: int,
 
 def warmup_update(family: str = "arima", n_series: int = 1024, *,
                   dtype=None, p: int = 2, d: int = 1, q: int = 2,
-                  period: int = 12) -> dict:
+                  period: int = 12,
+                  policy: Optional[HealthPolicy] = None) -> dict:
     """Compile the per-tick update executable for a family/shape ahead of
     any session existing — no fitted model, no data.
 
-    The executable is keyed by ``(series bucket, state dim, SSMeta)``
-    only, so a zeros-valued SSM of the right shape compiles the exact
-    program every later :meth:`ServingSession.update` of that
-    family/order/bucket runs (``engine.warmup`` for the serving tier;
-    ``python -m spark_timeseries_tpu.engine --serving`` and bench's
-    serving demo both route here).  With ``STS_COMPILE_CACHE`` armed the
-    compile persists, and the next serving process deserializes instead
-    of compiling.  Returns a summary dict.
+    The executable is keyed by ``(series bucket, state dim, SSMeta,
+    HealthPolicy)`` only, so a zeros-valued SSM of the right shape
+    compiles the exact program every later :meth:`ServingSession.update`
+    of that family/order/bucket/policy runs (``engine.warmup`` for the
+    serving tier; ``python -m spark_timeseries_tpu.engine --serving``
+    and bench's serving demo both route here).  With
+    ``STS_COMPILE_CACHE`` armed the compile persists, and the next
+    serving process deserializes instead of compiling.  Returns a
+    summary dict.
     """
     import jax.numpy as jnp
 
@@ -357,6 +802,7 @@ def warmup_update(family: str = "arima", n_series: int = 1024, *,
     if dtype is None:
         dtype = jnp.float32
     meta = _warmup_meta(family, p, d, q, period)
+    pol = (policy if policy is not None else HealthPolicy()).validate()
     bucket = series_bucket(int(n_series))
     m = meta.m
     zeros = jnp.zeros((bucket,), dtype)
@@ -371,10 +817,11 @@ def warmup_update(family: str = "arima", n_series: int = 1024, *,
                         ring=jnp.zeros((bucket, meta.d_order), dtype),
                         loglik=zeros, ssq=zeros, sumlogf=zeros,
                         n_obs=jnp.zeros((bucket,), jnp.int32))
+    health = initial_health(state)
     y = jnp.full((bucket,), jnp.nan, dtype)
     fn = _jitted("update")
     with _metrics.span("serving.warmup"):
-        fn(meta, ssm, state, y, zeros)
+        fn(meta, pol, ssm, state, health, y, zeros)
     return {"family": family, "bucket": bucket, "state_dim": m,
             "mode": meta.mode, "d_order": meta.d_order,
             "dtype": str(np.dtype(dtype))}

@@ -83,19 +83,23 @@ def _long_combine_representative(n_series: int, n_obs: int,
 
 def _serving_update_representative(n_series: int,
                                    dtype) -> Tuple[Callable, Tuple]:
-    """The serving tier's per-tick program: one Kalman update across a
-    panel of ARIMA(2,1,2)-shaped state-space lanes — exactly what
-    ``statespace.serving.ServingSession.update`` jits, traced from its
-    flat array leaves (the ``SSMeta`` statics closed over).  ``n_obs``
-    does not apply: the whole point of the serving tier is that a tick
-    is O(1) in history length."""
+    """The serving tier's per-tick program: one *health-monitored*
+    Kalman update across a panel of ARIMA(2,1,2)-shaped state-space
+    lanes — exactly what ``statespace.serving.ServingSession.update``
+    jits (filter step + χ²-band innovation tracking + non-finite
+    detection + in-graph quarantine, Joseph-form covariance), traced
+    from its flat array leaves (the ``SSMeta``/``HealthPolicy`` statics
+    closed over).  ``n_obs`` does not apply: the whole point of the
+    serving tier is that a tick is O(1) in history length."""
     import jax
 
+    from ..statespace.health import HealthPolicy, LaneHealth
     from ..statespace.serving import _update_impl
     from ..statespace.ssm import FilterState, SSMeta, StateSpace
 
     md = 3                               # max(p, q+1) for ARIMA(2,1,2)
     meta = SSMeta("arima", "exact", 1, md)
+    policy = HealthPolicy()
     s = n_series
 
     def sd(*shape, dt=dtype):
@@ -106,12 +110,16 @@ def _serving_update_representative(n_series: int,
             sd(s, md, md), sd(s, md),                       # StateSpace
             sd(s, md), sd(s, md, md), sd(s, meta.d_order), sd(s), sd(s),
             sd(s), sd(s, dt=jnp.int32),                     # FilterState
+            sd(s), sd(s, dt=jnp.int32), sd(s, md),
+            sd(s, meta.d_order),                            # LaneHealth
             sd(s), sd(s))                                   # y, offset
 
     def update(*leaves):
         ssm = StateSpace(*leaves[:7])
         state = FilterState(*leaves[7:14])
-        return _update_impl(meta, ssm, state, leaves[14], leaves[15])
+        health = LaneHealth(*leaves[14:18])
+        return _update_impl(meta, policy, ssm, state, health,
+                            leaves[18], leaves[19])
 
     return update, args
 

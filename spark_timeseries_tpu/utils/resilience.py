@@ -44,6 +44,7 @@ retry path on every resilient fit while leaving plain fits untouched.
 from __future__ import annotations
 
 import contextlib
+import itertools
 import os
 from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
@@ -59,10 +60,10 @@ __all__ = [
     "STATUS_OK", "STATUS_RETRIED", "STATUS_FALLBACK", "STATUS_SKIPPED",
     "STATUS_ABANDONED", "STATUS_NAMES",
     "classify_series", "unfittable_mask",
-    "FitOutcome", "RetryPolicy", "retry_kwargs",
+    "FitOutcome", "RetryPolicy", "retry_kwargs", "StageResult",
     "FaultSpec", "InjectedOOM", "fault_injection", "fault_spec",
-    "chunk_fault", "forced_optimizer_failures", "corrupt_values",
-    "resilient_fit",
+    "chunk_fault", "serving_fault", "fault_scope_token",
+    "forced_optimizer_failures", "corrupt_values", "resilient_fit",
 ]
 
 # ---------------------------------------------------------------------------
@@ -163,12 +164,18 @@ class FitOutcome(NamedTuple):
     plus fallback stages actually run for the lane (0 for skipped);
     ``fallback_used`` is the index into the fit chain that produced the
     lane's parameters (-1 = the primary fit, or no stage at all).
+    ``orders (n_series, 3)`` records the effective (p, d, q) the lane's
+    parameters were selected at, for families with an order notion —
+    populated per-lane by order-searching stages (:class:`StageResult`)
+    and back-filled statically by the family wrapper; (-1, -1, -1) where
+    no stage produced the lane (skipped).  None for order-free families.
     """
     params: Optional[np.ndarray]
     status: np.ndarray
     attempts: np.ndarray
     fallback_used: np.ndarray
     health: np.ndarray
+    orders: Optional[np.ndarray] = None
 
     def counts(self) -> Dict[str, int]:
         """``{status_name: lane count}`` summary (only nonzero entries)."""
@@ -176,6 +183,17 @@ class FitOutcome(NamedTuple):
         return {name: int(np.sum(s == code))
                 for code, name in STATUS_NAMES.items()
                 if int(np.sum(s == code))}
+
+
+class StageResult(NamedTuple):
+    """Optional rich return for a fallback-chain stage: the fitted model
+    plus per-lane ``lane_orders (n_sub, 3)`` — the (p, d, q) each gathered
+    lane's parameters were actually selected at (the ``auto_order``
+    stage's contract; plain stages just return the model and the chain's
+    static order applies).  Distinguished by *type*, not tuple-ness —
+    model pytrees are themselves NamedTuples."""
+    model: Any
+    lane_orders: Optional[np.ndarray] = None
 
 
 class RetryPolicy(NamedTuple):
@@ -247,6 +265,20 @@ class FaultSpec(NamedTuple):
       chunk's journal commit — the kill-9-then-resume scenario;
     - ``"corrupt_journal"``: garble the target chunk's journal entry
       right after commit, exercising detect-quarantine-refit on resume.
+
+    Serving-tier modes (consumed host-side by
+    ``statespace.serving.ServingSession.update`` via
+    :func:`serving_fault`; deterministic per-lane stride, never traced):
+
+    - ``"tick_corrupt_nan"``: every ``lane_stride``-th lane's incoming
+      tick becomes NaN (a dropped observation) for the scope's duration;
+    - ``"tick_corrupt_inf"``: same lanes get an ``inf`` tick — bad data
+      on the wire, which the filter must degrade to a missed tick
+      instead of poisoning the lane's state;
+    - ``"state_poison"``: every ``lane_stride``-th lane's filter state
+      mean is overwritten with a huge finite value ONCE per scope per
+      session — the numerically-diverged-lane scenario the health
+      monitor must quarantine and ``heal()`` must recover.
     """
     mode: str
     n_attempts: int = 1
@@ -264,9 +296,23 @@ class InjectedOOM(RuntimeError):
 
 _VALID_MODES = ("force_nonconverge", "corrupt_nan", "corrupt_inf",
                 "hang_chunk", "oom_chunk", "kill_after_chunk",
-                "corrupt_journal")
-_CHUNK_MODES = _VALID_MODES[3:]
+                "corrupt_journal",
+                "tick_corrupt_nan", "tick_corrupt_inf", "state_poison")
+_CHUNK_MODES = _VALID_MODES[3:7]
+_SERVING_MODES = _VALID_MODES[7:]
 _active_fault: List[FaultSpec] = []
+# monotonically increasing id per fault_injection scope entry — never
+# reused, unlike id(spec) (a freed FaultSpec's address can be recycled
+# by the very next scope), so "once per scope" consumers key on this
+_scope_serial = itertools.count(1)
+_active_scope_tokens: List[int] = []
+
+
+def fault_scope_token() -> Optional[int]:
+    """Unique token of the innermost active :func:`fault_injection`
+    scope (None outside any scope).  Consumers that act once per scope
+    (the ``state_poison`` mode) remember tokens, not spec ids."""
+    return _active_scope_tokens[-1] if _active_scope_tokens else None
 
 
 def fault_spec() -> Optional[FaultSpec]:
@@ -282,6 +328,22 @@ def chunk_fault(mode: str, chunk_index: int) -> Optional[FaultSpec]:
     spec = fault_spec()
     if spec is not None and spec.mode == mode \
             and int(spec.chunk_index) == int(chunk_index):
+        return spec
+    return None
+
+
+def serving_fault(mode: str) -> Optional[FaultSpec]:
+    """The active fault spec when it is a serving-tier fault of the given
+    ``mode``, else None.  Read host-side by
+    ``statespace.serving.ServingSession.update`` — these modes corrupt
+    host tick buffers / host-visible state only and never enter traced
+    code, so no jit-cache flush is needed around their scopes."""
+    if mode not in _SERVING_MODES:
+        raise ValueError(
+            f"unknown serving fault mode {mode!r}; expected one of "
+            f"{_SERVING_MODES}")
+    spec = fault_spec()
+    if spec is not None and spec.mode == mode:
         return spec
     return None
 
@@ -347,12 +409,14 @@ def fault_injection(mode: str, n_attempts: int = 1, lane_stride: int = 2,
     spec = FaultSpec(mode, int(n_attempts), int(lane_stride),
                      int(chunk_index), float(hang_s))
     _active_fault.append(spec)
+    _active_scope_tokens.append(next(_scope_serial))
     if clear:
         _clear_jit_caches()
     try:
         yield spec
     finally:
         _active_fault.pop()
+        _active_scope_tokens.pop()
         if clear:
             _clear_jit_caches()
 
@@ -466,7 +530,8 @@ def _stack_params(model: Any, n_series: int) -> Optional[np.ndarray]:
 
 def resilient_fit(values, fits: Sequence[Tuple[str, Callable]], *,
                   min_len: int = 3, family: str = "model",
-                  registry: Optional["_metrics.MetricsRegistry"] = None
+                  registry: Optional["_metrics.MetricsRegistry"] = None,
+                  suspect_fn: Optional[Callable[[Any], np.ndarray]] = None
                   ) -> Tuple[Any, FitOutcome]:
     """Run a fallback chain of batched fits with per-lane failure isolation.
 
@@ -475,7 +540,10 @@ def resilient_fit(values, fits: Sequence[Tuple[str, Callable]], *,
     ``fit_fn(values) -> model`` must return the *same pytree structure*
     (the model-family ``fit_resilient`` wrappers guarantee this by
     re-expressing lower-order fallbacks in the primary parameter layout)
-    with a ``diagnostics.converged`` entry per lane.
+    with a ``diagnostics.converged`` entry per lane.  A stage may instead
+    return a :class:`StageResult` to additionally report the per-lane
+    (p, d, q) it selected (the ``auto_order`` stage); those land in
+    ``FitOutcome.orders``.
 
     Flow: classify lane health → replace unfittable lanes with a benign
     placeholder (their results are NaN-ed afterwards; healthy lanes are
@@ -485,11 +553,21 @@ def resilient_fit(values, fits: Sequence[Tuple[str, Callable]], *,
     A stage that *raises* is recorded and skipped — the panel never dies on
     a stage error as long as some stage returns.
 
+    ``suspect_fn(base_model) -> bool (n_series,)`` flags lanes whose
+    primary fit *converged but plateaued* (e.g. near-cancelling AR/MA
+    roots — common-factor cancellation): suspect lanes are offered to the
+    fallback chain like failed lanes, but keep their primary parameters
+    and OK/RETRIED status unless a stage actually converges them —
+    a fallback may rescue a plateau, never worsen a healthy lane.
+
     Returns ``(model, outcome)``: the merged model (primary structure,
     final diagnostics reflecting the per-lane disposition) and the
     :class:`FitOutcome`.  Counts land in the registry as
     ``resilience.<family>.*`` plus aggregate ``resilience.*`` counters and
-    ``frac_recovered`` / ``frac_fallback`` / ``frac_abandoned`` gauges.
+    ``frac_recovered`` / ``frac_fallback`` / ``frac_abandoned`` gauges;
+    lanes an ``auto``-named stage attempted but nothing rescued count
+    into ``resilience.auto_fallback_dead`` (zero-baselined by
+    ``tools/bench_gate.py``).
     """
     if not fits:
         raise ValueError("resilient_fit needs at least one fit stage")
@@ -529,6 +607,16 @@ def resilient_fit(values, fits: Sequence[Tuple[str, Callable]], *,
         errors: List[str] = []
         model = None
         base_idx = 0
+        orders: Optional[np.ndarray] = None
+
+        def _set_orders(rows_idx: np.ndarray,
+                        lane_orders: np.ndarray) -> None:
+            nonlocal orders
+            if orders is None:
+                orders = np.full((n_series, 3), -1, np.int32)
+            orders[rows_idx] = np.asarray(lane_orders,
+                                          np.int32)[:rows_idx.size]
+
         base_ctx = fault_injection("force_nonconverge", n_attempts=1,
                                    _clear_caches=False) \
             if env_armed else contextlib.nullcontext()
@@ -550,6 +638,10 @@ def resilient_fit(values, fits: Sequence[Tuple[str, Callable]], *,
             raise RuntimeError(
                 f"resilient_fit({family}): every fit stage raised — "
                 + "; ".join(errors))
+        if isinstance(model, StageResult):
+            if model.lane_orders is not None:
+                _set_orders(np.arange(n_series), model.lane_orders)
+            model = model.model
 
         diag = getattr(model, "diagnostics", None)
         if diag is None:
@@ -573,7 +665,29 @@ def resilient_fit(values, fits: Sequence[Tuple[str, Callable]], *,
         status[skipped] = STATUS_SKIPPED
         attempts[skipped] = 0
 
-        pending = ~conv & ~skipped
+        # plateau detection: converged-but-suspect lanes (near-cancelling
+        # AR/MA roots, ...) are offered to the fallback chain without
+        # losing their primary result — they keep OK/RETRIED status and
+        # parameters unless a stage actually converges them
+        suspect = np.zeros(n_series, bool)
+        if suspect_fn is not None:
+            try:
+                suspect = np.asarray(suspect_fn(model)) \
+                    .reshape(-1).astype(bool)
+            except Exception as e:  # noqa: BLE001 — detection is
+                # advisory; a detector crash must not kill the panel
+                errors.append(f"suspect_fn: {type(e).__name__}: {e}")
+                reg.inc(f"resilience.{family}.stage_errors")
+            suspect &= conv & ~skipped
+            if suspect.any():
+                reg.inc(f"resilience.{family}.suspect",
+                        int(suspect.sum()))
+                _metrics.trace_instant(
+                    f"resilience.{family}.suspect",
+                    {"lanes": int(suspect.sum())})
+
+        auto_seen = np.zeros(n_series, bool)
+        pending = (~conv | suspect) & ~skipped
         for j in range(base_idx + 1, len(fits)):
             if not pending.any():
                 break
@@ -593,7 +707,19 @@ def resilient_fit(values, fits: Sequence[Tuple[str, Callable]], *,
                 _metrics.trace_instant(
                     f"resilience.{family}.stage_error",
                     {"stage": name, "error": type(e).__name__})
+                if name.startswith("auto"):
+                    # only the order search may touch converged-but-
+                    # suspect lanes; past it (even via a stage crash)
+                    # they keep their primary fit — the simpler
+                    # fallbacks must never replace a converged model
+                    pending &= ~suspect
                 continue
+            sub_orders = None
+            if isinstance(sub, StageResult):
+                sub_orders = sub.lane_orders
+                sub = sub.model
+            if name.startswith("auto"):
+                auto_seen[rows] = True
             sub_diag = getattr(sub, "diagnostics", None)
             if sub_diag is None:
                 errors.append(f"{name}: returned model without diagnostics")
@@ -619,6 +745,13 @@ def resilient_fit(values, fits: Sequence[Tuple[str, Callable]], *,
                 status[took] = STATUS_FALLBACK
                 fallback_used[took] = j
                 pending[took] = False
+                if sub_orders is not None:
+                    _set_orders(took, np.asarray(sub_orders)[sub_conv])
+            if name.startswith("auto"):
+                # suspect lanes the order search did not rescue keep
+                # their converged primary result: drop them from pending
+                # so the hardcoded fallbacks cannot worsen them
+                pending &= ~suspect
 
         model = _nan_lanes(model, np.flatnonzero(skipped), n_series)
 
@@ -637,7 +770,23 @@ def resilient_fit(values, fits: Sequence[Tuple[str, Callable]], *,
         model = model._replace(diagnostics=final_diag)
 
         outcome = FitOutcome(_stack_params(model, n_series), status,
-                             attempts, fallback_used, health)
+                             attempts, fallback_used, health, orders)
+
+        if auto_seen.any():
+            # auto-order lanes NOTHING rescued (suspect lanes that kept
+            # their primary result are not dead — they still converged)
+            n_auto_dead = int(np.sum(auto_seen
+                                     & (status == STATUS_ABANDONED)))
+            for prefix in (f"resilience.{family}", "resilience"):
+                reg.inc(f"{prefix}.auto_fallback", int(auto_seen.sum()))
+                if n_auto_dead:
+                    # materializes only on first real death, so a clean
+                    # history zero-baselines the bench gate
+                    reg.inc(f"{prefix}.auto_fallback_dead", n_auto_dead)
+            if n_auto_dead:
+                _metrics.trace_instant(
+                    f"resilience.{family}.auto_fallback_dead",
+                    {"lanes": n_auto_dead})
 
         n_skip = int(skipped.sum())
         n_retr = int(np.sum(status == STATUS_RETRIED))

@@ -326,3 +326,239 @@ def test_resilient_model_is_usable_downstream():
     ok = np.isin(outcome.status,
                  (res.STATUS_OK, res.STATUS_RETRIED, res.STATUS_FALLBACK))
     assert np.isfinite(fc[ok][:, -5:]).all()
+
+
+# ---------------------------------------------------------------------------
+# adaptive auto-order fallback (ISSUE 9, ROADMAP item 1 resilience wiring)
+# ---------------------------------------------------------------------------
+
+def _arma11_panel(S=10, n=256, seed=0):
+    """ARMA(1,1) truth — fitted at (2, 0, 2) this is the classic
+    common-factor-cancellation plateau shape."""
+    rng = np.random.default_rng(seed)
+    e = rng.standard_normal((S, n + 8)).astype(np.float32)
+    y = np.zeros((S, n + 8), np.float32)
+    for t in range(1, n + 8):
+        y[:, t] = 0.4 + 0.6 * y[:, t - 1] + e[:, t] + 0.5 * e[:, t - 1]
+    return y[:, 8:]
+
+
+def _leaves(model):
+    return [np.asarray(leaf) for leaf in jax.tree_util.tree_leaves(model)
+            if hasattr(leaf, "dtype")]
+
+
+@pytest.mark.slow
+@pytest.mark.serving
+@pytest.mark.parametrize("family", ALL_FAMILIES)
+def test_default_chains_bitwise_unchanged_by_auto_order_machinery(family):
+    """The bitwise-equivalence regression for every family: the default
+    resilient chain is deterministic, and for arima an explicit
+    ``auto_order=False`` is bit-for-bit the default call — the new
+    suspect/StageResult/orders machinery must be invisible when off."""
+    mixed = jnp.asarray(_mixed_panel(96))
+    n_obs = mixed.shape[1]
+    rng = np.random.default_rng(5)
+    xreg = jnp.asarray(rng.standard_normal((n_obs, 2)))
+    args = {
+        "arima": (1, 0, 1), "arimax": (xreg, 1, 0, 1, 1), "ar": (2,),
+        "arx": (xreg, 1, 1), "ewma": (), "garch": (), "argarch": (),
+        "egarch": (), "holt_winters": (4,), "regression_arima": (xreg,),
+    }[family]
+    from spark_timeseries_tpu.engine import FitEngine
+    fit_fn = FitEngine.resilient_dispatch(family)
+    m1, o1 = fit_fn(mixed, *args)
+    m2, o2 = fit_fn(mixed, *args)
+    for a, b in zip(_leaves(m1), _leaves(m2)):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(o1.status, o2.status)
+    np.testing.assert_array_equal(o1.fallback_used, o2.fallback_used)
+    if family == "arima":
+        m3, o3 = fit_fn(mixed, *args, auto_order=False)
+        for a, b in zip(_leaves(m1), _leaves(m3)):
+            np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(o1.status, o3.status)
+        # orders are recorded either way for arima (total per-lane map)
+        assert o3.orders is not None
+        assert o3.orders.shape == (mixed.shape[0], 3)
+    else:
+        assert o1.orders is None
+
+
+def test_cancellation_detector_flags_common_factors():
+    from spark_timeseries_tpu.models.arima import _cancellation_suspects
+    # lane 0: AR and MA roots coincide (phi = -theta): 1-0.9z | 1-0.9z
+    # lane 1: well-separated roots; lane 2: NaN coefficients
+    coefs = np.array([[0.1, 0.9, -0.9],
+                      [0.1, 0.5, 0.5],
+                      [np.nan, np.nan, np.nan]], np.float32)
+    m = arima.ARIMAModel(1, 0, 1, jnp.asarray(coefs), True)
+    got = _cancellation_suspects(m, tol=0.15)
+    assert got.tolist() == [True, False, False]
+    # pure AR / pure MA layouts can never cancel
+    m_ar = arima.ARIMAModel(1, 0, 0, jnp.asarray(coefs[:, :2]), True)
+    assert not _cancellation_suspects(m_ar).any()
+
+
+@pytest.mark.slow
+@pytest.mark.serving
+def test_auto_order_rescues_forced_failures_with_searched_orders():
+    panel = jnp.asarray(_arma11_panel())
+    with res.fault_injection("force_nonconverge", n_attempts=10):
+        model, outcome = arima.fit_resilient(
+            panel, 2, 0, 2, auto_order=True,
+            retry=res.RetryPolicy(max_restarts=0))
+    # the auto stage (chain index 1) rescued every lane the primary
+    # could not converge, at searched orders within (2, 2)
+    rescued = outcome.fallback_used == 1
+    assert rescued.any()
+    assert (outcome.status[rescued] == res.STATUS_FALLBACK).all()
+    assert outcome.orders is not None
+    assert (outcome.orders[rescued, 0] <= 2).all()
+    assert (outcome.orders[rescued, 2] <= 2).all()
+    assert (outcome.orders[rescued, 1] == 0).all()
+    conv = np.asarray(model.diagnostics.converged)
+    np.testing.assert_array_equal(conv[rescued], True)
+
+
+@pytest.mark.skipif(FAULT_ENV, reason="fault injection forces the retry "
+                    "path; the plateau statuses differ under it")
+@pytest.mark.slow
+@pytest.mark.serving
+def test_auto_order_reselects_plateaued_lanes_without_degrading_ok():
+    """ARMA(1,1) truth fitted at (2,0,2): the cancellation detector
+    flags plateaued lanes and the auto stage re-selects a strictly
+    smaller order for at least some of them; lanes it does not rescue
+    keep their converged primary result (never worsened)."""
+    panel = jnp.asarray(_arma11_panel())
+    base, o_base = arima.fit_resilient(panel, 2, 0, 2)
+    model, outcome = arima.fit_resilient(panel, 2, 0, 2, auto_order=True)
+    reselected = outcome.fallback_used == 1
+    assert reselected.any(), "no lane was re-ordered on a plateau panel"
+    sub = outcome.orders[reselected]
+    assert ((sub[:, 0] + sub[:, 2]) < 4).all()     # strictly lower order
+    untouched = ~reselected
+    np.testing.assert_array_equal(
+        np.asarray(model.coefficients)[untouched],
+        np.asarray(base.coefficients)[untouched])
+    # every non-skipped lane still converges
+    ok = np.isin(outcome.status, (res.STATUS_OK, res.STATUS_RETRIED,
+                                  res.STATUS_FALLBACK))
+    assert ok.all()
+
+
+def test_auto_order_validates_arguments():
+    panel = jnp.asarray(_healthy_panel(3, 96))
+    with pytest.raises(ValueError, match="include_intercept"):
+        arima.fit_resilient(panel, 1, 0, 1, include_intercept=False,
+                            auto_order=True)
+    with pytest.raises(ValueError, match="p > 0 or q > 0"):
+        arima.fit_resilient(panel, 0, 1, 0, auto_order=True)
+
+
+@pytest.mark.slow
+@pytest.mark.serving
+def test_engine_bucketing_slices_orders():
+    """A non-power-of-two panel through engine.fit_resilient: pad lanes
+    sliced off the orders map too, real lanes keep a total map."""
+    from spark_timeseries_tpu.engine import FitEngine
+    panel = _arma11_panel(S=5)
+    model, outcome = FitEngine().fit_resilient(jnp.asarray(panel),
+                                               "arima", 2, 0, 2,
+                                               auto_order=True)
+    assert outcome.status.shape == (5,)
+    assert outcome.orders.shape == (5, 3)
+    assert (outcome.orders[:, 0] >= 0).all()
+
+
+@pytest.mark.slow
+@pytest.mark.serving
+def test_stream_fit_resilient_path_with_auto_order(tmp_path):
+    """stream_fit(resilient=True): chunks run the fallback chain under
+    the durability scaffolding — statuses aggregate, journal resume is
+    exact, and a different resilient spec refuses the journal."""
+    from spark_timeseries_tpu.engine import FitEngine, JournalSpecMismatch
+    panel = _arma11_panel(S=24)
+    panel[5] = np.nan
+    eng = FitEngine()
+    jr = str(tmp_path / "jr")
+    r1 = eng.stream_fit(panel, "arima", chunk_size=8, resilient=True,
+                        p=2, d=0, q=2, auto_order=True, journal=jr)
+    assert r1.stats["resilient"] is True
+    agg = r1.stats["resilient_statuses"]
+    assert agg.get("skipped") == 1
+    assert r1.n_converged == sum(agg.get(k, 0) for k in
+                                 ("ok", "retried", "fallback"))
+    r2 = eng.stream_fit(panel, "arima", chunk_size=8, resilient=True,
+                        p=2, d=0, q=2, auto_order=True, journal=jr)
+    assert r2.stats["journal_hits"] == r1.n_chunks
+    assert r2.n_converged == r1.n_converged
+    assert r2.stats["resilient_statuses"] == agg
+    with pytest.raises(JournalSpecMismatch):
+        eng.stream_fit(panel, "arima", chunk_size=8, resilient=True,
+                       p=1, d=0, q=1, journal=jr)
+
+
+@pytest.mark.slow
+@pytest.mark.serving
+def test_auto_fallback_dead_counter_zero_baseline():
+    """Lanes the auto stage saw but nothing rescued count into
+    resilience.auto_fallback_dead; a fully-rescued run leaves the
+    counter unmaterialized (the bench gate's zero-baseline)."""
+    reg = metrics.get_registry()
+    base_dead = reg.snapshot()["counters"].get(
+        "resilience.auto_fallback_dead", 0)
+    # clean rescue: no deaths recorded
+    with res.fault_injection("force_nonconverge", n_attempts=10):
+        arima.fit_resilient(jnp.asarray(_arma11_panel(S=6)), 2, 0, 2,
+                            auto_order=True,
+                            retry=res.RetryPolicy(max_restarts=0))
+    snap = reg.snapshot()["counters"]
+    assert snap.get("resilience.auto_fallback_dead", 0) == base_dead
+    assert snap.get("resilience.auto_fallback", 0) > 0
+
+
+def test_suspect_lanes_never_fall_past_the_auto_stage():
+    """Contract pin (review finding): a converged-but-suspect lane the
+    auto stage cannot rescue keeps its primary parameters and OK status
+    — the simpler hardcoded fallbacks must never replace a converged
+    model with an intercept-only one."""
+    from spark_timeseries_tpu.models.base import FitDiagnostics
+
+    n_series, n = 4, 64
+    rng = np.random.default_rng(0)
+    values = rng.standard_normal((n_series, n)).astype(np.float32)
+
+    class FakeModel:
+        pass
+
+    def make_model(rows, conv, tag):
+        import jax.numpy as jnp
+        from typing import NamedTuple, Optional
+
+        class M(NamedTuple):
+            coefficients: jnp.ndarray
+            diagnostics: Optional[FitDiagnostics] = None
+
+        coefs = jnp.full((rows, 2), float(tag), jnp.float32)
+        return M(coefs, FitDiagnostics(jnp.asarray(conv),
+                                       jnp.zeros((rows,), jnp.int32),
+                                       jnp.zeros((rows,), jnp.float32)))
+
+    primary = lambda v: make_model(v.shape[0], np.ones(v.shape[0], bool), 1)
+    auto_fails = lambda v: make_model(v.shape[0],
+                                      np.zeros(v.shape[0], bool), 2)
+    mean_takes_all = lambda v: make_model(v.shape[0],
+                                          np.ones(v.shape[0], bool), 3)
+    model, outcome = res.resilient_fit(
+        values,
+        [("primary", primary), ("auto_order", auto_fails),
+         ("mean", mean_takes_all)],
+        family="fake",
+        suspect_fn=lambda m: np.array([False, True, False, True]))
+    # every lane keeps the primary's parameters (tag 1), none fell to
+    # the mean stage, statuses stay OK
+    np.testing.assert_array_equal(np.asarray(model.coefficients),
+                                  np.full((n_series, 2), 1.0))
+    assert outcome.counts() == {"ok": n_series}
+    assert (outcome.fallback_used == -1).all()

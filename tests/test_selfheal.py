@@ -1,0 +1,439 @@
+"""Self-healing fits (ISSUE 9): serving-lane health, divergence
+quarantine, automatic refit, and the adaptive auto-order fallback.
+
+The acceptance scenario lives here: inject ``state_poison`` into k of n
+serving lanes mid-stream → those lanes (and only those) transition
+diverged→quarantined, ``heal()`` recovers them via an auto-order batch
+refit, post-heal forecasts on recovered lanes match a fresh session
+started from the same history, ``serving.healed == k`` — with the
+warmed update path still pinned at 0 recompiles.  Everything runs under
+``make verify-faults`` (the ``serving`` marker) as well as tier-1.
+
+The χ²-band calibration pin is the false-positive half of the story: a
+*well-specified* AR(2) stream of ≥ 5000 ticks must quarantine zero
+lanes, or the monitor is a pager-storm generator rather than a monitor.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_timeseries_tpu import statespace as ss
+from spark_timeseries_tpu.models import arima
+from spark_timeseries_tpu.statespace.health import (
+    LANE_DIVERGED, LANE_OK, HealthPolicy, initial_health, monitor_panel)
+from spark_timeseries_tpu.utils import metrics, resilience
+
+pytestmark = pytest.mark.serving
+
+
+def _ar2_panel(S, n, seed=0, dtype=np.float32):
+    """A stationary AR(2) panel (burn-in discarded)."""
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=(S, n + 16)).astype(dtype)
+    y = np.zeros((S, n + 16), dtype)
+    for t in range(2, n + 16):
+        y[:, t] = 0.3 + 0.5 * y[:, t - 1] - 0.2 * y[:, t - 2] + e[:, t]
+    return y[:, 16:]
+
+
+# ---------------------------------------------------------------------------
+# χ²-band calibration: zero false positives on a well-specified stream
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_chi2_band_quarantines_zero_lanes_on_well_specified_stream():
+    """≥5000 well-specified ticks across 64 lanes: the EW
+    standardized-innovation monitor must quarantine nothing (and end
+    with every lane OK) — the default band is calibrated against the
+    χ²₁ law of ν²/F, so a healthy stream stays inside it."""
+    S, n_hist, n_live = 64, 400, 5000
+    panel = _ar2_panel(S, n_hist + n_live, seed=11)
+    hist, live = panel[:, :n_hist], panel[:, n_hist:]
+    model = arima.fit(2, 0, 0, jnp.asarray(hist), warn=False)
+    sess = ss.ServingSession.start(model, hist)
+
+    # bulk path: the whole live stream through the scan driver (the
+    # exact per-tick semantics, health transitions included)
+    state, health = monitor_panel(
+        sess._ssm, sess._state, sess._health,
+        jnp.asarray(np.pad(live, ((0, sess._bucket - S), (0, 0)),
+                           constant_values=np.nan)),
+        sess.meta, sess.policy)
+    status = np.asarray(health.status[:S])
+    assert int(np.sum(status == LANE_DIVERGED)) == 0, \
+        f"{np.sum(status == LANE_DIVERGED)} false-positive quarantines"
+    assert (status == LANE_OK).all(), status
+    # and the EW scores sit where χ²₁ says they should (mean 1)
+    ew = np.asarray(health.ew[:S])
+    assert 0.5 < float(ew.mean()) < 1.5
+
+
+def test_policy_validation_rejects_nonsense():
+    with pytest.raises(ValueError, match="ew_alpha"):
+        HealthPolicy(ew_alpha=0.0).validate()
+    with pytest.raises(ValueError, match="suspect_hi"):
+        HealthPolicy(suspect_hi=5.0, diverged_hi=4.0).validate()
+    with pytest.raises(ValueError, match="forecast_policy"):
+        HealthPolicy(forecast_policy="banana").validate()
+
+
+def test_joseph_form_matches_standard_update():
+    """The Joseph stabilized covariance update is algebraically the
+    standard one — same filtered states/covariances to float rounding
+    on a well-conditioned lane."""
+    from spark_timeseries_tpu.statespace.kalman import filter_step_panel
+    from spark_timeseries_tpu.statespace.ssm import SSMeta, initial_state
+    from spark_timeseries_tpu.statespace.convert import companion_arma
+
+    phi = jnp.asarray(np.array([[0.5, -0.2], [0.3, 0.1]], np.float32))
+    theta = jnp.asarray(np.array([[0.4], [-0.3]], np.float32))
+    ssm = companion_arma(phi, theta)
+    meta = SSMeta("arima", "exact", 0, ssm.state_dim)
+    st = initial_state(ssm, meta)
+    y = jnp.asarray(np.array([0.7, -1.1], np.float32))
+    off = jnp.zeros((2,), jnp.float32)
+    a, (va, fa) = filter_step_panel(ssm, st, y, off, meta, joseph=False)
+    b, (vb, fb) = filter_step_panel(ssm, st, y, off, meta, joseph=True)
+    np.testing.assert_allclose(np.asarray(a.a), np.asarray(b.a),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(a.P), np.asarray(b.P),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+    # Joseph output is symmetric by construction
+    P = np.asarray(b.P)
+    np.testing.assert_array_equal(P, np.swapaxes(P, -1, -2))
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario: poison → quarantine → heal → serve on
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_state_poison_quarantine_heal_end_to_end():
+    S, n_hist, ring = 8, 300, 256
+    k = 3                                    # lanes poisoned (stride 3)
+    panel = _ar2_panel(S, n_hist + 60, seed=5)
+    hist, live = panel[:, :n_hist], panel[:, n_hist:]
+
+    reg = metrics.MetricsRegistry()
+    metrics.install_jax_hooks()
+    model = arima.fit(2, 0, 0, jnp.asarray(hist), warn=False)
+    sess = ss.ServingSession.start(model, hist, registry=reg,
+                                   history_ring=ring)
+    sess.warmup()
+    sess.forecast(6)                         # precompile the horizon
+    fed = []
+    for t in range(20):
+        out = sess.update(live[:, t])
+        fed.append(live[:, t])
+    assert sess.health_counts() == {"ok": S}
+
+    before = metrics.jax_stats()["jit_compiles"]
+    with resilience.fault_injection("state_poison", lane_stride=3):
+        out = sess.update(live[:, 20])
+        fed.append(live[:, 20])
+    poisoned = np.arange(S)[::3]
+    assert poisoned.size == k
+    # those lanes, and only those, transitioned diverged→quarantined
+    assert (out.status[poisoned] == LANE_DIVERGED).all()
+    others = np.setdiff1d(np.arange(S), poisoned)
+    assert (out.status[others] == LANE_OK).all()
+    assert reg.snapshot()["counters"]["serving.diverged"] == k
+    assert reg.snapshot()["counters"]["serving.quarantined"] == k
+
+    # quarantined lanes: predict-only ticks (NaN innovations), NaN
+    # forecasts; healthy lanes unaffected
+    out2 = sess.update(live[:, 21])
+    fed.append(live[:, 21])
+    assert np.isnan(out2.innovations[poisoned]).all()
+    assert np.isfinite(out2.innovations[others]).all()
+    fc = sess.forecast(6)
+    assert np.isnan(fc[poisoned]).all()
+    assert np.isfinite(fc[others]).all()
+
+    # the warmed tick path never recompiled through poison + quarantine
+    assert metrics.jax_stats()["jit_compiles"] - before == 0
+
+    # heal: auto-order batch refit from the ring, spliced back in (the
+    # refit itself may compile — it is explicitly OFF the tick path)
+    report = sess.heal()
+    assert report["quarantined"] == k
+    assert report["healed"] == k
+    assert report["dead"] == 0
+    assert reg.snapshot()["counters"]["serving.healed"] == k
+    assert sess.health_counts() == {"ok": S}
+
+    # and post-heal ticks still serve through the same warmed
+    # executable (same bucket/meta/policy): zero new compiles
+    before2 = metrics.jax_stats()["jit_compiles"]
+    out3 = sess.update(live[:, 22])
+    fed.append(live[:, 22])
+    sess.forecast(6)
+    assert metrics.jax_stats()["jit_compiles"] - before2 == 0
+    assert np.isfinite(out3.innovations).all()
+
+    # post-heal forecasts on recovered lanes == a fresh session started
+    # from the same (ring) history via the same resilient refit
+    from spark_timeseries_tpu.engine import default_engine
+    all_ticks = np.concatenate([hist] + [c[:, None] for c in fed[:-1]],
+                               axis=1)
+    expected_hist = all_ticks[:, -ring:][poisoned]
+    model2, out_r = default_engine().fit_resilient(
+        jnp.asarray(expected_hist), "arima", 2, 0, 0,
+        include_intercept=True, auto_order=True)
+    fresh = ss.ServingSession.start(model2, expected_hist)
+    fresh.update(fed[-1][poisoned])
+    want = fresh.forecast(6)
+    got = sess.forecast(6)[poisoned]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.slow
+def test_tick_corruption_faults_degrade_to_missing():
+    """NaN and Inf wire corruption on strided lanes: the filter treats
+    both as missed ticks — no divergence, state stays finite, healthy
+    lanes keep their likelihood flowing."""
+    S = 6
+    panel = _ar2_panel(S, 340, seed=9)
+    hist, live = panel[:, :300], panel[:, 300:]
+    model = arima.fit(2, 0, 0, jnp.asarray(hist), warn=False)
+    sess = ss.ServingSession.start(model, hist)
+    for mode in ("tick_corrupt_nan", "tick_corrupt_inf"):
+        with resilience.fault_injection(mode, lane_stride=2):
+            out = sess.update(live[:, 0])
+        assert np.isnan(out.innovations[::2]).all() \
+            or not np.isfinite(out.innovations[::2]).all()
+        assert out.loglik_inc[::2].sum() == 0.0
+        assert (out.status == LANE_OK).all(), (mode, out.status)
+        assert np.isfinite(np.asarray(sess._state.a)).all(), mode
+
+
+@pytest.mark.slow
+def test_state_poison_applies_once_per_scope():
+    S = 4
+    panel = _ar2_panel(S, 320, seed=13)
+    hist, live = panel[:, :300], panel[:, 300:]
+    model = arima.fit(2, 0, 0, jnp.asarray(hist), warn=False)
+    sess = ss.ServingSession.start(model, hist)
+    with resilience.fault_injection("state_poison", lane_stride=2):
+        sess.update(live[:, 0])
+        healed = sess.heal()                  # inside the scope:
+        out = sess.update(live[:, 1])         # must NOT re-poison
+    assert healed["healed"] == 2
+    assert (out.status == LANE_OK).all()
+
+
+@pytest.mark.slow
+def test_last_good_forecast_policy():
+    """forecast_policy="last_good": quarantined lanes forecast from
+    their last pre-divergence state instead of NaN."""
+    S = 4
+    panel = _ar2_panel(S, 330, seed=21)
+    hist, live = panel[:, :300], panel[:, 300:]
+    model = arima.fit(2, 0, 0, jnp.asarray(hist), warn=False)
+    sess = ss.ServingSession.start(
+        model, hist, policy=HealthPolicy(forecast_policy="last_good"))
+    sess.update(live[:, 0])
+    want = sess.forecast(4).copy()            # all lanes healthy here
+    with resilience.fault_injection("state_poison", lane_stride=2):
+        # an OBSERVED tick: the astronomical innovation flags the lane
+        # the same step it is poisoned, so the good-state snapshot
+        # freezes at the pre-poison state (a silent all-missing stream
+        # on a finitely-poisoned state is undetectable by innovations)
+        sess.update(live[:, 1])
+    assert (sess.lane_status[::2] == LANE_DIVERGED).all()
+    fc = sess.forecast(4)
+    assert np.isfinite(fc).all()
+    # poisoned lanes serve the pre-poison (last good) mean path
+    np.testing.assert_allclose(fc[::2], want[::2], rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_heal_with_no_quarantined_lanes_is_a_noop():
+    S = 3
+    panel = _ar2_panel(S, 320, seed=31)
+    model = arima.fit(2, 0, 0, jnp.asarray(panel[:, :300]), warn=False)
+    sess = ss.ServingSession.start(model, panel[:, :300])
+    assert sess.heal() == {"quarantined": 0, "healed": 0, "dead": 0}
+
+
+# ---------------------------------------------------------------------------
+# checkpoint round-trip + restore validation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_checkpoint_roundtrips_health_and_ring(tmp_path):
+    S = 5
+    panel = _ar2_panel(S, 330, seed=41)
+    hist, live = panel[:, :300], panel[:, 300:]
+    model = arima.fit(2, 0, 0, jnp.asarray(hist), warn=False)
+    sess = ss.ServingSession.start(model, hist, history_ring=64)
+    with resilience.fault_injection("state_poison", lane_stride=2):
+        sess.update(live[:, 0])
+    path = str(tmp_path / "health.ckpt")
+    sess.checkpoint(path)
+    back = ss.ServingSession.restore(path)
+    assert back.describe() == sess.describe()
+    np.testing.assert_array_equal(back.lane_status, sess.lane_status)
+    np.testing.assert_array_equal(back._ring_history(),
+                                  sess._ring_history())
+    # the restored session heals exactly like the original would
+    a = sess.heal()
+    b = back.heal()
+    assert a["healed"] == b["healed"] == 3  # ceil(5/2) strided lanes
+    ta = sess.update(live[:, 1])
+    tb = back.update(live[:, 1])
+    np.testing.assert_allclose(ta.innovations, tb.innovations,
+                               rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.slow
+def test_restore_rejects_geometry_mismatch(tmp_path):
+    """A checkpoint whose recorded bucket disagrees with the restoring
+    process' series_bucket policy (or whose SSMeta disagrees with its
+    own arrays) raises ServingRestoreMismatch naming the fields."""
+    from spark_timeseries_tpu.utils import checkpoint as ckpt
+
+    S = 4
+    panel = _ar2_panel(S, 320, seed=51)
+    model = arima.fit(1, 0, 1, jnp.asarray(panel[:, :300]), warn=False)
+    sess = ss.ServingSession.start(model, panel[:, :300])
+    path = str(tmp_path / "geom.ckpt")
+    sess.checkpoint(path)
+    blob = ckpt.load_pytree(path)
+
+    bad = dict(blob)
+    bad["bucket"] = 16                        # bucket-policy drift
+    p2 = str(tmp_path / "badbucket.ckpt")
+    ckpt.save_pytree_atomic(p2, bad)
+    with pytest.raises(ss.ServingRestoreMismatch,
+                       match="bucket"):
+        ss.ServingSession.restore(p2)
+
+    bad = dict(blob)
+    bad["meta"] = bad["meta"]._replace(d_order=3)   # meta vs arrays
+    p3 = str(tmp_path / "badmeta.ckpt")
+    ckpt.save_pytree_atomic(p3, bad)
+    with pytest.raises(ss.ServingRestoreMismatch, match="d_order"):
+        ss.ServingSession.restore(p3)
+
+
+def test_restore_rejects_preheath_format(tmp_path):
+    from spark_timeseries_tpu.utils import checkpoint as ckpt
+    path = str(tmp_path / "old.ckpt")
+    ckpt.save_pytree_atomic(path, {"format": 1})
+    with pytest.raises(ValueError, match="format"):
+        ss.ServingSession.restore(path)
+
+
+# ---------------------------------------------------------------------------
+# bench gate wiring for the self-healing counters
+# ---------------------------------------------------------------------------
+
+def test_bench_gate_extracts_selfheal_counters():
+    from tools.bench_gate import METRICS, extract_metrics
+
+    names = [m[0] for m in METRICS]
+    assert "serving_diverged_lanes" in names
+    assert "resilience_auto_fallback_dead" in names
+    assert "heal_p50" in names
+
+    # block present + key absent = a measured 0 (the zero-baseline rule)
+    h = {"value": 1.0, "metrics": {
+        "serving": {"serving.updates": 10},
+        "fit_counters": {"fit.arima.calls": 1},
+        "spans": {}}}
+    got = extract_metrics(h)
+    assert got["serving_diverged_lanes"] == 0.0
+    assert got["resilience_auto_fallback_dead"] == 0.0
+    assert "heal_p50" not in got              # tolerated-absent
+
+    # real values flow through, heal span by path leaf
+    h = {"value": 1.0, "metrics": {
+        "serving": {"serving.diverged": 4},
+        "fit_counters": {"resilience.auto_fallback_dead": 2},
+        "spans": {"bench.serving_demo/serving.heal":
+                  {"count": 1, "p50_s": 0.5}}}}
+    got = extract_metrics(h)
+    assert got["serving_diverged_lanes"] == 4.0
+    assert got["resilience_auto_fallback_dead"] == 2.0
+    assert got["heal_p50"] == 0.5
+
+    # blocks absent entirely (pre-serving rounds) -> no fabricated zeros
+    got = extract_metrics({"value": 1.0, "metrics": {"spans": {}}})
+    assert "serving_diverged_lanes" not in got
+    assert "resilience_auto_fallback_dead" not in got
+
+
+def test_bench_gate_flags_first_diverging_round():
+    from tools.bench_gate import evaluate
+
+    def mk(r, diverged=None):
+        serving = {"serving.updates": 5}
+        if diverged is not None:
+            serving["serving.diverged"] = diverged
+        return {"round": r, "rc": 0, "path": f"r{r}", "headline": {
+            "metric": "t", "value": 100.0, "platform": "cpu",
+            "metrics": {"serving": serving, "spans": {}}}}
+
+    clean = [mk(r) for r in range(1, 4)]
+    verdict = evaluate(clean + [mk(4, diverged=7)])
+    row = next(r for r in verdict["rows"]
+               if r["metric"] == "serving_diverged_lanes")
+    assert row["status"] == "REGRESSED"
+    assert verdict["status"] == "regressed"
+    verdict = evaluate(clean + [mk(4)])
+    row = next(r for r in verdict["rows"]
+               if r["metric"] == "serving_diverged_lanes")
+    assert row["status"] == "ok"
+
+
+@pytest.mark.slow
+def test_heal_survives_missing_ticks_in_ring_history():
+    """Review-finding pin: a missing (NaN) or inf tick inside the ring
+    window must not make a lane permanently unhealable — heal refits
+    from the lane's longest gap-free suffix."""
+    S = 4
+    panel = _ar2_panel(S, 360, seed=71)
+    hist, live = panel[:, :300], panel[:, 300:]
+    model = arima.fit(2, 0, 0, jnp.asarray(hist), warn=False)
+    sess = ss.ServingSession.start(model, hist, history_ring=128)
+    # a missing tick and a wire-corrupt inf tick land in every lane's
+    # ring window...
+    gap = live[:, 0].copy()
+    gap[:] = np.nan
+    sess.update(gap)
+    inf_tick = live[:, 1].copy()
+    inf_tick[:] = np.inf
+    sess.update(inf_tick)
+    # ...followed by plenty of clean history
+    for t in range(2, 50):
+        sess.update(live[:, t])
+    with resilience.fault_injection("state_poison", lane_stride=2):
+        sess.update(live[:, 50])
+    assert (sess.lane_status[::2] == LANE_DIVERGED).all()
+    report = sess.heal()
+    assert report["healed"] == 2, report
+    assert sess.health_counts() == {"ok": S}
+
+
+@pytest.mark.slow
+def test_state_poison_fires_once_per_scope_across_scopes():
+    """Review-finding pin: two sequential fault scopes each poison once
+    (scope tokens, not recyclable id(spec))."""
+    S = 4
+    panel = _ar2_panel(S, 340, seed=81)
+    hist, live = panel[:, :300], panel[:, 300:]
+    model = arima.fit(2, 0, 0, jnp.asarray(hist), warn=False)
+    sess = ss.ServingSession.start(model, hist)
+    with resilience.fault_injection("state_poison", lane_stride=2):
+        sess.update(live[:, 0])
+    assert (sess.lane_status[::2] == LANE_DIVERGED).all()
+    assert sess.heal()["healed"] == 2
+    assert sess.health_counts() == {"ok": S}
+    # a brand-new scope must poison again
+    with resilience.fault_injection("state_poison", lane_stride=2):
+        sess.update(live[:, 1])
+    assert (sess.lane_status[::2] == LANE_DIVERGED).all()

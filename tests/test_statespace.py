@@ -474,10 +474,13 @@ def test_1024_series_tick_is_one_cached_step():
         out = sess.update(rng.normal(size=n_series))
         assert out.innovations.shape == (n_series,)
     assert metrics.jax_stats()["jit_compiles"] - before == 0
-    # state really is O(m²) per series, not O(history)
+    # state really is O(m²) per series, not O(history): the filter carry
+    # (a, P, ring, 3 accumulators, n_obs) plus the health monitor's
+    # O(m) leaves (ew, status, good_a, good_ring)
     m = sess.describe()["state_dim"]
+    d = sess.describe()["d_order"]
     per_series = sess.state_bytes / sess.describe()["bucket"]
-    assert per_series <= 8 * (m * m + m + 5)
+    assert per_series <= 8 * (m * m + m + 5) + 4 * (m + d + 2)
 
 
 def test_sessions_share_one_executable_across_instances():

@@ -16,20 +16,23 @@ automated check (``make gate``):
   the trailing ``--window`` comparable rounds and fails past the
   metric's threshold:
 
-  =====================  ==========================================  ======
-  metric                 source                                      worse
-  =====================  ==========================================  ======
-  throughput             headline ``value`` (series/sec)             lower
-  fit_wall_s             ``metrics.spans["bench.fit_panel"]`` p50    higher
-  compile_s_total        ``metrics.compile_s_total``                 higher
-  jit_compiles           ``metrics.jit_compiles``                    higher
-  engine_cache_misses    ``metrics.engine["engine.cache_misses"]``   higher
-  engine_chunk_failures  ``metrics.engine["engine.chunk_failures"]`` higher
-  engine_dead_chunks     ``metrics.engine["engine.dead_chunks"]``    higher
-  serving_update_p50     ``metrics.spans["serving.update"]`` p50     higher
-  serving_update_p95     ``metrics.spans["serving.update"]`` p95     higher
-  long_obs_per_s         headline ``long_demo.obs_per_s``            lower
-  =====================  ==========================================  ======
+  ============================  ============================================  ======
+  metric                        source                                        worse
+  ============================  ============================================  ======
+  throughput                    headline ``value`` (series/sec)               lower
+  fit_wall_s                    ``metrics.spans["bench.fit_panel"]`` p50      higher
+  compile_s_total               ``metrics.compile_s_total``                   higher
+  jit_compiles                  ``metrics.jit_compiles``                      higher
+  engine_cache_misses           ``metrics.engine["engine.cache_misses"]``     higher
+  engine_chunk_failures         ``metrics.engine["engine.chunk_failures"]``   higher
+  engine_dead_chunks            ``metrics.engine["engine.dead_chunks"]``      higher
+  serving_update_p50            ``metrics.spans["serving.update"]`` p50       higher
+  serving_update_p95            ``metrics.spans["serving.update"]`` p95       higher
+  serving_diverged_lanes        ``metrics.serving["serving.diverged"]``       higher
+  resilience_auto_fallback_dead ``metrics.fit_counters[...auto_fallback_dead]`` higher
+  heal_p50                      ``metrics.spans["serving.heal"]`` p50         higher
+  long_obs_per_s                headline ``long_demo.obs_per_s``              lower
+  ============================  ============================================  ======
 
   (``engine_cache_misses`` is the streaming engine's executable-cache
   miss count — a >50% jump over the trailing median means fits stopped
@@ -49,6 +52,18 @@ automated check (``make gate``):
   a >25% jump over the trailing median means tick ingest itself got
   slower — a recompile leaking into the hot path, a bucket policy
   change, or per-tick work that stopped being O(1).
+
+  ``serving_diverged_lanes`` and ``resilience_auto_fallback_dead`` are
+  the self-healing tier's reliability counters (ISSUE 9), zero-baselined
+  exactly like the engine's: when the record carries a ``serving`` /
+  ``fit_counters`` block but the counter key is absent, the run was
+  CLEAN and the value is a real 0 (registry counters materialize on
+  first increment) — so any round where serving lanes started diverging,
+  or where the auto-order fallback stage started losing lanes it was
+  offered, is flagged by the zero-baseline rule even though a 0 baseline
+  admits no percentage.  ``heal_p50`` is the ``serving.heal`` span's
+  median — the wall cost of one quarantine-refit-splice cycle — and is
+  tolerated-absent in rounds that never healed (or predate healing).
 
   ``long_obs_per_s`` is the ultra-long tier's end-to-end throughput
   (ISSUE 8): the bench's ``long_demo`` fits one 10⁶-observation
@@ -99,6 +114,9 @@ METRICS = [
     ("engine_dead_chunks", "lower_better", 50.0),
     ("serving_update_p50", "lower_better", 25.0),
     ("serving_update_p95", "lower_better", 25.0),
+    ("serving_diverged_lanes", "lower_better", 50.0),
+    ("resilience_auto_fallback_dead", "lower_better", 50.0),
+    ("heal_p50", "lower_better", 50.0),
     ("long_obs_per_s", "higher_better", 25.0),
 ]
 
@@ -204,6 +222,12 @@ def extract_metrics(headline: Optional[dict]) -> Dict[str, float]:
                     out["serving_update_p50"] = float(upd["p50_s"])
                 if isinstance(upd.get("p95_s"), (int, float)):
                     out["serving_update_p95"] = float(upd["p95_s"])
+            # heal latency: tolerated-absent — rounds that never healed
+            # (or predate healing) contribute no baseline sample
+            heal = _leaf_span(spans, "serving.heal")
+            if isinstance(heal, dict) and heal.get("count") \
+                    and isinstance(heal.get("p50_s"), (int, float)):
+                out["heal_p50"] = float(heal["p50_s"])
         if isinstance(m.get("compile_s_total"), (int, float)):
             out["compile_s_total"] = float(m["compile_s_total"])
         if isinstance(m.get("jit_compiles"), (int, float)):
@@ -225,6 +249,18 @@ def extract_metrics(headline: Optional[dict]) -> Dict[str, float]:
                 v = eng.get(key, 0)
                 if isinstance(v, (int, float)):
                     out[name] = float(v)
+        # self-healing reliability counters (ISSUE 9), zero-baselined
+        # like the engine's: block present + key absent = a measured 0
+        sv = m.get("serving")
+        if isinstance(sv, dict):
+            v = sv.get("serving.diverged", 0)
+            if isinstance(v, (int, float)):
+                out["serving_diverged_lanes"] = float(v)
+        fc = m.get("fit_counters")
+        if isinstance(fc, dict):
+            v = fc.get("resilience.auto_fallback_dead", 0)
+            if isinstance(v, (int, float)):
+                out["resilience_auto_fallback_dead"] = float(v)
     return out
 
 
