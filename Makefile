@@ -14,8 +14,8 @@ WARMUP_SERVING ?=
 STS_COMPILE_CACHE ?=
 
 .PHONY: help verify compileall tier1 verify-faults verify-durability \
-	verify-perf verify-serving verify-long gate trace lint lint-baseline \
-	contracts verify-static warmup
+	verify-perf verify-serving verify-long verify-telemetry gate trace \
+	lint lint-baseline contracts verify-static warmup
 
 help:
 	@echo "Targets:"
@@ -35,6 +35,8 @@ help:
 	@echo "                exact-likelihood ARIMA, session checkpoint/restore, 0-recompile pin)"
 	@echo "  verify-long   ultra-long-series suite (DARIMA split-and-combine: segmentation,"
 	@echo "                AR-truncation combiner, journaled segment streams, exact forecast)"
+	@echo "  verify-telemetry live telemetry suite (scrape exporter lifecycle, heartbeats/ETA,"
+	@echo "                serving SLO windows, flight-recorder bundles incl. kill -9 forensics)"
 	@echo "  verify-perf   perf gate: newest BENCH_r*.json vs trailing-median baseline"
 	@echo "  gate          same as verify-perf (tools/bench_gate.py; exit 1 on regression)"
 	@echo "  trace         run a small demo workload, write trace.json (open in ui.perfetto.dev)"
@@ -90,7 +92,7 @@ tier1:
 # false-positive pin, which use the tick_corrupt_* / state_poison fault
 # modes) runs under the same env, so heal()'s batch refit exercises its
 # forced-retry path too.
-verify-faults: verify-durability
+verify-faults: verify-durability verify-telemetry
 	STS_FAULT_INJECT=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 		-m 'not slow' --continue-on-collection-errors \
 		-p no:cacheprovider -p no:xdist -p no:randomly
@@ -112,6 +114,18 @@ verify-durability:
 		-p no:xdist -p no:randomly
 	STS_CHUNK_DEADLINE_S=300 STS_CHUNK_RETRIES=1 JAX_PLATFORMS=cpu \
 		$(PY) -m pytest tests/ -q -m durability \
+		--continue-on-collection-errors -p no:cacheprovider \
+		-p no:xdist -p no:randomly
+
+# telemetry-plane gate (ISSUE 10): the `telemetry`-marked subset —
+# exporter lifecycle (all four routes scraped during a live stream,
+# clean shutdown, double-start rejection), heartbeat/ETA/staleness
+# contract, serving SLO windows + 0-recompile pin with the exporter
+# armed, Prometheus-grammar + concurrent-scrape hammer, and the
+# flight recorder (bundle schema, retention, kill -9 forensics +
+# journal resume); includes the slow subprocess cases tier-1 skips
+verify-telemetry:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m telemetry \
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly
 

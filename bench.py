@@ -300,6 +300,54 @@ def main():
     # self-disarms after one probe on platforms with no memory stats
     costs.install_device_memory_sampler()
 
+    # live telemetry plane (ISSUE 10): every bench runs with the scrape
+    # exporter armed on a free port (BENCH_TELEMETRY_PORT pins one), so
+    # an operator can `python -m tools.sts_top <url>` a long bench and
+    # every record's metrics block carries measured scrape latencies +
+    # heartbeat-gauge presence.  The flight recorder arms off
+    # STS_INCIDENT_DIR as usual; its incidents.written counter lands in
+    # the telemetry block, where tools/bench_gate.py zero-baselines it
+    # (a bench round must not organically crash).
+    telem_server = None
+    try:
+        from spark_timeseries_tpu.utils import telemetry as sts_telemetry
+        telem_server = sts_telemetry.start(
+            port=int(os.environ.get("BENCH_TELEMETRY_PORT", "0")))
+        print(f"# telemetry exporter at {telem_server.url}",
+              file=sys.stderr, flush=True)
+    except Exception as e:        # noqa: BLE001 — optional accounting;
+        # a bench must measure even when the port is unavailable
+        print(f"# telemetry exporter failed to start: "
+              f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+
+    def _telemetry_block(snap: dict) -> dict:
+        """Exporter self-measurement for the metrics block: scrape
+        latency of the two hot routes plus whether the job-heartbeat
+        gauges materialized this round (tolerated-absent in rounds that
+        predate the telemetry plane, like serving_update_p50)."""
+        import urllib.request
+
+        tb: dict = {
+            "heartbeat_gauges": any(k.startswith("engine.job.")
+                                    for k in snap["gauges"]),
+            "incidents_written": int(
+                snap["counters"].get("incidents.written", 0)),
+        }
+        if telem_server is not None:
+            tb["port"] = telem_server.port
+            for route, key in (("/metrics", "metrics_scrape_ms"),
+                               ("/snapshot.json", "snapshot_scrape_ms")):
+                try:
+                    t0 = time.perf_counter()
+                    with urllib.request.urlopen(telem_server.url + route,
+                                                timeout=10) as resp:
+                        resp.read()
+                    tb[key] = round(1e3 * (time.perf_counter() - t0), 2)
+                except Exception as e:  # noqa: BLE001 — a failed scrape
+                    # is itself a finding the artifact should carry
+                    tb[key + "_error"] = f"{type(e).__name__}: {e}"
+        return tb
+
     # static-analysis summary (ISSUE 4): every BENCH record also says
     # whether the tree it measured was invariant-clean — sts-lint
     # finding counts plus the jaxpr/HLO contract results.  Lint is a
@@ -392,6 +440,7 @@ def main():
                      if k.startswith("serving.")})
         if serv:
             block["serving"] = serv
+        block["telemetry"] = _telemetry_block(snap)
         block["static_analysis"] = _static_analysis_block()
         return block
 

@@ -85,7 +85,9 @@ from typing import (Any, Callable, Dict, List, NamedTuple, Optional,
 import numpy as np
 
 from .utils import durability as _durability
+from .utils import flightrec as _flightrec
 from .utils import metrics as _metrics
+from .utils import telemetry as _telemetry
 from .utils.durability import (BackoffPolicy, ChunkDeadlineExceeded,
                                JournalSpecMismatch)
 
@@ -809,6 +811,7 @@ class FitEngine:
                    degrade: bool = True,
                    degrade_floor: Optional[int] = None,
                    resilient: bool = False,
+                   on_progress: Optional[Callable[[Any], None]] = None,
                    **kwargs) -> StreamResult:
         """Fit a panel larger than device memory by streaming chunks.
 
@@ -881,6 +884,24 @@ class FitEngine:
         ``stats["resilient_statuses"]``; ``converged`` counts lanes whose
         status is ok/retried/fallback.
 
+        Telemetry (docs/design.md §6f), all host-side: every run
+        registers a live :class:`~spark_timeseries_tpu.utils.telemetry.
+        JobProgress` (job id, chunks done/total/failed/quarantined/
+        degraded, journal commits, EW-smoothed chunk cadence → ETA),
+        heartbeat-stamped at every chunk dispatch and materialization —
+        a hung chunk shows a growing heartbeat age on ``/healthz``
+        *before* its deadline fires.  Progress also lands in the
+        ``engine.job.*`` gauges (last-write-wins across concurrent
+        jobs; per-job fidelity lives in ``/snapshot.json``).
+        ``on_progress`` (optional callable) receives the ``JobProgress``
+        after every chunk completion; a callback that raises is dropped
+        after counting ``engine.progress_cb_errors``.  With
+        ``STS_INCIDENT_DIR`` set, chunk deaths, deadline expiries,
+        OOM-at-floor, the ``kill_after_chunk`` fault, and any exception
+        escaping this call each leave a forensic incident bundle
+        (``utils.flightrec``); bundle writing never touches the journal
+        or the resume path.
+
         Timing covers dispatch through host materialization of every
         chunk's outputs — the real pipeline cost for out-of-core panels.
         """
@@ -932,6 +953,11 @@ class FitEngine:
         floor = SERIES_BUCKET_FLOOR if degrade_floor is None \
             else max(1, int(degrade_floor))
 
+        # membership test for progress accounting: OOM-degraded
+        # sub-ranges must not count as whole chunks (chunks_done would
+        # pass n_chunks and the ETA would collapse)
+        partition_set = set(partition)
+
         if job_meta is not None:
             import json as _json
             try:
@@ -964,6 +990,43 @@ class FitEngine:
                 spec["job"] = job_meta
             jr = _durability.ChunkJournal.open(journal, spec)
         keep_models = collect or jr is not None
+
+        # live telemetry (docs/design.md §6f): the job's structured
+        # heartbeat, registered before the first dispatch so an operator
+        # can watch the run from chunk 0; the STS_TELEMETRY_PORT opt-in
+        # is honored here (no exporter thread exists without it)
+        _telemetry.ensure_started_from_env()
+        progress = _telemetry.JobProgress(
+            _telemetry.new_job_id(family), family, n_series,
+            len(partition), chunk, journal_path=journal or None,
+            resilient=resilient)
+        _telemetry.register_job(progress, self._reg)
+        cb_state = {"cb": on_progress}
+
+        def _publish_progress() -> None:
+            """engine.job.* gauges (last-write-wins across concurrent
+            jobs) + the caller's on_progress callback, which is dropped
+            after its first raise — observability must never kill the
+            stream it observes."""
+            eta = progress.eta_s
+            self._reg.set_gauge("engine.job.chunks_done",
+                                progress.chunks_done)
+            self._reg.set_gauge("engine.job.chunks_total",
+                                progress.n_chunks)
+            self._reg.set_gauge("engine.job.chunks_failed",
+                                progress.chunks_failed)
+            self._reg.set_gauge("engine.job.eta_s",
+                                eta if eta is not None else -1.0)
+            if progress.ew_chunk_s is not None:
+                self._reg.set_gauge("engine.job.chunk_s_ew",
+                                    progress.ew_chunk_s)
+            cb = cb_state["cb"]
+            if cb is not None:
+                try:
+                    cb(progress)
+                except Exception:  # noqa: BLE001 — see docstring
+                    cb_state["cb"] = None
+                    self._reg.inc("engine.progress_cb_errors")
 
         conv = 0
         dead_series = 0
@@ -1013,6 +1076,12 @@ class FitEngine:
                     f"per-chunk deadline during {stage} "
                     f"(deadline_s= / STS_CHUNK_DEADLINE_S); the worker "
                     f"thread is abandoned and the stream continues")
+                _flightrec.record_incident(
+                    "deadline_expired", exc=err, job=progress,
+                    journal_path=jr.path if jr is not None else None,
+                    extra={"chunk": [int(start), int(stop)],
+                           "stage": stage, "deadline_s": deadline},
+                    registry=self._reg)
                 # the retry loop gates on this: while the abandoned
                 # worker lives, it may still own the range's device
                 # buffers and eventually execute its fit
@@ -1055,6 +1124,7 @@ class FitEngine:
             """Prep + executable lookup + async dispatch under the
             deadline (compiles can hang too).  Returns
             ``(out, entry, n_real)``."""
+            progress.heartbeat("dispatch", chunk=(start, stop))
             part, bs, variant, n_real = _prep(start, stop)
             oom = _resilience.chunk_fault("oom_chunk", idx)
             if oom is not None and (start, stop) == partition[idx]:
@@ -1084,6 +1154,8 @@ class FitEngine:
                          stop: int, n_real: int) -> None:
             """Block on the chunk's outputs under the deadline, then
             publish (and journal-commit) the result."""
+            progress.heartbeat("materialize", chunk=(start, stop))
+
             def work():
                 with _metrics.span("engine.collect"):
                     return [np.asarray(a) for a in out[0]], int(out[1])
@@ -1107,15 +1179,37 @@ class FitEngine:
                            "variant": entry.variant})
                 durex["journal_commits"] += 1
                 self._reg.inc("engine.journal_commits")
+                progress.note(journal_commits=1)
                 full = (start, stop) == partition[idx]
                 if full and _resilience.chunk_fault(
                         "kill_after_chunk", idx) is not None:
+                    _pre_kill_incident(idx, start, stop)
                     os.kill(os.getpid(), signal.SIGKILL)
                 if full and _resilience.chunk_fault(
                         "corrupt_journal", idx) is not None:
                     jr.corrupt_entry(start, stop)
             if collect:
                 collected[start] = (stop, model)
+            if (start, stop) in partition_set:
+                progress.note_chunk_done()
+            else:
+                progress.note(subchunks_done=1)
+            _publish_progress()
+
+        def _pre_kill_incident(idx: int, start: int, stop: int) -> None:
+            """The kill_after_chunk fault sends SIGKILL (which by
+            definition runs no handlers), so the crash-forensics bundle
+            is written immediately BEFORE the kill — the deterministic,
+            testable stand-in for "the process died mid-job".  The
+            bundle lands in STS_INCIDENT_DIR via tmp+fsync+rename; the
+            journal directory is never touched."""
+            _flightrec.record_incident(
+                "kill_after_chunk", job=progress,
+                journal_path=jr.path if jr is not None else None,
+                extra={"chunk": [int(start), int(stop)],
+                       "chunk_index": int(idx),
+                       "note": "injected SIGKILL after journal commit"},
+                registry=self._reg)
 
         res_statuses: Dict[str, int] = {}
 
@@ -1126,6 +1220,7 @@ class FitEngine:
             size) so the durability suite drives this path too."""
             import jax.numpy as jnp
 
+            progress.heartbeat("resilient_fit", chunk=(start, stop))
             part = host[start:stop]
             oom = _resilience.chunk_fault("oom_chunk", idx)
             if oom is not None and (start, stop) == partition[idx]:
@@ -1159,15 +1254,22 @@ class FitEngine:
                            "statuses": outcome.counts()})
                 durex["journal_commits"] += 1
                 self._reg.inc("engine.journal_commits")
+                progress.note(journal_commits=1)
                 full = (start, stop) == partition[idx]
                 if full and _resilience.chunk_fault(
                         "kill_after_chunk", idx) is not None:
+                    _pre_kill_incident(idx, start, stop)
                     os.kill(os.getpid(), signal.SIGKILL)
                 if full and _resilience.chunk_fault(
                         "corrupt_journal", idx) is not None:
                     jr.corrupt_entry(start, stop)
             if collect:
                 collected[start] = (stop, model)
+            if (start, stop) in partition_set:
+                progress.note_chunk_done()
+            else:
+                progress.note(subchunks_done=1)
+            _publish_progress()
 
         def _failure_kind(e: Exception) -> str:
             if isinstance(e, ChunkDeadlineExceeded):
@@ -1189,7 +1291,7 @@ class FitEngine:
                 else min(series_bucket(n_real), chunk)
             tb = "".join(_traceback.format_exception(
                 type(e), e, e.__traceback__))
-            failures.append({
+            record = {
                 "chunk_start": int(start),
                 "chunk_stop": int(stop),
                 "n_series": int(n_real),
@@ -1199,24 +1301,48 @@ class FitEngine:
                 "error": f"{type(e).__name__}: {e}",
                 "traceback": tb[-2000:],
                 "attempts": int(attempts),
-            })
+            }
+            failures.append(record)
             self._reg.inc("engine.chunk_failures")
+            if (start, stop) in partition_set:
+                progress.note(failed=1)
+            else:
+                progress.note(subchunks_failed=1)
             if kind != "data":
                 durex["dead_chunks"] += 1
                 self._reg.inc("engine.dead_chunks")
+                # chunk death is an operator incident (a deterministic
+                # data rejection is a caller bug, not a crash story)
+                _flightrec.record_incident(
+                    "chunk_dead", exc=e, job=progress,
+                    journal_path=jr.path if jr is not None else None,
+                    extra={"failure": record}, registry=self._reg)
             _metrics.trace_instant(
                 "engine.chunk_failure",
                 {"chunk_start": int(start), "chunk_stop": int(stop),
                  "kind": kind, "error": type(e).__name__})
+            _publish_progress()
 
         def _quarantine(idx: int, start: int, stop: int, e: Exception,
                         kind: str) -> None:
             durex["quarantined"] += 1
             self._reg.inc("engine.quarantined")
+            progress.note(quarantined=1)
             _metrics.trace_instant(
                 "engine.quarantine",
                 {"chunk_start": int(start), "chunk_stop": int(stop),
                  "kind": kind, "error": type(e).__name__})
+            if kind == "oom":
+                # an OOM only reaches quarantine when it can no longer
+                # split (at the degrade floor, or degrade=False) — the
+                # "memory pressure won" forensic moment
+                _flightrec.record_incident(
+                    "oom_at_floor", exc=e, job=progress,
+                    journal_path=jr.path if jr is not None else None,
+                    extra={"chunk": [int(start), int(stop)],
+                           "degrade_floor": int(floor),
+                           "degrade": bool(degrade)},
+                    registry=self._reg)
             quarantine.append({"idx": idx, "start": start, "stop": stop,
                                "error": e, "kind": kind})
 
@@ -1226,6 +1352,7 @@ class FitEngine:
             half that can still halve recurses toward the floor)."""
             durex["degraded_chunks"] += 1
             self._reg.inc("engine.degraded_chunks")
+            progress.note(degraded=1)
             mid = start + (stop - start) // 2
             _metrics.trace_instant(
                 "engine.degrade_split",
@@ -1310,6 +1437,8 @@ class FitEngine:
             # against n_chunks
             durex["journal_hits"] += 1
             self._reg.inc("engine.journal_hits")
+            progress.note_chunk_done(restored=True)
+            _publish_progress()
             return True
 
         def _pull(out, entry: _Entry, idx: int, start: int, stop: int,
@@ -1321,73 +1450,91 @@ class FitEngine:
                 _route_failure(idx, start, stop, e)
 
         t0 = time.perf_counter()
-        with _metrics.span("engine.stream"):
-            for idx, (start, stop) in enumerate(partition):
-                if jr is not None and _resume_from_journal(start, stop):
-                    continue
-                if resilient:
+        try:
+            with _metrics.span("engine.stream"):
+                for idx, (start, stop) in enumerate(partition):
+                    if jr is not None and _resume_from_journal(start, stop):
+                        continue
+                    if resilient:
+                        try:
+                            _run_sync(idx, start, stop)
+                        except Exception as e:  # noqa: BLE001 — isolation
+                            _route_failure(idx, start, stop, e)
+                        continue
                     try:
-                        _run_sync(idx, start, stop)
+                        out, entry, n_real = _dispatch(idx, start, stop)
                     except Exception as e:  # noqa: BLE001 — isolation
                         _route_failure(idx, start, stop, e)
-                    continue
-                try:
-                    out, entry, n_real = _dispatch(idx, start, stop)
-                except Exception as e:  # noqa: BLE001 — chunk isolation
-                    _route_failure(idx, start, stop, e)
-                    continue
-                pending.append((out, entry, idx, start, stop, n_real))
-                while len(pending) >= depth + 1:
+                        continue
+                    pending.append((out, entry, idx, start, stop, n_real))
+                    while len(pending) >= depth + 1:
+                        _pull(*pending.popleft())
+                while pending:
                     _pull(*pending.popleft())
-            while pending:
-                _pull(*pending.popleft())
 
-            # end-of-stream quarantine: bounded deterministic backoff
-            # retries, then declare the chunk dead.  Index-based walk —
-            # a retry that degrades under OOM can quarantine fresh
-            # sub-ranges, which get their own retries.
-            qi = 0
-            while qi < len(quarantine):
-                q = quarantine[qi]
-                qi += 1
-                recovered = False
-                last_err = q["error"]
-                attempts = 1
-                for attempt in range(1, policy.max_retries + 1):
-                    delay = policy.delay(attempt)
-                    durex["retry_attempts"] += 1
-                    self._reg.inc("engine.retry_attempts")
-                    _metrics.trace_instant(
-                        "engine.retry_attempt",
-                        {"chunk_start": int(q["start"]),
-                         "chunk_stop": int(q["stop"]),
-                         "attempt": attempt, "delay_s": delay})
-                    attempts += 1
-                    hung = getattr(last_err, "worker", None)
-                    if hung is not None and hung.is_alive():
-                        # a deadline-abandoned worker may still own this
-                        # range's device buffers and eventually run its
-                        # fit; the backoff doubles as a grace join, and
-                        # while it lives we never race a duplicate
-                        # dispatch against it
-                        hung.join(delay)
-                        if hung.is_alive():
-                            continue
-                    elif delay > 0:
-                        time.sleep(delay)
-                    try:
-                        _run_sync(q["idx"], q["start"], q["stop"])
-                        recovered = True
-                        break
-                    except Exception as e:  # noqa: BLE001 — retried
-                        last_err = e
-                if recovered:
-                    durex["recovered"] += 1
-                    self._reg.inc("engine.quarantine_recovered")
-                else:
-                    _record_terminal(q["start"], q["stop"], last_err,
-                                     _failure_kind(last_err), attempts)
+                # end-of-stream quarantine: bounded deterministic backoff
+                # retries, then declare the chunk dead.  Index-based walk —
+                # a retry that degrades under OOM can quarantine fresh
+                # sub-ranges, which get their own retries.
+                qi = 0
+                while qi < len(quarantine):
+                    q = quarantine[qi]
+                    qi += 1
+                    recovered = False
+                    last_err = q["error"]
+                    attempts = 1
+                    for attempt in range(1, policy.max_retries + 1):
+                        delay = policy.delay(attempt)
+                        durex["retry_attempts"] += 1
+                        self._reg.inc("engine.retry_attempts")
+                        progress.heartbeat("retry",
+                                           chunk=(q["start"], q["stop"]))
+                        _metrics.trace_instant(
+                            "engine.retry_attempt",
+                            {"chunk_start": int(q["start"]),
+                             "chunk_stop": int(q["stop"]),
+                             "attempt": attempt, "delay_s": delay})
+                        attempts += 1
+                        hung = getattr(last_err, "worker", None)
+                        if hung is not None and hung.is_alive():
+                            # a deadline-abandoned worker may still own
+                            # this range's device buffers and eventually
+                            # run its fit; the backoff doubles as a grace
+                            # join, and while it lives we never race a
+                            # duplicate dispatch against it
+                            hung.join(delay)
+                            if hung.is_alive():
+                                continue
+                        elif delay > 0:
+                            time.sleep(delay)
+                        try:
+                            _run_sync(q["idx"], q["start"], q["stop"])
+                            recovered = True
+                            break
+                        except Exception as e:  # noqa: BLE001 — retried
+                            last_err = e
+                    if recovered:
+                        durex["recovered"] += 1
+                        self._reg.inc("engine.quarantine_recovered")
+                    else:
+                        _record_terminal(q["start"], q["stop"], last_err,
+                                         _failure_kind(last_err), attempts)
+        except BaseException as e:
+            # chunk failures are isolated above, so anything escaping the
+            # stream is an un-modeled failure — the flight recorder's
+            # "unhandled exception" trigger; the bundle lands before the
+            # exception reaches the caller, and the job is marked failed
+            # so /snapshot.json tells the story even post-mortem
+            _flightrec.record_incident(
+                "stream_exception", exc=e, job=progress,
+                journal_path=jr.path if jr is not None else None,
+                registry=self._reg)
+            _telemetry.finish_job(progress, "failed",
+                                  error=f"{type(e).__name__}: {e}",
+                                  registry=self._reg)
+            raise
         wall = time.perf_counter() - t0
+        _telemetry.finish_job(progress, "done", registry=self._reg)
 
         after = self.cache_stats()
         stats = {
@@ -1399,6 +1546,7 @@ class FitEngine:
             "chunk_size": chunk,
             "deadline_s": deadline,
             "retries": policy.max_retries,
+            "job_id": progress.job_id,
             **durex,
         }
         if resilient:

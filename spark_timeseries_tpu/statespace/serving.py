@@ -52,7 +52,10 @@ per-tick SLO and heal latency in ``tools/bench_gate.py``), and
 
 from __future__ import annotations
 
+import itertools
 import threading
+import time
+from collections import deque
 from typing import Any, Dict, NamedTuple, Optional
 
 import numpy as np
@@ -60,6 +63,7 @@ import numpy as np
 from ..utils import checkpoint as _checkpoint
 from ..utils import metrics as _metrics
 from ..utils import resilience as _resilience
+from ..utils import telemetry as _telemetry
 from .convert import Bootstrapped, bootstrap
 from .health import (LANE_DIVERGED, LANE_NAMES, LANE_OK, HealthPolicy,
                      LaneHealth, initial_health, monitored_step)
@@ -67,7 +71,7 @@ from .ssm import FilterState, SSMeta, StateSpace, state_nbytes
 
 __all__ = ["ServingSession", "TickResult", "start_session",
            "warmup_update", "WARMUP_FAMILIES", "ServingRestoreMismatch",
-           "DEFAULT_HISTORY_RING"]
+           "DEFAULT_HISTORY_RING", "TICK_LATENCY_WINDOW"]
 
 # format 2 = health-era checkpoints (lane health + history ring + heal
 # route); format-1 checkpoints predate the health machinery and cannot
@@ -85,6 +89,29 @@ _POISON_VALUE = 1e30
 # families warmup_update can synthesize an executable-shaped SSM for
 # without a fitted model (the serving-capable subset of ENGINE_FAMILIES)
 WARMUP_FAMILIES = ("arima", "ar", "arx", "ewma", "holt_winters")
+
+# rolling per-session tick-latency window: the bounded ring behind the
+# serving.session.<label>.tick_p50_ms / tick_p95_ms gauges and the SLO
+# burn counter — O(window) host memory per session, recomputed per tick
+# (≤ window floats; noise next to the Kalman step's materialization)
+TICK_LATENCY_WINDOW = 256
+
+_session_seq = itertools.count(1)
+
+
+def _serving_slo_ms() -> Optional[float]:
+    """The per-tick latency SLO (``STS_SERVING_SLO_MS``, milliseconds),
+    parsed once per session; unset = no SLO accounting, junk raises a
+    named error (the shared ``telemetry.env_positive`` contract)."""
+    return _telemetry.env_positive("STS_SERVING_SLO_MS", float, None)
+
+
+def _check_label(label: str) -> str:
+    if not label or not all(ch.isalnum() or ch in "_-" for ch in label):
+        raise ValueError(
+            f"session label must be non-empty [A-Za-z0-9_-] (it names "
+            f"the serving.session.<label>.* metrics), got {label!r}")
+    return label
 
 
 class ServingRestoreMismatch(ValueError):
@@ -228,7 +255,8 @@ class ServingSession:
                  health: Optional[LaneHealth] = None,
                  heal_spec: Optional[Dict[str, Any]] = None,
                  history_ring: int = DEFAULT_HISTORY_RING,
-                 history_tail=None, _hist_state=None):
+                 history_tail=None, _hist_state=None,
+                 label: Optional[str] = None):
         from ..engine import series_bucket
 
         self._reg = registry if registry is not None \
@@ -270,6 +298,19 @@ class ServingSession:
                 self._hist[:, :k] = tail
                 self._hist_pos = k % self._hist_len
                 self._hist_fill = k
+        # telemetry plane (docs/design.md §6f): a stable label names
+        # this session's serving.session.<label>.* latency/SLO metrics;
+        # the session is weakly registered for /snapshot.json summaries
+        # (the exporter never pins it), and the STS_TELEMETRY_PORT
+        # opt-in is honored here — all strictly host-side, nothing on
+        # the jitted tick path changes
+        self.label = _check_label(label) if label is not None \
+            else f"s{next(_session_seq)}"
+        self._tick_lat: deque = deque(maxlen=TICK_LATENCY_WINDOW)
+        self._slo_ms = _serving_slo_ms()
+        self._slo_burns = 0
+        _telemetry.register_session(self)
+        _telemetry.ensure_started_from_env()
         self._reg.inc("serving.sessions")
         self._reg.set_gauge("serving.state_bytes",
                             state_nbytes((self._state, self._health)))
@@ -279,8 +320,8 @@ class ServingSession:
     @classmethod
     def start(cls, model, history, *, offsets=None, registry=None,
               policy: Optional[HealthPolicy] = None,
-              history_ring: int = DEFAULT_HISTORY_RING
-              ) -> "ServingSession":
+              history_ring: int = DEFAULT_HISTORY_RING,
+              label: Optional[str] = None) -> "ServingSession":
         """Open a session from a fitted model pytree and the history it
         was fitted on: converts to state-space form
         (``statespace.convert.to_statespace``), filters the history to a
@@ -302,7 +343,7 @@ class ServingSession:
                    ticks_seen=int(history.shape[1]), registry=registry,
                    policy=policy, heal_spec=_heal_spec_for(model),
                    history_ring=history_ring,
-                   history_tail=np.asarray(history))
+                   history_tail=np.asarray(history), label=label)
 
     # -- serving ------------------------------------------------------------
 
@@ -334,6 +375,7 @@ class ServingSession:
             off[:self.n_series] = np.asarray(offset, self._dtype) \
                 .reshape(-1)
         fn = _jitted("update")
+        t0 = time.perf_counter()
         with _metrics.span("serving.update"):
             state2, health2, v, f, ll_inc = fn(
                 self.meta, self.policy, self._ssm, self._state,
@@ -349,6 +391,7 @@ class ServingSession:
         self._state = state2
         self._health = health2
         self._note_transitions(out.status)
+        self._note_tick_latency(time.perf_counter() - t0)
         # the ring normalizes non-finite arrivals to NaN (the filter
         # already degrades inf to a missed tick; a verbatim inf would
         # needlessly poison heal()'s refit window for ring-length ticks)
@@ -409,6 +452,62 @@ class ServingSession:
                 "serving.quarantined_lanes",
                 int(np.sum(status == LANE_DIVERGED)))
         self._status_host = status.copy()
+
+    def _note_tick_latency(self, dt_s: float) -> None:
+        """Fold one tick's wall latency into the session's rolling
+        window and publish the ``serving.session.<label>.*`` SLO
+        surface: tick p50/p95 gauges off the bounded ring, an SLO burn
+        counter against ``STS_SERVING_SLO_MS``, and the per-session
+        quarantined-lanes gauge alongside (the global
+        ``serving.quarantined_lanes`` gauge is last-write-wins across
+        sessions; the labeled one is this session's own).  Host-side
+        accounting only — the warmed tick executable is untouched."""
+        self._tick_lat.append(float(dt_s))
+        pre = f"serving.session.{self.label}"
+        ms = dt_s * 1e3
+        if self._slo_ms is not None and ms > self._slo_ms:
+            self._slo_burns += 1
+            self._reg.inc(f"{pre}.slo_burns")
+            self._reg.inc("serving.slo_burns")
+            _metrics.trace_instant(
+                "serving.slo_burn",
+                {"session": self.label, "tick_ms": round(ms, 3),
+                 "slo_ms": self._slo_ms})
+        arr = np.fromiter(self._tick_lat, dtype=np.float64)
+        self._reg.set_gauge(f"{pre}.tick_p50_ms",
+                            float(np.percentile(arr, 50)) * 1e3)
+        self._reg.set_gauge(f"{pre}.tick_p95_ms",
+                            float(np.percentile(arr, 95)) * 1e3)
+        self._reg.set_gauge(
+            f"{pre}.quarantined_lanes",
+            int(np.sum(self._status_host == LANE_DIVERGED)))
+
+    def tick_latency_stats(self) -> Dict[str, Any]:
+        """The rolling window's latency summary (ms) — what the labeled
+        gauges and ``/snapshot.json`` report."""
+        if not self._tick_lat:
+            return {"window": 0}
+        arr = np.fromiter(self._tick_lat, dtype=np.float64) * 1e3
+        return {
+            "window": int(arr.size),
+            "tick_p50_ms": round(float(np.percentile(arr, 50)), 4),
+            "tick_p95_ms": round(float(np.percentile(arr, 95)), 4),
+            "tick_max_ms": round(float(arr.max()), 4),
+            "slo_ms": self._slo_ms,
+            "slo_burns": self._slo_burns,
+        }
+
+    def telemetry_summary(self) -> Dict[str, Any]:
+        """One scrape-ready dict for the telemetry plane's
+        ``/snapshot.json`` (``utils.telemetry.session_summaries``)."""
+        return {
+            "label": self.label,
+            **self.describe(),
+            "health": self.health_counts(),
+            "quarantined_lanes":
+                int(np.sum(self._status_host == LANE_DIVERGED)),
+            **self.tick_latency_stats(),
+        }
 
     def forecast(self, horizon: int, offsets=None) -> np.ndarray:
         """``(n_series, horizon)`` point forecasts from the current
@@ -546,6 +645,15 @@ class ServingSession:
                 self._reg.inc("serving.heal_errors")
                 _metrics.trace_instant(
                     "serving.heal_error", {"error": type(e).__name__})
+                # a failed heal is a crash-forensics moment: the lanes
+                # stay quarantined and an operator needs the refit's
+                # traceback + the session's state to decide what next
+                from ..utils import flightrec as _flightrec
+                _flightrec.record_incident(
+                    "heal_failure", exc=e,
+                    extra={"session": self.telemetry_summary(),
+                           "quarantined_rows": rows.tolist()[:256]},
+                    registry=self._reg)
                 report["error"] = f"{type(e).__name__}: {e}"
                 return report
             ok = np.isin(outcome.status,
@@ -688,7 +796,8 @@ class ServingSession:
         self._reg.inc("serving.checkpoints")
 
     @classmethod
-    def restore(cls, path: str, *, registry=None) -> "ServingSession":
+    def restore(cls, path: str, *, registry=None,
+                label: Optional[str] = None) -> "ServingSession":
         """Rebuild a session from :meth:`checkpoint` output.
 
         Validated twice: ``utils.checkpoint`` rejects torn/garbled files
@@ -754,7 +863,7 @@ class ServingSession:
                    policy=blob["policy"], health=health,
                    heal_spec=blob.get("heal_spec"),
                    _hist_state=(hist, int(blob["hist_pos"]),
-                                int(blob["hist_fill"])))
+                                int(blob["hist_fill"])), label=label)
 
 
 def start_session(model, history, **kwargs) -> ServingSession:

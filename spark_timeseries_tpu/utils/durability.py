@@ -50,7 +50,7 @@ from . import checkpoint as _checkpoint
 __all__ = [
     "BackoffPolicy", "as_backoff",
     "ChunkDeadlineExceeded", "JournalSpecMismatch",
-    "is_oom", "spec_digest", "array_digest",
+    "is_oom", "spec_digest", "array_digest", "atomic_write_json",
     "ChunkJournal",
 ]
 
@@ -150,15 +150,22 @@ def array_digest(arr) -> str:
     return h.hexdigest()[:16]
 
 
-def _atomic_write_json(path: str, obj: Any) -> None:
+def atomic_write_json(path: str, obj: Any) -> None:
     """tmp-file + fsync + rename: the file either has its full contents
-    or does not exist — the rename is the visibility point."""
+    or does not exist — the rename is the visibility point.  The journal
+    commit marker and the flight recorder's incident bundles share this
+    one implementation, so every on-disk forensic artifact carries the
+    same crash-consistency guarantee."""
     tmp = f"{path}.tmp-{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(obj, f)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+
+
+# backwards-compatible private alias (pre-telemetry name)
+_atomic_write_json = atomic_write_json
 
 
 class ChunkJournal:

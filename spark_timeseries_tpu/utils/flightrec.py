@@ -1,0 +1,321 @@
+"""Flight recorder: a forensic incident bundle for every failure.
+
+The durability tier (docs/design.md §3c) makes a crashed job *resumable*
+but not *explainable*: a dead chunk, an expired deadline, or an
+unhandled exception leaves nothing behind except the journal and
+whatever stdout survived.  This module is the black box — on every
+incident the process writes a single self-contained JSON bundle to
+``STS_INCIDENT_DIR`` carrying everything an operator needs for
+post-mortem triage:
+
+- the metrics **registry snapshot** (counters/gauges/histograms/spans
+  at the instant of failure),
+- the **trace ring** as Chrome trace JSON (the last
+  ``STS_INCIDENT_TRACE_EVENTS`` events — load the bundle's ``trace``
+  member in Perfetto to see exactly what ran before the death),
+- the failing job's **JobProgress** (chunks done/failed/quarantined,
+  heartbeat stage, EW cadence) plus every other active job,
+- the **exception** (type, message, truncated traceback),
+- the **journal manifest + committed ranges** when a journal is armed
+  (read-only: bundle writing must never touch the journal itself — the
+  resume path is sacred),
+- **platform/config identity** (python, jax version/config if loaded,
+  ``STS_*`` environment) so a bundle from a fleet machine is
+  self-describing.
+
+Bundles are written with the tmp+fsync+rename discipline from
+:mod:`~spark_timeseries_tpu.utils.durability` (a bundle either exists
+whole or not at all), into a bounded directory: the newest
+``STS_INCIDENT_KEEP`` (default 20) bundles are kept, older ones pruned.
+``incidents.written`` counts successful writes (``tools/bench_gate.py``
+zero-baselines it — a bench round must not organically crash);
+``incidents.errors`` counts recorder failures (the recorder itself must
+never raise into the code it observes).
+
+Trigger points (all host-side): chunk death and deadline expiry and
+OOM-at-floor in ``engine.stream_fit``, heal failure in
+``ServingSession.heal``, any unhandled exception escaping
+``stream_fit``, and the ``kill_after_chunk`` fault (the bundle is
+written immediately *before* the injected SIGKILL — the testable
+stand-in for a crash; a real SIGKILL cannot run handlers by
+definition, which is exactly why the recorder fires on every earlier
+failure signal instead of relying on an exit hook).
+
+Disabled (zero overhead, zero threads) unless ``STS_INCIDENT_DIR`` is
+set or :func:`configure` names a directory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform as _platform
+import sys
+import time
+import traceback as _traceback
+from typing import Any, Dict, List, Optional
+
+from . import durability as _durability
+from . import metrics as _metrics
+from . import telemetry as _telemetry
+
+__all__ = [
+    "INCIDENT_FORMAT", "DEFAULT_KEEP", "REQUIRED_KEYS",
+    "configure", "incident_dir", "enabled",
+    "record_incident", "list_incidents", "load_incident",
+    "validate_bundle",
+]
+
+INCIDENT_FORMAT = 1
+
+# newest-K retention (STS_INCIDENT_KEEP overrides)
+DEFAULT_KEEP = 20
+
+# newest trace-ring events embedded per bundle (STS_INCIDENT_TRACE_EVENTS
+# overrides); the full 65536-event ring would make every bundle ~10 MB
+DEFAULT_TRACE_EVENTS = 4096
+
+# top-level keys every schema-valid bundle must carry (the contract
+# tests and sts_top validate against)
+REQUIRED_KEYS = ("format", "kind", "time_unix", "time_iso", "pid",
+                 "exception", "job", "jobs", "journal", "registry",
+                 "trace", "config")
+
+_PREFIX = "incident_"
+
+_configured_dir: Optional[str] = None
+
+
+def configure(path: Optional[str]) -> Optional[str]:
+    """Set (or with None, clear) the incident directory in-process,
+    overriding ``STS_INCIDENT_DIR``.  Returns the effective directory."""
+    global _configured_dir
+    _configured_dir = path
+    return incident_dir()
+
+
+def incident_dir() -> Optional[str]:
+    """The armed incident directory, or None (recorder off)."""
+    if _configured_dir:
+        return _configured_dir
+    return os.environ.get("STS_INCIDENT_DIR") or None
+
+
+def enabled() -> bool:
+    return incident_dir() is not None
+
+
+def _keep() -> int:
+    return _telemetry.env_positive("STS_INCIDENT_KEEP", int,
+                                   DEFAULT_KEEP)
+
+
+def _sanitize_kind(kind: str) -> str:
+    return "".join(ch if ch.isalnum() or ch in "_-" else "_"
+                   for ch in str(kind)) or "incident"
+
+
+def _exception_block(exc: Optional[BaseException]) -> Optional[dict]:
+    if exc is None:
+        return None
+    tb = "".join(_traceback.format_exception(type(exc), exc,
+                                             exc.__traceback__))
+    return {"type": type(exc).__name__,
+            "message": str(exc)[:2000],
+            "traceback": tb[-8000:]}
+
+
+def _journal_block(journal_path: Optional[str]) -> Optional[dict]:
+    """Read-only view of the armed journal: manifest + committed ranges.
+    Pure reads — the recorder must never write inside the journal
+    directory (corrupting the resume path to explain a crash would be
+    the worst possible trade)."""
+    if not journal_path or not os.path.isdir(journal_path):
+        return None
+    block: Dict[str, Any] = {"path": journal_path}
+    try:
+        mpath = os.path.join(journal_path,
+                             _durability.ChunkJournal.MANIFEST)
+        if os.path.exists(mpath):
+            with open(mpath) as f:
+                block["manifest"] = json.load(f)
+        ranges = []
+        for name in sorted(os.listdir(journal_path)):
+            if name.endswith(".ok"):
+                ranges.append(name[len("chunk_"):-len(".ok")])
+        block["n_committed"] = len(ranges)
+        block["committed"] = ranges[:64]
+    except Exception as e:  # noqa: BLE001 — a half-readable journal
+        # still yields a partial block, never a recorder failure
+        block["read_error"] = f"{type(e).__name__}: {e}"
+    return block
+
+
+def _config_block() -> dict:
+    cfg: Dict[str, Any] = {
+        "python": sys.version.split()[0],
+        "platform": _platform.platform(),
+        "argv": sys.argv[:8],
+        "env": {k: v for k, v in sorted(os.environ.items())
+                if k.startswith(("STS_", "JAX_PLATFORMS", "XLA_FLAGS"))},
+    }
+    jx = sys.modules.get("jax")
+    if jx is not None:
+        cfg["jax_version"] = getattr(jx, "__version__", None)
+        try:
+            # config reads are safe; never call a backend-initializing
+            # API (jax.devices / default_backend) from the recorder
+            cfg["jax_platforms"] = jx.config.jax_platforms
+            cfg["jax_enable_x64"] = bool(jx.config.jax_enable_x64)
+        except Exception:  # noqa: BLE001 — config shape varies by jax
+            pass
+    return cfg
+
+
+def _trace_block() -> dict:
+    from . import tracing as _tracing
+
+    # junk raises (the shared env_positive contract) — caught by
+    # record_incident's no-raise guard and counted as incidents.errors,
+    # the same "misconfigured recorder disables itself noisily" policy
+    # as STS_INCIDENT_KEEP
+    limit = _telemetry.env_positive("STS_INCIDENT_TRACE_EVENTS", int,
+                                    DEFAULT_TRACE_EVENTS)
+    return _tracing.to_chrome_trace(limit=limit)
+
+
+def record_incident(kind: str, *, exc: Optional[BaseException] = None,
+                    job: Optional[Any] = None,
+                    journal_path: Optional[str] = None,
+                    extra: Optional[Dict[str, Any]] = None,
+                    registry: Optional[Any] = None) -> Optional[str]:
+    """Write one incident bundle; returns its path, or None when the
+    recorder is off or the write failed (counted, never raised — the
+    recorder must not take down the code it observes).
+
+    ``job`` is the failing ``telemetry.JobProgress`` (every other
+    active job is bundled too); ``extra`` is a JSON-able dict merged
+    under the bundle's ``"extra"`` key (chunk geometry, failure
+    records, fault names).
+    """
+    directory = incident_dir()
+    if not directory:
+        return None
+    reg = registry if registry is not None else _metrics.get_registry()
+    try:
+        # parse retention up front: a misconfigured STS_INCIDENT_KEEP
+        # must not leave a bundle the prune pass then can't bound
+        keep = _keep()
+        now = time.time()
+        bundle: Dict[str, Any] = {
+            "format": INCIDENT_FORMAT,
+            "kind": str(kind),
+            "time_unix": now,
+            "time_iso": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                      time.gmtime(now)),
+            "pid": os.getpid(),
+            "exception": _exception_block(exc),
+            "job": job.to_dict() if job is not None else None,
+            "jobs": [p.to_dict() for p in _telemetry.active_jobs()],
+            "journal": _journal_block(journal_path),
+            "registry": _telemetry.json_safe(reg.snapshot()),
+            "trace": _trace_block(),
+            "config": _config_block(),
+        }
+        if extra is not None:
+            bundle["extra"] = _telemetry.json_safe(extra)
+        os.makedirs(directory, exist_ok=True)
+        name = (f"{_PREFIX}{time.time_ns():020d}_{os.getpid()}_"
+                f"{_sanitize_kind(kind)}.json")
+        path = os.path.join(directory, name)
+        _durability.atomic_write_json(path, bundle)
+        reg.inc("incidents.written")
+        _metrics.trace_instant("flightrec.incident",
+                               {"kind": str(kind), "file": name})
+        _prune(directory, keep)
+        return path
+    except Exception:  # noqa: BLE001 — see docstring
+        try:
+            reg.inc("incidents.errors")
+        except Exception:  # noqa: BLE001 — truly last-resort
+            pass
+        return None
+
+
+def _prune(directory: str, keep: int) -> None:
+    names = sorted(n for n in os.listdir(directory)
+                   if n.startswith(_PREFIX) and n.endswith(".json"))
+    for name in names[:-keep] if len(names) > keep else []:
+        try:
+            os.remove(os.path.join(directory, name))
+        except OSError:
+            pass
+
+
+def list_incidents(directory: Optional[str] = None,
+                   limit: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Newest-first index of bundles in the incident directory —
+    filename-derived metadata only (kind, written-at, size), cheap
+    enough for every ``/snapshot.json`` scrape."""
+    d = directory or incident_dir()
+    if not d or not os.path.isdir(d):
+        return []
+    names = sorted((n for n in os.listdir(d)
+                    if n.startswith(_PREFIX) and n.endswith(".json")),
+                   reverse=True)
+    if limit is not None:
+        names = names[:limit]
+    out = []
+    for name in names:
+        parts = name[len(_PREFIX):-len(".json")].split("_", 2)
+        entry: Dict[str, Any] = {"file": name,
+                                 "path": os.path.join(d, name)}
+        try:
+            entry["time_unix"] = int(parts[0]) / 1e9
+            entry["pid"] = int(parts[1])
+            entry["kind"] = parts[2]
+            entry["bytes"] = os.path.getsize(entry["path"])
+        except (IndexError, ValueError, OSError):
+            pass
+        out.append(entry)
+    return out
+
+
+def load_incident(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)
+
+
+def validate_bundle(bundle: Dict[str, Any]) -> List[str]:
+    """Schema check: the list of violations (empty = schema-valid).
+    The contract the acceptance tests (and any downstream triage
+    tooling) pin."""
+    problems = []
+    if not isinstance(bundle, dict):
+        return ["bundle is not a JSON object"]
+    for key in REQUIRED_KEYS:
+        if key not in bundle:
+            problems.append(f"missing key {key!r}")
+    if bundle.get("format") != INCIDENT_FORMAT:
+        problems.append(f"format {bundle.get('format')!r} != "
+                        f"{INCIDENT_FORMAT}")
+    if not isinstance(bundle.get("kind"), str) or not bundle.get("kind"):
+        problems.append("kind must be a non-empty string")
+    if not isinstance(bundle.get("time_unix"), (int, float)):
+        problems.append("time_unix must be a number")
+    exc = bundle.get("exception")
+    if exc is not None and (not isinstance(exc, dict)
+                            or "type" not in exc
+                            or "traceback" not in exc):
+        problems.append("exception must be null or carry type/traceback")
+    reg = bundle.get("registry")
+    if not isinstance(reg, dict) or "counters" not in reg:
+        problems.append("registry must be a snapshot dict with counters")
+    tr = bundle.get("trace")
+    if not isinstance(tr, dict) or "traceEvents" not in tr:
+        problems.append("trace must be a Chrome trace object")
+    if not isinstance(bundle.get("config"), dict):
+        problems.append("config must be a dict")
+    if not isinstance(bundle.get("jobs"), list):
+        problems.append("jobs must be a list")
+    return problems

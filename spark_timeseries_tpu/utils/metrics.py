@@ -153,26 +153,41 @@ class Histogram:
             self.min = min(self.min, v)
             self.max = max(self.max, v)
 
-    def percentile(self, q: float) -> float:
+    def raw(self) -> tuple:
+        """Consistent ``(count, sum, min, max, samples-copy)`` under one
+        lock acquisition — the snapshot tier's raw material.  Percentile
+        math happens on the copy *outside* the lock, so a scrape never
+        stalls concurrent ``record()`` calls for the numpy work."""
         with self._lock:
-            if not self._samples:
-                return float("nan")
-            samples = np.asarray(self._samples)
-        return float(np.percentile(samples, q))
+            return (self.count, self.sum, self.min, self.max,
+                    list(self._samples))
+
+    def percentile(self, q: float) -> float:
+        _, _, _, _, samples = self.raw()
+        if not samples:
+            return float("nan")
+        return float(np.percentile(np.asarray(samples), q))
 
     def stats(self) -> Dict[str, float]:
-        with self._lock:
-            if self.count == 0:
-                return {"count": 0, "sum": 0.0}
-            return {
-                "count": self.count,
-                "sum": self.sum,
-                "min": self.min,
-                "max": self.max,
-                "mean": self.sum / self.count,
-                "p50": self.percentile(50),
-                "p95": self.percentile(95),
-            }
+        return _hist_stats(*self.raw())
+
+
+def _hist_stats(count: int, total: float, mn: float, mx: float,
+                samples: list) -> Dict[str, float]:
+    """Histogram summary off one consistent :meth:`Histogram.raw` read
+    (lock already released — see snapshot hardening note there)."""
+    if count == 0:
+        return {"count": 0, "sum": 0.0}
+    s = np.asarray(samples)
+    return {
+        "count": count,
+        "sum": total,
+        "min": mn,
+        "max": mx,
+        "mean": total / count,
+        "p50": float(np.percentile(s, 50)),
+        "p95": float(np.percentile(s, 95)),
+    }
 
 
 class MetricsRegistry:
@@ -242,22 +257,37 @@ class MetricsRegistry:
 
     def snapshot(self) -> Dict[str, Any]:
         """JSON-able view of every metric.  Span stats carry ``_s``
-        suffixes to make the unit unambiguous in bench artifacts."""
+        suffixes to make the unit unambiguous in bench artifacts.
+
+        Concurrency-hardened for the telemetry exporter (the first
+        consumer that snapshots from a *different* thread while worker
+        threads mutate): the registry lock is held only long enough to
+        copy scalar values and histogram sample rings, so a scrape can
+        never observe a torn count/sum/ring triple — and the numpy
+        percentile work runs on the copies *after* the lock drops, so
+        scraping never stalls the instrumented hot paths either."""
         with self._lock:
             counters = {k: v.value for k, v in sorted(self._counters.items())}
             gauges = {k: v.value for k, v in sorted(self._gauges.items())}
-            hists = {k: v.stats() for k, v in sorted(self._histograms.items())}
-            spans = {
-                k: {
-                    "count": h.count,
-                    "total_s": h.sum,
-                    "mean_s": h.sum / h.count if h.count else 0.0,
-                    "min_s": h.min if h.count else 0.0,
-                    "max_s": h.max if h.count else 0.0,
-                    "p50_s": h.percentile(50) if h.count else 0.0,
-                    "p95_s": h.percentile(95) if h.count else 0.0,
-                }
-                for k, h in sorted(self._spans.items())
+            hist_raw = {k: v.raw()
+                        for k, v in sorted(self._histograms.items())}
+            span_raw = {k: v.raw() for k, v in sorted(self._spans.items())}
+        hists = {k: _hist_stats(*r) for k, r in hist_raw.items()}
+        spans = {}
+        for k, (count, total, mn, mx, samples) in span_raw.items():
+            if count:
+                s = np.asarray(samples)
+                p50, p95 = (float(np.percentile(s, q)) for q in (50, 95))
+            else:
+                p50 = p95 = 0.0
+            spans[k] = {
+                "count": count,
+                "total_s": total,
+                "mean_s": total / count if count else 0.0,
+                "min_s": mn if count else 0.0,
+                "max_s": mx if count else 0.0,
+                "p50_s": p50,
+                "p95_s": p95,
             }
         return {"counters": counters, "gauges": gauges,
                 "histograms": hists, "spans": spans}
@@ -275,27 +305,44 @@ class MetricsRegistry:
         return json.dumps(self.snapshot(), indent=indent, sort_keys=True)
 
     def to_prometheus(self, prefix: str = "sts") -> str:
-        """Prometheus text exposition.  Histograms and spans export as
-        summaries (quantiles + ``_sum``/``_count``); metric names are
-        sanitized to ``[a-zA-Z0-9_]`` with the given prefix."""
+        """Prometheus text exposition (format 0.0.4 — what a real
+        scraper parses off the telemetry exporter's ``/metrics``).
+
+        Conformance notes: every metric family gets a ``# HELP`` line
+        (help text escapes ``\\`` and newlines per the exposition
+        grammar) followed by its ``# TYPE``; histograms and spans export
+        as ``summary`` families whose ``{quantile=...}`` samples are
+        always accompanied by the ``_sum``/``_count`` samples the
+        summary type *requires* (quantile samples alone are rejected or
+        misread by real scrapers); metric names are sanitized to
+        ``[a-zA-Z0-9_]`` with the given prefix; an empty registry
+        exports as an empty string (a lone blank line is not valid
+        exposition text)."""
 
         def sanitize(name: str) -> str:
             return prefix + "_" + "".join(
                 ch if ch.isalnum() or ch == "_" else "_" for ch in name)
 
+        def esc_help(text: str) -> str:
+            return text.replace("\\", "\\\\").replace("\n", "\\n")
+
         snap = self.snapshot()
         lines = []
         for name, value in snap["counters"].items():
             m = sanitize(name)
+            lines.append(f"# HELP {m} {esc_help(name)} (counter)")
             lines.append(f"# TYPE {m} counter")
             lines.append(f"{m} {_fmt(value)}")
         for name, value in snap["gauges"].items():
             m = sanitize(name)
+            lines.append(f"# HELP {m} {esc_help(name)} (gauge)")
             lines.append(f"# TYPE {m} gauge")
             lines.append(f"{m} {_fmt(value)}")
-        for section, unit in (("histograms", ""), ("spans", "_seconds")):
+        for section, unit, kind in (("histograms", "", "histogram"),
+                                    ("spans", "_seconds", "span")):
             for name, st in snap[section].items():
                 m = sanitize(name) + unit
+                lines.append(f"# HELP {m} {esc_help(name)} ({kind})")
                 lines.append(f"# TYPE {m} summary")
                 if st["count"]:
                     p50 = st.get("p50", st.get("p50_s"))
@@ -305,7 +352,7 @@ class MetricsRegistry:
                 total = st.get("sum", st.get("total_s", 0.0))
                 lines.append(f"{m}_sum {_fmt(total)}")
                 lines.append(f"{m}_count {_fmt(st['count'])}")
-        return "\n".join(lines) + "\n"
+        return "\n".join(lines) + "\n" if lines else ""
 
 
 # ---------------------------------------------------------------------------

@@ -71,17 +71,24 @@ def slowest_spans(n: int = 10,
              "thread": e.get("tname", "")} for e in spans[:n]]
 
 
-def to_chrome_trace(events: Optional[List[Dict[str, Any]]] = None
-                    ) -> Dict[str, Any]:
+def to_chrome_trace(events: Optional[List[Dict[str, Any]]] = None,
+                    limit: Optional[int] = None) -> Dict[str, Any]:
     """Render the trace buffer as a Chrome trace-event JSON object.
 
     Uses the object form (``{"traceEvents": [...]}``) so the file can
     carry ``otherData``; the array inside follows the trace-event spec:
     ``X`` (complete) events for spans with ``ts``/``dur`` in µs, ``i``
     (instant, thread scope) events for markers, and ``M`` metadata
-    events naming the process and each thread row."""
+    events naming the process and each thread row.
+
+    ``limit`` keeps only the newest N events (by begin time) — the
+    payload bound the telemetry exporter's ``/trace.json?limit=`` and
+    the flight recorder's embedded trace use (a full 65536-event ring
+    renders to ~10 MB, too heavy for a scrape or an incident bundle)."""
     if events is None:
         events = _metrics.trace_events()
+    if limit is not None and len(events) > limit:
+        events = sorted(events, key=lambda e: e["ts"])[-int(limit):]
     pid = os.getpid()
     out: List[Dict[str, Any]] = [{
         "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
@@ -122,6 +129,7 @@ def to_chrome_trace(events: Optional[List[Dict[str, Any]]] = None
             "trace_start_walltime": wall0,
             "perf_counter_at_start": perf0,
             "events_dropped": buf.dropped,
+            "events_exported": len(events),
             "capacity": buf.capacity,
         },
     }

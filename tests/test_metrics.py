@@ -153,21 +153,31 @@ def test_json_export_round_trips():
 def test_prometheus_export_golden():
     out = _golden_registry().to_prometheus()
     assert out == (
+        "# HELP sts_fit_arima_series fit.arima.series (counter)\n"
         "# TYPE sts_fit_arima_series counter\n"
         "sts_fit_arima_series 8\n"
+        "# HELP sts_panel_n_series panel.n_series (gauge)\n"
         "# TYPE sts_panel_n_series gauge\n"
         "sts_panel_n_series 4\n"
+        "# HELP sts_optimize_lm_iters_mean optimize.lm.iters_mean "
+        "(histogram)\n"
         "# TYPE sts_optimize_lm_iters_mean summary\n"
         'sts_optimize_lm_iters_mean{quantile="0.5"} 2.5\n'
         'sts_optimize_lm_iters_mean{quantile="0.95"} 3.85\n'
         "sts_optimize_lm_iters_mean_sum 10\n"
         "sts_optimize_lm_iters_mean_count 4\n"
+        "# HELP sts_arima_fit_panel_seconds arima.fit_panel (span)\n"
         "# TYPE sts_arima_fit_panel_seconds summary\n"
         'sts_arima_fit_panel_seconds{quantile="0.5"} 0.5\n'
         'sts_arima_fit_panel_seconds{quantile="0.95"} 0.725\n'
         "sts_arima_fit_panel_seconds_sum 1\n"
         "sts_arima_fit_panel_seconds_count 2\n"
     )
+
+
+def test_prometheus_empty_registry_exports_empty_string():
+    # a lone blank line is not valid exposition text
+    assert MetricsRegistry().to_prometheus() == ""
 
 
 # ---------------------------------------------------------------------------

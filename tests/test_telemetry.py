@@ -1,0 +1,786 @@
+"""Production telemetry plane (ISSUE 10): scrape exporter, job
+heartbeats/ETA/staleness, serving SLO windows, and the flight recorder.
+
+Acceptance scenarios covered here:
+
+- the exporter's four routes answer valid payloads *during* an active
+  ``stream_fit``, and scraping ``/metrics`` concurrently with a live
+  multi-chunk stream returns grammar-valid Prometheus text on every
+  scrape (the hammer test drives the same interleaving registry-side);
+- a ``stream_fit`` killed mid-job via ``kill_after_chunk`` leaves a
+  complete, schema-valid incident bundle in ``STS_INCIDENT_DIR``, and
+  the same journal then resumes cleanly (subprocess pair, slow-marked);
+- with the exporter armed and ``STS_SERVING_SLO_MS`` set, the warmed
+  ``ServingSession.update`` tick path stays pinned at 0 recompiles.
+
+Everything runs under ``make verify-telemetry`` (the ``telemetry``
+marker); the fast cases ride tier-1 too.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu import engine as E
+from spark_timeseries_tpu.utils import flightrec, metrics, telemetry
+from spark_timeseries_tpu.utils.metrics import MetricsRegistry
+
+pytestmark = pytest.mark.telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _panel(n_series=48, n_obs=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(n_series, n_obs)).astype(
+        np.float32).cumsum(axis=1)
+
+
+@pytest.fixture
+def exporter():
+    srv = telemetry.start(port=0)
+    yield srv
+    telemetry.stop()
+
+
+@pytest.fixture
+def incident_dir(tmp_path):
+    d = str(tmp_path / "incidents")
+    flightrec.configure(d)
+    yield d
+    flightrec.configure(None)
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition grammar (satellite: conformance + line format)
+# ---------------------------------------------------------------------------
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_HELP_RE = re.compile(rf"^# HELP {_NAME} [^\n]*$")
+_TYPE_RE = re.compile(
+    rf"^# TYPE ({_NAME}) (counter|gauge|summary|histogram|untyped)$")
+_LABELS = r'\{[a-zA-Z_][a-zA-Z0-9_]*="[^"\\\n]*"' \
+          r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"\\\n]*")*\}'
+_VALUE = r"(-?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?|[+-]?[Ii]nf|[Nn]a[Nn])"
+_SAMPLE_RE = re.compile(rf"^({_NAME})({_LABELS})? {_VALUE}$")
+
+
+def assert_prometheus_grammar(text: str) -> None:
+    """Validate every line against the exposition format 0.0.4 grammar
+    and the summary-type contract (each declared summary family must
+    emit its ``_sum`` and ``_count`` samples)."""
+    if text == "":
+        return
+    assert text.endswith("\n"), "exposition must end with a newline"
+    declared = {}
+    sampled = set()
+    for line in text[:-1].split("\n"):
+        assert line != "", "blank line inside exposition text"
+        if line.startswith("# HELP "):
+            assert _HELP_RE.match(line), f"bad HELP line: {line!r}"
+        elif line.startswith("# TYPE "):
+            m = _TYPE_RE.match(line)
+            assert m, f"bad TYPE line: {line!r}"
+            assert m.group(1) not in declared, \
+                f"duplicate TYPE for {m.group(1)}"
+            declared[m.group(1)] = m.group(2)
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"bad sample line: {line!r}"
+            sampled.add(m.group(1))
+    for name, kind in declared.items():
+        if kind == "summary":
+            assert f"{name}_sum" in sampled, f"{name}: missing _sum"
+            assert f"{name}_count" in sampled, f"{name}: missing _count"
+    # every sample belongs to a declared family (base name, or its
+    # summary _sum/_count companions)
+    for name in sampled:
+        base_ok = name in declared or any(
+            name == f"{d}{suffix}" and declared[d] == "summary"
+            for d in declared for suffix in ("_sum", "_count"))
+        assert base_ok, f"sample {name} has no TYPE declaration"
+
+
+def test_prometheus_grammar_and_help_lines():
+    reg = MetricsRegistry()
+    reg.inc("engine.chunks", 3)
+    reg.set_gauge("serving.session.s1.tick_p50_ms", 0.25)
+    reg.set_gauge("weird-name with spaces!", -1.5)
+    for v in (0.1, 0.2, 0.3):
+        reg.record("telemetry.scrape_s", v)
+    reg.histogram("empty.hist")             # count 0: sum/count only
+    reg.record_span("a.b/c.d", 0.5)
+    out = reg.to_prometheus()
+    assert_prometheus_grammar(out)
+    assert "# HELP sts_engine_chunks engine.chunks (counter)" in out
+    # summary with zero observations still emits the required samples
+    assert "sts_empty_hist_sum 0" in out
+    assert "sts_empty_hist_count 0" in out
+
+
+# ---------------------------------------------------------------------------
+# snapshot thread-safety hammer (satellite + concurrent-scrape acceptance)
+# ---------------------------------------------------------------------------
+
+def test_snapshot_hammer_under_concurrent_mutators():
+    """snapshot()/to_prometheus()/to_json() racing four mutator threads
+    must never raise, tear, or emit grammar-invalid text; counters read
+    monotonically."""
+    reg = MetricsRegistry()
+    stop = threading.Event()
+    errors = []
+
+    def mutate(i):
+        try:
+            k = 0
+            while not stop.is_set():
+                reg.inc("hammer.count")
+                reg.record(f"hammer.h{i}", k * 0.001)
+                reg.set_gauge("hammer.gauge", k)
+                reg.record_span(f"hammer.span{i % 2}", 0.0001 * k)
+                k += 1
+        except Exception as e:  # noqa: BLE001 — reported below
+            errors.append(e)
+
+    threads = [threading.Thread(target=mutate, args=(i,), daemon=True)
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    last = 0
+    deadline = time.time() + 2.0
+    scrapes = 0
+    while time.time() < deadline:
+        snap = reg.snapshot()
+        text = reg.to_prometheus()
+        assert_prometheus_grammar(text)
+        json.loads(reg.to_json())
+        now = snap["counters"].get("hammer.count", 0)
+        assert now >= last, "counter went backwards across snapshots"
+        last = now
+        for st in snap["histograms"].values():
+            if st["count"]:
+                assert st["sum"] == pytest.approx(st["mean"] * st["count"])
+        scrapes += 1
+    stop.set()
+    for t in threads:
+        t.join(5)
+    assert not errors, errors
+    assert scrapes > 10 and last > 0
+
+
+# ---------------------------------------------------------------------------
+# exporter lifecycle (satellite): all four routes live, clean shutdown
+# ---------------------------------------------------------------------------
+
+def test_exporter_lifecycle_scrapes_during_active_stream(exporter):
+    v = _panel(96, 64)
+    results = {}
+
+    def run():
+        results["res"] = E.FitEngine().stream_fit(
+            v, "ar", chunk_size=8, max_lag=2)
+
+    worker = threading.Thread(target=run)
+    worker.start()
+    metrics_bodies = []
+    try:
+        while worker.is_alive():
+            status, body = _get(exporter.url + "/metrics")
+            assert status == 200
+            metrics_bodies.append(body.decode())
+            time.sleep(0.01)
+    finally:
+        worker.join(120)
+    assert not worker.is_alive()
+    # every mid-stream scrape was grammar-valid (no torn reads)
+    assert metrics_bodies
+    for text in metrics_bodies:
+        assert_prometheus_grammar(text)
+
+    status, body = _get(exporter.url + "/snapshot.json")
+    snap = json.loads(body)
+    assert status == 200 and snap["format"] == 1
+    assert isinstance(snap["jobs"], list)
+    assert any(j["status"] == "done" and j["family"] == "ar"
+               for j in snap["recent_jobs"])
+    assert "engine.chunks" in snap["registry"]["counters"]
+
+    status, body = _get(exporter.url + "/trace.json?limit=64")
+    trace = json.loads(body)
+    assert status == 200 and "traceEvents" in trace
+    assert trace["otherData"]["events_exported"] <= 64
+
+    status, body = _get(exporter.url + "/healthz")
+    hz = json.loads(body)
+    assert status == 200 and hz["status"] == "ok"
+
+    with pytest.raises(urllib.error.HTTPError):
+        _get(exporter.url + "/no-such-route")
+
+    # double-start raises the named error; stop() leaves no thread
+    with pytest.raises(telemetry.TelemetryAlreadyStarted):
+        telemetry.start(port=0)
+    assert telemetry.stop() is True
+    assert not exporter.alive
+    with pytest.raises((urllib.error.URLError, OSError)):
+        _get(exporter.url + "/metrics", timeout=2)
+    assert results["res"].n_fitted == 96
+
+
+def test_env_port_optin_and_validation(monkeypatch):
+    monkeypatch.setenv("STS_TELEMETRY_PORT", "junk")
+    with pytest.raises(ValueError, match="STS_TELEMETRY_PORT"):
+        telemetry.ensure_started_from_env()
+    monkeypatch.setenv("STS_TELEMETRY_PORT", "0")
+    try:
+        srv = telemetry.ensure_started_from_env()
+        assert srv is not None and srv.alive
+        # idempotent: the running server is reused, not duplicated
+        assert telemetry.ensure_started_from_env() is srv
+    finally:
+        telemetry.stop()
+    monkeypatch.delenv("STS_TELEMETRY_PORT")
+    assert telemetry.ensure_started_from_env() is None
+    assert telemetry.server() is None
+
+
+# ---------------------------------------------------------------------------
+# heartbeats, ETA, staleness
+# ---------------------------------------------------------------------------
+
+def test_job_progress_eta_and_staleness_math():
+    p = telemetry.JobProgress("j1", "arima", 1000, 10, 100)
+    assert p.eta_s is None and p.chunks_remaining == 10
+    # journal restores count but never smooth the cadence
+    p.note_chunk_done(restored=True)
+    assert p.chunks_done == 1 and p.ew_chunk_s is None
+    p.note_chunk_done()
+    assert p.ew_chunk_s is not None
+    first = p.ew_chunk_s
+    p.note_chunk_done()
+    assert p.eta_s == pytest.approx(p.ew_chunk_s * p.chunks_remaining)
+    assert p.ew_chunk_s <= first + 1e-9  # EW folded a fast second chunk
+    # staleness: fresh heartbeat is healthy; an old one trips the
+    # factor x cadence threshold
+    assert not p.is_stale()
+    p.last_heartbeat_unix = time.time() - 10 * p.stale_after_s()
+    assert p.is_stale()
+    p.finish("done")
+    assert not p.is_stale()          # finished jobs never page
+    d = p.to_dict()
+    assert d["status"] == "done" and d["chunks_done"] == 3
+    assert d["chunks_restored"] == 1
+
+
+def test_healthz_reports_stale_job_as_503(exporter):
+    p = telemetry.JobProgress(telemetry.new_job_id("t"), "ar", 8, 4, 2)
+    telemetry.register_job(p)
+    try:
+        status, body = _get(exporter.url + "/healthz")
+        assert status == 200 and json.loads(body)["status"] == "ok"
+        p.last_heartbeat_unix = time.time() - 10 * p.stale_after_s()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(exporter.url + "/healthz")
+        assert ei.value.code == 503
+        doc = json.loads(ei.value.read())
+        assert doc["status"] == "stale"
+        (job,) = [j for j in doc["jobs"] if j["job_id"] == p.job_id]
+        assert job["stale"] and job["heartbeat_age_s"] \
+            > job["stale_after_s"]
+    finally:
+        telemetry.finish_job(p, "done")
+    status, body = _get(exporter.url + "/healthz")
+    assert status == 200
+
+
+def test_stream_fit_publishes_heartbeat_gauges_and_progress():
+    reg = metrics.get_registry()
+    seen = []
+    res = E.FitEngine().stream_fit(
+        _panel(40, 64), "ar", chunk_size=8, max_lag=2,
+        on_progress=lambda p: seen.append(
+            (p.chunks_done, p.heartbeat_stage)))
+    assert res.stats["job_id"].startswith("ar-")
+    assert [c for c, _ in seen] == [1, 2, 3, 4, 5]
+    g = reg.snapshot()["gauges"]
+    assert g["engine.job.chunks_done"] == 5.0
+    assert g["engine.job.chunks_total"] == 5.0
+    assert g["engine.job.chunks_failed"] == 0.0
+    assert "engine.job.chunk_s_ew" in g
+    done = [p for p in telemetry.recent_jobs()
+            if p.job_id == res.stats["job_id"]]
+    assert done and done[0].status == "done"
+    assert done[0].journal_commits == 0
+
+
+def test_degraded_subchunks_never_overcount_chunks_done():
+    """An OOM-degraded chunk's halves complete as sub-chunks: the whole
+    chunk is never double-counted, so chunks_done can't pass
+    chunks_total and the ETA math stays sane (review regression)."""
+    from spark_timeseries_tpu.utils import resilience
+
+    reg = MetricsRegistry()
+    seen = []
+    with resilience.fault_injection("oom_chunk", chunk_index=1):
+        res = E.FitEngine(registry=reg).stream_fit(
+            _panel(32, 64), "ar", chunk_size=8, max_lag=2,
+            degrade=True, degrade_floor=4,
+            on_progress=lambda p: seen.append(
+                (p.chunks_done, p.subchunks_done)))
+    assert res.n_fitted == 32 and not res.chunk_failures
+    assert res.stats["degraded_chunks"] == 1
+    last = [p for p in telemetry.recent_jobs()
+            if p.job_id == res.stats["job_id"]][0]
+    assert last.chunks_done == 3          # the split chunk stays out
+    assert last.subchunks_done == 2       # ...its halves count here
+    assert last.chunks_degraded == 1
+    assert all(done <= last.n_chunks for done, _ in seen)
+
+
+def test_trace_limit_junk_answers_400_and_env_positive_contract(exporter):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(exporter.url + "/trace.json?limit=5OOO")
+    assert ei.value.code == 400
+    status, _ = _get(exporter.url + "/trace.json?limit=5")
+    assert status == 200
+    # the shared env-knob parser: unset -> default, junk/non-positive
+    # raise the named error every knob shares
+    assert telemetry.env_positive("STS_NOT_SET_EVER", int, 7) == 7
+    os.environ["STS_TELEM_TEST_KNOB"] = "-3"
+    try:
+        with pytest.raises(ValueError, match="STS_TELEM_TEST_KNOB"):
+            telemetry.env_positive("STS_TELEM_TEST_KNOB", float)
+    finally:
+        del os.environ["STS_TELEM_TEST_KNOB"]
+
+
+def test_on_progress_callback_raising_is_dropped_not_fatal():
+    reg = MetricsRegistry()
+    calls = []
+
+    def bad(p):
+        calls.append(p.chunks_done)
+        raise RuntimeError("observer bug")
+
+    res = E.FitEngine(registry=reg).stream_fit(
+        _panel(24, 64), "ar", chunk_size=8, max_lag=2, on_progress=bad)
+    assert res.n_fitted == 24 and not res.chunk_failures
+    assert calls == [1]          # dropped after the first raise
+    assert reg.snapshot()["counters"]["engine.progress_cb_errors"] == 1
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: bundles, schema, retention
+# ---------------------------------------------------------------------------
+
+def test_dead_chunk_writes_schema_valid_bundles(incident_dir):
+    from spark_timeseries_tpu.utils import resilience
+
+    reg = MetricsRegistry()
+    eng = E.FitEngine(registry=reg)
+    v = _panel(32, 64)
+    with resilience.fault_injection("oom_chunk", chunk_index=1):
+        res = eng.stream_fit(v, "ar", chunk_size=8, max_lag=2,
+                             degrade=False, retry=0)
+    assert len(res.chunk_failures) == 1
+    incidents = flightrec.list_incidents(incident_dir)
+    kinds = {i["kind"] for i in incidents}
+    # the OOM could not split (degrade off) -> oom_at_floor at
+    # quarantine time, then chunk_dead when retries (0) exhausted
+    assert kinds == {"oom_at_floor", "chunk_dead"}
+    for inc in incidents:
+        bundle = flightrec.load_incident(inc["path"])
+        assert flightrec.validate_bundle(bundle) == []
+        assert bundle["exception"]["type"] == "InjectedOOM"
+        assert bundle["job"]["family"] == "ar"
+        assert bundle["job"]["chunks_total"] == 4
+        assert "counters" in bundle["registry"]
+        assert isinstance(bundle["trace"]["traceEvents"], list)
+        assert bundle["config"]["python"]
+    assert reg.snapshot()["counters"]["incidents.written"] == 2
+
+
+def test_stream_exception_bundle_and_reraise(incident_dir, monkeypatch):
+    from spark_timeseries_tpu.utils import resilience
+
+    eng = E.FitEngine(registry=MetricsRegistry())
+    # argument validation precedes job registration — no bundle for a
+    # plain caller error...
+    with pytest.raises(TypeError):
+        eng.stream_fit(_panel(8, 64), "ar", chunk_size=8, max_lag=2,
+                       retry=object())
+    assert flightrec.list_incidents(incident_dir) == []
+
+    # ...but an exception escaping the stream body (here: the failure
+    # router itself exploding while classifying a chunk death — chunk
+    # failures are isolated, so only un-modeled failures escape)
+    # records a bundle and re-raises
+    def boom(e):
+        raise RuntimeError("classifier exploded")
+
+    monkeypatch.setattr(E._durability, "is_oom", boom)
+    with resilience.fault_injection("oom_chunk", chunk_index=0):
+        with pytest.raises(RuntimeError, match="classifier exploded"):
+            eng.stream_fit(_panel(16, 64), "ar", chunk_size=8,
+                           max_lag=2)
+    (inc,) = flightrec.list_incidents(incident_dir)
+    assert inc["kind"] == "stream_exception"
+    bundle = flightrec.load_incident(inc["path"])
+    assert flightrec.validate_bundle(bundle) == []
+    assert bundle["exception"]["type"] == "RuntimeError"
+    assert bundle["job"]["status"] == "running"
+
+
+def test_retention_keeps_newest_k(incident_dir, monkeypatch):
+    monkeypatch.setenv("STS_INCIDENT_KEEP", "3")
+    paths = [flightrec.record_incident(f"k{i}") for i in range(5)]
+    assert all(paths)
+    left = flightrec.list_incidents(incident_dir)
+    assert [i["kind"] for i in left] == ["k4", "k3", "k2"]
+    # a junk STS_INCIDENT_KEEP is caught by the recorder's no-raise
+    # guarantee: nothing is written, the error is counted
+    monkeypatch.setenv("STS_INCIDENT_KEEP", "zero")
+    reg = MetricsRegistry()
+    assert flightrec.record_incident("boom", registry=reg) is None
+    assert reg.snapshot()["counters"]["incidents.errors"] == 1
+    assert len(flightrec.list_incidents(incident_dir)) == 3
+
+
+def test_recorder_disabled_and_failure_isolated(tmp_path):
+    assert flightrec.incident_dir() is None
+    assert flightrec.record_incident("nope") is None
+    # a recorder failure (incident dir is a file) is counted, not raised
+    blocker = tmp_path / "blocked"
+    blocker.write_text("not a directory")
+    flightrec.configure(str(blocker))
+    try:
+        reg = MetricsRegistry()
+        assert flightrec.record_incident("x", registry=reg) is None
+        assert reg.snapshot()["counters"]["incidents.errors"] == 1
+    finally:
+        flightrec.configure(None)
+
+
+def test_validate_bundle_flags_missing_pieces():
+    assert flightrec.validate_bundle({}) != []
+    assert flightrec.validate_bundle("nope") == [
+        "bundle is not a JSON object"]
+    good = {
+        "format": 1, "kind": "k", "time_unix": 1.0, "time_iso": "x",
+        "pid": 1, "exception": None, "job": None, "jobs": [],
+        "journal": None,
+        "registry": {"counters": {}, "gauges": {}, "histograms": {},
+                     "spans": {}},
+        "trace": {"traceEvents": []}, "config": {},
+    }
+    assert flightrec.validate_bundle(good) == []
+    bad = dict(good, trace={"oops": 1})
+    assert any("trace" in p for p in flightrec.validate_bundle(bad))
+
+
+def test_heal_failure_writes_incident(incident_dir):
+    import jax.numpy as jnp
+
+    from spark_timeseries_tpu import statespace as ss
+    from spark_timeseries_tpu.models import arima
+    from spark_timeseries_tpu.utils import resilience
+
+    rng = np.random.default_rng(3)
+    e = rng.normal(size=(6, 216)).astype(np.float32)
+    y = np.zeros_like(e)
+    for t in range(2, e.shape[1]):
+        y[:, t] = 0.5 * y[:, t - 1] - 0.2 * y[:, t - 2] + e[:, t]
+    hist, live = y[:, 16:200], y[:, 200:]
+    model = arima.fit(2, 0, 0, jnp.asarray(hist), warn=False)
+    sess = ss.ServingSession.start(model, hist,
+                                   registry=MetricsRegistry())
+    with resilience.fault_injection("state_poison", lane_stride=2):
+        sess.update(live[:, 0])
+    sess.update(live[:, 1])
+    assert (sess.lane_status == 2).any()
+    sess._heal_spec = {"family": "bogus"}       # force the refit to die
+    report = sess.heal()
+    assert "error" in report and report["healed"] == 0
+    (inc,) = flightrec.list_incidents(incident_dir)
+    assert inc["kind"] == "heal_failure"
+    bundle = flightrec.load_incident(inc["path"])
+    assert flightrec.validate_bundle(bundle) == []
+    assert bundle["exception"]["type"] == "NotImplementedError"
+    assert bundle["extra"]["session"]["label"] == sess.label
+
+
+# ---------------------------------------------------------------------------
+# serving SLO windows + the 0-recompile acceptance pin
+# ---------------------------------------------------------------------------
+
+def test_serving_slo_window_and_zero_recompiles(exporter, monkeypatch):
+    """Exporter armed + STS_SERVING_SLO_MS set: the warmed tick path
+    compiles nothing, the labeled p50/p95/SLO surface materializes,
+    and /metrics scrapes taken between ticks stay grammar-valid."""
+    import jax.numpy as jnp
+
+    from spark_timeseries_tpu import statespace as ss
+    from spark_timeseries_tpu.models import arima
+
+    monkeypatch.setenv("STS_SERVING_SLO_MS", "0.0001")  # burn every tick
+    metrics.install_jax_hooks()
+    v = _panel(16, 96, seed=7)
+    model = arima.fit(1, 1, 1, jnp.asarray(v[:, :80]), warn=False)
+    sess = ss.ServingSession.start(model, v[:, :80], label="slo-test")
+    sess.warmup()
+    before = metrics.jax_stats()["jit_compiles"]
+    for t in range(8):
+        sess.update(v[:, 80 + t])
+        status, body = _get(exporter.url + "/metrics")
+        assert status == 200
+        assert_prometheus_grammar(body.decode())
+    assert metrics.jax_stats()["jit_compiles"] - before == 0
+    snap = metrics.snapshot()
+    pre = "serving.session.slo-test"
+    assert snap["counters"][f"{pre}.slo_burns"] == 8
+    assert snap["gauges"][f"{pre}.tick_p50_ms"] > 0
+    assert snap["gauges"][f"{pre}.tick_p95_ms"] >= \
+        snap["gauges"][f"{pre}.tick_p50_ms"]
+    assert snap["gauges"][f"{pre}.quarantined_lanes"] == 0
+    # the session summary reaches /snapshot.json under its label
+    _, body = _get(exporter.url + "/snapshot.json")
+    sessions = json.loads(body)["serving_sessions"]
+    (mine,) = [s for s in sessions if s.get("label") == "slo-test"]
+    assert mine["slo_burns"] == 8 and mine["window"] == 8
+    stats = sess.tick_latency_stats()
+    assert stats["slo_ms"] == pytest.approx(0.0001)
+    assert stats["tick_p95_ms"] >= stats["tick_p50_ms"]
+
+
+def test_serving_slo_env_validation_and_label_rules(monkeypatch):
+    import jax.numpy as jnp
+
+    from spark_timeseries_tpu import statespace as ss
+    from spark_timeseries_tpu.models import arima
+
+    v = _panel(8, 64, seed=9)
+    model = arima.fit(1, 0, 0, jnp.asarray(v), warn=False)
+    monkeypatch.setenv("STS_SERVING_SLO_MS", "fast")
+    with pytest.raises(ValueError, match="STS_SERVING_SLO_MS"):
+        ss.ServingSession.start(model, v)
+    monkeypatch.delenv("STS_SERVING_SLO_MS")
+    with pytest.raises(ValueError, match="label"):
+        ss.ServingSession.start(model, v, label="bad label!")
+    a = ss.ServingSession.start(model, v)
+    b = ss.ServingSession.start(model, v)
+    assert a.label != b.label           # default labels stay distinct
+    a.update(v[:, -1])
+    assert a.tick_latency_stats()["slo_ms"] is None  # no SLO -> no burns
+
+
+# ---------------------------------------------------------------------------
+# sts_top rendering + CLI
+# ---------------------------------------------------------------------------
+
+def _fake_snapshot():
+    return {
+        "format": 1, "pid": 4242, "time_unix": 1000.0, "uptime_s": 75.0,
+        "registry": {"counters": {"telemetry.scrapes": 9,
+                                  "incidents.written": 1},
+                     "gauges": {}, "histograms": {}, "spans": {}},
+        "jax": {"jit_compiles": 12},
+        "jobs": [{
+            "job_id": "arima-1-1", "family": "arima", "status": "running",
+            "chunks_total": 8, "chunks_done": 3, "chunks_failed": 1,
+            "chunks_quarantined": 2, "chunks_degraded": 0,
+            "journal_commits": 3, "eta_s": 125.0,
+            "throughput_series_per_s": 2048.0,
+            "heartbeat_age_s": 900.0, "stale_after_s": 300.0,
+            "heartbeat_stage": "materialize",
+        }],
+        "recent_jobs": [],
+        "serving_sessions": [{
+            "label": "us-east", "family": "arima", "n_series": 1024,
+            "ticks_seen": 777, "tick_p50_ms": 1.234, "tick_p95_ms": 4.2,
+            "slo_burns": 3, "quarantined_lanes": 2,
+            "health": {"ok": 1022, "diverged": 2},
+        }],
+        "incident_dir": "/tmp/incidents",
+        "incidents": [{"file": "incident_1_2_chunk_dead.json",
+                       "path": "/tmp/incidents/x.json",
+                       "kind": "chunk_dead", "time_unix": 940.0,
+                       "bytes": 2048}],
+    }
+
+
+def test_sts_top_render_snapshot():
+    from tools import sts_top
+
+    frame = sts_top.render_snapshot(_fake_snapshot())
+    assert "arima-1-1" in frame
+    assert "3/8" in frame
+    assert "2m05s" in frame              # ETA formatting
+    assert "STALE" in frame              # heartbeat age > threshold
+    assert "us-east" in frame and "1.234" in frame
+    assert "chunk_dead" in frame
+    assert "2048/s" in frame
+    # empty snapshot renders the placeholders, not a crash
+    empty = sts_top.render_snapshot({"pid": 1})
+    assert "no active streaming jobs" in empty
+    assert "recorder off" in empty
+
+
+def test_sts_top_cli_once_against_live_exporter(exporter, capsys):
+    from tools import sts_top
+
+    E.FitEngine().stream_fit(_panel(16, 64), "ar", chunk_size=8,
+                             max_lag=2)
+    assert sts_top.main([exporter.url, "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "sts_top — pid" in out and "SERVING" in out
+    assert sts_top.main(["http://127.0.0.1:9/", "--once"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# bench gate: --json + incidents_written zero-baseline
+# ---------------------------------------------------------------------------
+
+def _round_file(tmp_path, n, value, incidents=None, extra_metrics=None):
+    m = {"spans": {}}
+    if incidents is not None:
+        m["telemetry"] = {"heartbeat_gauges": True,
+                          "incidents_written": incidents}
+    if extra_metrics:
+        m.update(extra_metrics)
+    headline = {"metric": "demo", "value": value, "unit": "series/sec",
+                "platform": "cpu", "metrics": m}
+    wrapper = {"n": n, "cmd": "python bench.py", "rc": 0, "tail": "",
+               "parsed": headline}
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps(wrapper))
+
+
+def test_gate_zero_baselines_incidents_written(tmp_path):
+    from tools import bench_gate
+
+    for n in (1, 2, 3):
+        _round_file(tmp_path, n, 1000.0, incidents=0)
+    _round_file(tmp_path, 4, 1000.0, incidents=2)   # bench crashed twice
+    verdict = bench_gate.evaluate(bench_gate.load_history(str(tmp_path)))
+    rows = {r["metric"]: r for r in verdict["rows"]}
+    assert verdict["status"] == "regressed"
+    assert rows["incidents_written"]["status"] == "REGRESSED"
+    assert rows["incidents_written"]["delta_pct"] is None  # 0 baseline
+    # block present + key absent reads as a measured 0 (not skipped)
+    got = bench_gate.extract_metrics(
+        {"value": 1.0, "metrics": {"telemetry": {"heartbeat_gauges":
+                                                 True}}})
+    assert got["incidents_written"] == 0.0
+    # no telemetry block at all (old rounds): no fabricated zeros
+    got = bench_gate.extract_metrics({"value": 1.0, "metrics": {}})
+    assert "incidents_written" not in got
+
+
+def test_gate_json_output_machine_readable(tmp_path, capsys):
+    from tools import bench_gate
+
+    for n in (1, 2, 3):
+        _round_file(tmp_path, n, 1000.0, incidents=0)
+    _round_file(tmp_path, 4, 1000.0, incidents=1)
+    rc = bench_gate.main(["--dir", str(tmp_path), "--json"])
+    assert rc == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["status"] == "regressed" and doc["exit_code"] == 1
+    rows = {r["metric"]: r for r in doc["rows"]}
+    assert rows["incidents_written"]["status"] == "REGRESSED"
+    # clean history passes with exit_code 0 in the payload
+    _round_file(tmp_path, 4, 1000.0, incidents=0)
+    rc = bench_gate.main(["--dir", str(tmp_path), "--json"])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out)["exit_code"] == 0
+
+
+# ---------------------------------------------------------------------------
+# kill -9 forensics + clean resume (the acceptance subprocess pair)
+# ---------------------------------------------------------------------------
+
+_KILL_CHILD = """
+import contextlib, hashlib, json, os
+import numpy as np
+from spark_timeseries_tpu import engine as E
+from spark_timeseries_tpu.utils import resilience
+
+rng = np.random.default_rng(0)
+v = rng.normal(size=(128, 48)).astype(np.float32).cumsum(axis=1)
+ctx = resilience.fault_injection("kill_after_chunk", chunk_index=1) \\
+    if os.environ.get("STS_TEST_KILL") == "1" else contextlib.nullcontext()
+with ctx:
+    res = E.FitEngine().stream_fit(
+        v, "ar", chunk_size=32, max_lag=2, collect=True,
+        journal=os.environ["STS_TEST_JOURNAL"])
+h = hashlib.sha256()
+for m in res.models:
+    h.update(np.ascontiguousarray(np.asarray(m.coefficients)).tobytes())
+print(json.dumps({
+    "sha": h.hexdigest(), "n_fitted": res.n_fitted,
+    "journal_hits": res.stats["journal_hits"],
+    "journal_commits": res.stats["journal_commits"]}))
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(900)
+def test_kill9_leaves_bundle_and_journal_resumes(tmp_path):
+    """ISSUE 10 acceptance: a stream_fit killed mid-job by the
+    kill_after_chunk fault leaves a complete, schema-valid incident
+    bundle in STS_INCIDENT_DIR (written immediately before the injected
+    SIGKILL), and the same journal then resumes cleanly — bundle
+    writing corrupted neither the journal nor the resume path."""
+    jdir = str(tmp_path / "journal")
+    idir = str(tmp_path / "incidents")
+    cache = tmp_path / "xla-cache"
+    cache.mkdir()
+    base_env = dict(os.environ, JAX_PLATFORMS="cpu",
+                    STS_COMPILE_CACHE=str(cache),
+                    STS_TEST_JOURNAL=jdir)
+
+    def run(**extra):
+        return subprocess.run([sys.executable, "-c", _KILL_CHILD],
+                              capture_output=True, text=True, cwd=REPO,
+                              env=dict(base_env, **extra), timeout=600)
+
+    # run A: incident dir armed, SIGKILLed after chunk 1's commit
+    out_a = run(STS_TEST_KILL="1", STS_INCIDENT_DIR=idir)
+    assert out_a.returncode == -9, (out_a.returncode, out_a.stderr[-2000:])
+    (inc,) = flightrec.list_incidents(idir)
+    assert inc["kind"] == "kill_after_chunk"
+    bundle = flightrec.load_incident(inc["path"])
+    assert flightrec.validate_bundle(bundle) == []
+    assert bundle["extra"]["chunk"] == [32, 64]
+    assert bundle["job"]["family"] == "ar"
+    assert bundle["job"]["journal_commits"] == 2
+    assert bundle["journal"]["path"] == jdir
+    assert bundle["journal"]["n_committed"] == 2
+    assert bundle["registry"]["counters"]["engine.journal_commits"] == 2
+
+    # run B: same journal, no fault — resumes the two committed chunks
+    out_b = run()
+    assert out_b.returncode == 0, out_b.stderr[-2000:]
+    rec_b = json.loads(out_b.stdout.strip().splitlines()[-1])
+    assert rec_b["journal_hits"] == 2
+    assert rec_b["journal_commits"] == 2
+    assert rec_b["n_fitted"] == 128
+
+    # run C: fresh journal, uninterrupted — bitwise-identical results
+    out_c = run(STS_TEST_JOURNAL=str(tmp_path / "journal_c"))
+    assert out_c.returncode == 0, out_c.stderr[-2000:]
+    assert rec_b["sha"] == json.loads(
+        out_c.stdout.strip().splitlines()[-1])["sha"]

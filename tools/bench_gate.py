@@ -32,6 +32,7 @@ automated check (``make gate``):
   resilience_auto_fallback_dead ``metrics.fit_counters[...auto_fallback_dead]`` higher
   heal_p50                      ``metrics.spans["serving.heal"]`` p50         higher
   long_obs_per_s                headline ``long_demo.obs_per_s``              lower
+  incidents_written             ``metrics.telemetry["incidents_written"]``    higher
   ============================  ============================================  ======
 
   (``engine_cache_misses`` is the streaming engine's executable-cache
@@ -75,8 +76,16 @@ automated check (``make gate``):
   the combiner grew host round-trips, ...).  Like the serving SLO it
   is absent in rounds that predate the tier — no fabricated zeros.)
 
-- prints a pass/fail table with signed percentage deltas and exits 1 on
-  any regression, 0 otherwise.  A newest round that crashed (``rc != 0``)
+  ``incidents_written`` is the flight recorder's bundle counter
+  (ISSUE 10), zero-baselined: a bench round must not organically crash
+  — any round where ``stream_fit`` chunks started dying, deadlines
+  started expiring, or a stream exception escaped writes bundles, and
+  the first such round is flagged against an all-zero history.
+  Tolerated-absent in rounds that predate the telemetry block.
+
+- prints a pass/fail table with signed percentage deltas (``--json``
+  emits the same verdict as machine-readable JSON for CI, exit codes
+  unchanged) and exits 1 on any regression, 0 otherwise.  A newest round that crashed (``rc != 0``)
   or carries no measured headline value fails outright — a broken bench
   is the regression, not a reason to skip.  Fewer than ``--min-history``
   comparable prior rounds passes with an ``insufficient history`` note
@@ -118,6 +127,7 @@ METRICS = [
     ("resilience_auto_fallback_dead", "lower_better", 50.0),
     ("heal_p50", "lower_better", 50.0),
     ("long_obs_per_s", "higher_better", 25.0),
+    ("incidents_written", "lower_better", 50.0),
 ]
 
 
@@ -261,6 +271,19 @@ def extract_metrics(headline: Optional[dict]) -> Dict[str, float]:
             v = fc.get("resilience.auto_fallback_dead", 0)
             if isinstance(v, (int, float)):
                 out["resilience_auto_fallback_dead"] = float(v)
+        # flight-recorder counter (ISSUE 10), zero-baselined like the
+        # engine's reliability counters: a telemetry block present with
+        # the key absent means the round wrote no incident bundles — a
+        # measured 0 that seeds the baseline, so the first round where
+        # a bench run organically crashes (deadline expiries, dead
+        # chunks, stream exceptions) is flagged even though a 0
+        # baseline admits no percentage.  Absent in pre-telemetry
+        # rounds — no fabricated zeros.
+        tel = m.get("telemetry")
+        if isinstance(tel, dict):
+            v = tel.get("incidents_written", 0)
+            if isinstance(v, (int, float)):
+                out["incidents_written"] = float(v)
     return out
 
 
@@ -400,18 +423,29 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--strict", action="store_true",
                     help="fail (exit 2) on insufficient history instead of "
                          "passing")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the verdict as machine-readable JSON "
+                         "instead of the table (CI consumption; exit "
+                         "codes unchanged, and the payload carries them "
+                         "as 'exit_code')")
     args = ap.parse_args(argv)
 
     history = load_history(args.dir, args.glob)
     verdict = evaluate(history, window=args.window,
                        min_history=args.min_history,
                        threshold_override=args.threshold)
-    print(render(verdict))
     if verdict["status"] == "regressed":
-        return 1
-    if verdict["status"] == "insufficient-history" and args.strict:
-        return 2
-    return 0
+        code = 1
+    elif verdict["status"] == "insufficient-history" and args.strict:
+        code = 2
+    else:
+        code = 0
+    if args.json:
+        print(json.dumps(dict(verdict, exit_code=code), indent=2,
+                         sort_keys=True))
+    else:
+        print(render(verdict))
+    return code
 
 
 if __name__ == "__main__":

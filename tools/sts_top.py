@@ -1,0 +1,239 @@
+"""sts_top: a terminal dashboard over a live telemetry endpoint.
+
+``python -m tools.sts_top http://127.0.0.1:<port>`` tails the
+exporter's ``/snapshot.json`` (``utils.telemetry``; armed with
+``STS_TELEMETRY_PORT`` or ``telemetry.start()``) and renders, curses-free
+(plain ANSI, any terminal or a CI log):
+
+- **jobs**: per-``stream_fit`` progress — chunks done/total, failures/
+  quarantines/degradations, journal commits, EW throughput, ETA, and
+  the heartbeat age (with a ``STALE`` flag past the staleness
+  threshold, the same contract ``/healthz`` serves);
+- **serving**: per-session lane health and the rolling tick-latency
+  window — p50/p95 ms, SLO burns against ``STS_SERVING_SLO_MS``,
+  quarantined lanes;
+- **incidents**: the flight recorder's newest bundles (kind, age,
+  size) so a crash's forensics are one glance away.
+
+``--once`` prints a single frame and exits (scripts/CI); the default
+loop redraws every ``--interval`` seconds until Ctrl-C.  Rendering is
+pure (``render_snapshot(dict) -> str``), so tests drive it without a
+server.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+CLEAR = "\x1b[2J\x1b[H"
+
+
+def fetch_snapshot(url: str, timeout: float = 5.0) -> Dict[str, Any]:
+    """GET ``<url>/snapshot.json`` (a bare host:port URL is enough)."""
+    base = url.rstrip("/")
+    if not base.startswith("http"):
+        base = "http://" + base
+    with urllib.request.urlopen(base + "/snapshot.json",
+                                timeout=timeout) as resp:
+        return json.load(resp)
+
+
+def _fmt_eta(seconds: Optional[float]) -> str:
+    if seconds is None:
+        return "-"
+    s = int(seconds)
+    if s >= 3600:
+        return f"{s // 3600}h{(s % 3600) // 60:02d}m"
+    if s >= 60:
+        return f"{s // 60}m{s % 60:02d}s"
+    return f"{s}s"
+
+
+def _fmt_age(seconds: Optional[float]) -> str:
+    return "-" if seconds is None else _fmt_eta(seconds)
+
+
+def _table(headers: List[str], rows: List[List[str]]) -> List[str]:
+    widths = [len(h) for h in headers]
+    for row in rows:
+        for i, cell in enumerate(row):
+            widths[i] = max(widths[i], len(cell))
+    out = ["  ".join(h.ljust(w) for h, w in zip(headers, widths))]
+    out.append("  ".join("-" * w for w in widths))
+    for row in rows:
+        out.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    return out
+
+
+def _job_rows(jobs: List[Dict[str, Any]]) -> List[List[str]]:
+    rows = []
+    for j in jobs:
+        stale = j.get("heartbeat_age_s") is not None \
+            and j.get("stale_after_s") is not None \
+            and j.get("status") == "running" \
+            and j["heartbeat_age_s"] > j["stale_after_s"]
+        status = j.get("status", "?")
+        if stale:
+            status = "STALE"
+        thr = j.get("throughput_series_per_s")
+        rows.append([
+            str(j.get("job_id", "?")),
+            str(j.get("family", "?")),
+            f"{j.get('chunks_done', 0)}/{j.get('chunks_total', '?')}",
+            str(j.get("chunks_failed", 0)),
+            str(j.get("chunks_quarantined", 0)),
+            str(j.get("chunks_degraded", 0)),
+            str(j.get("journal_commits", 0)),
+            f"{thr:.0f}/s" if isinstance(thr, (int, float)) else "-",
+            _fmt_eta(j.get("eta_s")),
+            _fmt_age(j.get("heartbeat_age_s")),
+            f"{j.get('heartbeat_stage', '-')}",
+            status,
+        ])
+    return rows
+
+
+def _serving_rows(sessions: List[Dict[str, Any]]) -> List[List[str]]:
+    rows = []
+    for s in sessions:
+        if "error" in s and "label" not in s:
+            rows.append(["?", "?", "-", "-", "-", "-", "-", "-",
+                         s["error"][:40]])
+            continue
+        health = s.get("health") or {}
+        hstr = " ".join(f"{k}:{v}" for k, v in sorted(health.items())) \
+            or "-"
+        p50 = s.get("tick_p50_ms")
+        p95 = s.get("tick_p95_ms")
+        rows.append([
+            str(s.get("label", "?")),
+            str(s.get("family", "?")),
+            str(s.get("n_series", "?")),
+            str(s.get("ticks_seen", "?")),
+            f"{p50:.3f}" if isinstance(p50, (int, float)) else "-",
+            f"{p95:.3f}" if isinstance(p95, (int, float)) else "-",
+            str(s.get("slo_burns", 0)),
+            str(s.get("quarantined_lanes", 0)),
+            hstr,
+        ])
+    return rows
+
+
+def _incident_rows(incidents: List[Dict[str, Any]],
+                   now: float) -> List[List[str]]:
+    rows = []
+    for inc in incidents:
+        if "error" in inc and "file" not in inc:
+            rows.append(["?", "-", "-", inc["error"][:60]])
+            continue
+        t = inc.get("time_unix")
+        age = _fmt_age(max(now - t, 0.0)) if isinstance(
+            t, (int, float)) else "-"
+        size = inc.get("bytes")
+        rows.append([
+            str(inc.get("kind", "?")),
+            age,
+            f"{size / 1024:.0f}K" if isinstance(size, (int, float))
+            else "-",
+            str(inc.get("file", "?")),
+        ])
+    return rows
+
+
+def render_snapshot(snap: Dict[str, Any]) -> str:
+    """One full frame from a ``/snapshot.json`` payload (pure)."""
+    now = snap.get("time_unix") or time.time()
+    counters = (snap.get("registry") or {}).get("counters", {})
+    jx = snap.get("jax") or {}
+    lines = [
+        f"sts_top — pid {snap.get('pid', '?')}  "
+        f"uptime {_fmt_age(snap.get('uptime_s'))}  "
+        f"scrapes {counters.get('telemetry.scrapes', 0)}  "
+        f"jit_compiles {jx.get('jit_compiles', '-')}  "
+        f"incidents {counters.get('incidents.written', 0)}",
+        "",
+    ]
+    jobs = list(snap.get("jobs") or [])
+    recent = [j for j in (snap.get("recent_jobs") or [])
+              if j.get("status") != "done" or j.get("chunks_failed")]
+    lines.append(f"JOBS ({len(jobs)} active)")
+    all_jobs = jobs + recent[-4:]
+    if all_jobs:
+        lines += _table(
+            ["JOB", "FAMILY", "CHUNKS", "FAIL", "QUAR", "DEG", "JRNL",
+             "RATE", "ETA", "HB-AGE", "STAGE", "STATUS"],
+            _job_rows(all_jobs))
+    else:
+        lines.append("  (no active streaming jobs)")
+    lines.append("")
+
+    sessions = list(snap.get("serving_sessions") or [])
+    lines.append(f"SERVING ({len(sessions)} sessions)")
+    if sessions:
+        lines += _table(
+            ["SESSION", "FAMILY", "SERIES", "TICKS", "P50ms", "P95ms",
+             "SLO-BURN", "QUAR", "HEALTH"],
+            _serving_rows(sessions))
+    else:
+        lines.append("  (no live serving sessions)")
+    lines.append("")
+
+    incidents = list(snap.get("incidents") or [])
+    dirname = snap.get("incident_dir")
+    lines.append(f"INCIDENTS"
+                 + (f" ({dirname})" if dirname else " (recorder off)"))
+    if incidents:
+        lines += _table(["KIND", "AGE", "SIZE", "FILE"],
+                        _incident_rows(incidents, now))
+    else:
+        lines.append("  (none recorded)")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.sts_top",
+        description="Tail a live telemetry endpoint's /snapshot.json and "
+                    "render job progress, ETA, serving lane health, and "
+                    "recent incidents.")
+    ap.add_argument("url", help="exporter base URL, e.g. "
+                               "http://127.0.0.1:8321 (the value of "
+                               "telemetry.start().url)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit (scripts/CI)")
+    ap.add_argument("--no-clear", action="store_true",
+                    help="append frames instead of clearing the screen")
+    args = ap.parse_args(argv)
+
+    while True:
+        try:
+            snap = fetch_snapshot(args.url)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"sts_top: cannot scrape {args.url}: {e}",
+                  file=sys.stderr)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        frame = render_snapshot(snap)
+        if args.once:
+            sys.stdout.write(frame)
+            return 0
+        sys.stdout.write(("" if args.no_clear else CLEAR) + frame)
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
