@@ -14,8 +14,8 @@ WARMUP_SERVING ?=
 STS_COMPILE_CACHE ?=
 
 .PHONY: help verify compileall tier1 verify-faults verify-durability \
-	verify-perf verify-serving verify-long verify-telemetry gate trace \
-	lint lint-baseline contracts verify-static warmup
+	verify-perf verify-serving verify-long verify-telemetry verify-fleet \
+	gate trace lint lint-baseline contracts verify-static warmup
 
 help:
 	@echo "Targets:"
@@ -37,6 +37,8 @@ help:
 	@echo "                AR-truncation combiner, journaled segment streams, exact forecast)"
 	@echo "  verify-telemetry live telemetry suite (scrape exporter lifecycle, heartbeats/ETA,"
 	@echo "                serving SLO windows, flight-recorder bundles incl. kill -9 forensics)"
+	@echo "  verify-fleet  multi-tenant fleet suite (admission/backpressure, coalesced ticks"
+	@echo "                bitwise-pinned, SLO shedding + cached forecasts, drain/adopt kill -9)"
 	@echo "  verify-perf   perf gate: newest BENCH_r*.json vs trailing-median baseline"
 	@echo "  gate          same as verify-perf (tools/bench_gate.py; exit 1 on regression)"
 	@echo "  trace         run a small demo workload, write trace.json (open in ui.perfetto.dev)"
@@ -87,17 +89,24 @@ tier1:
 # fallback chain, which runs clean (fallback stages must be able to
 # SUCCEED here, or a regression in them would be invisible).  Plain fits
 # are unaffected; the bit-for-bit equivalence tests skip themselves
-# under this flag.  The serving-marked suite (including its slow cases —
+# under this flag.  The fleet-marked suite rides along the same way:
+# its admission/coalescing/shed/migration scenarios (and the
+# tenant_flood / coalesce_straggler / drop_tenant_process fault modes)
+# must hold when every resilient refit underneath is also being forced
+# through its retry path.  The serving-marked suite (including its slow cases —
 # the end-to-end poison -> quarantine -> heal scenario and the χ²-band
 # false-positive pin, which use the tick_corrupt_* / state_poison fault
 # modes) runs under the same env, so heal()'s batch refit exercises its
 # forced-retry path too.
-verify-faults: verify-durability verify-telemetry
+verify-faults: verify-durability verify-telemetry verify-fleet
 	STS_FAULT_INJECT=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 		-m 'not slow' --continue-on-collection-errors \
 		-p no:cacheprovider -p no:xdist -p no:randomly
 	STS_FAULT_INJECT=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 		-m serving --continue-on-collection-errors \
+		-p no:cacheprovider -p no:xdist -p no:randomly
+	STS_FAULT_INJECT=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
+		-m fleet --continue-on-collection-errors \
 		-p no:cacheprovider -p no:xdist -p no:randomly
 
 # durable-streaming gate (ISSUE 6): the `durability`-marked subset
@@ -135,6 +144,17 @@ verify-telemetry:
 # and the zero-recompile pin on warmed per-tick updates
 verify-serving:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m serving \
+		--continue-on-collection-errors -p no:cacheprovider \
+		-p no:xdist -p no:randomly
+
+# multi-tenant fleet gate (ISSUE 12): the `fleet`-marked subset —
+# coalesced-vs-sequential bitwise pin across tenants sharing a bucket,
+# flood -> reject -> recover, shed -> cache-serve -> restore, the
+# drain/adopt kill -9 subprocess pair proving bitwise tenant migration,
+# bundle mismatch rejections, and the warmed-tick 0-recompile pin with
+# the scheduler armed; includes the slow subprocess cases tier-1 skips
+verify-fleet:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m fleet \
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly
 

@@ -813,6 +813,77 @@ def main():
             # failure must not void the already-measured curve
             serving_demo = {"error": f"{type(e).__name__}: {e}"}
 
+    # fleet demo (ISSUE 12): multiplex ≥64 tenant sessions onto one
+    # coalesced update executable through the FleetScheduler and measure
+    # the aggregate lane-tick throughput plus the pooled per-tick p99
+    # across every session's latency window.  Runs on a PRIVATE registry
+    # (like the heal demo) so the injected-load numbers never pollute
+    # the global serving counters; the block's own shed_lanes field is
+    # what tools/bench_gate.py zero-baselines (a bench fleet must not
+    # shed under its own nominal load), and fleet_ticks_per_s is gated
+    # higher-is-better once two rounds carry it.
+    fleet_demo = None
+    if error is None and os.environ.get("BENCH_FLEET", "1") == "1":
+        try:
+            from spark_timeseries_tpu.statespace import (AdmissionPolicy,
+                                                         FleetScheduler)
+            from spark_timeseries_tpu.statespace import serving as sstate
+
+            n_sessions = max(2, int(os.environ.get("BENCH_FLEET_SESSIONS",
+                                                   "64")))
+            per = max(8, int(os.environ.get("BENCH_FLEET_SERIES", "16")))
+            rounds = max(1, int(os.environ.get("BENCH_FLEET_TICKS", "32")))
+            need = n_sessions * per
+            fl_panel = _synthetic_arima_panel(need, 65 + rounds, seed=5)
+            # differenced slices are stationary AR(2)-ish; one shared
+            # order keeps every tenant in ONE coalescing group
+            fl_hist = np.diff(fl_panel, axis=1).astype(np_dtype)
+            fleet_reg = metrics.MetricsRegistry()
+            with metrics.span("bench.fleet_demo"):
+                fl_model = arima.fit(2, 0, 0,
+                                     jnp.asarray(fl_hist[:per, :64]),
+                                     warn=False)
+                sched = FleetScheduler(AdmissionPolicy(queue_depth=4),
+                                       registry=fleet_reg,
+                                       auto_pump=False)
+                for i in range(n_sessions):
+                    sess = sstate.ServingSession.start(
+                        fl_model, fl_hist[i * per:(i + 1) * per, :64],
+                        label=f"bench-t{i}", registry=fleet_reg)
+                    sched.attach(sess)
+                sched.warmup()             # compile outside the timing
+                live = fl_hist[:, 64:64 + rounds]
+                t0 = time.perf_counter()
+                for t in range(rounds):
+                    for i in range(n_sessions):
+                        sched.submit(f"bench-t{i}",
+                                     live[i * per:(i + 1) * per, t])
+                    sched.pump()
+                fleet_s = time.perf_counter() - t0
+                pooled = np.concatenate([
+                    np.fromiter(sched.session(la)._tick_lat,
+                                dtype=np.float64)
+                    for la in sched.tenants]) * 1e3
+            fl_counters = fleet_reg.snapshot()["counters"]
+            fleet_demo = {
+                "sessions": n_sessions,
+                "series_per_session": per,
+                "ticks": rounds,
+                "coalesced_dispatches": int(
+                    fl_counters.get("fleet.coalesced_dispatches", 0)),
+                "fleet_ticks_per_s": round(
+                    n_sessions * per * rounds / fleet_s, 1),
+                "tick_p99_ms": round(float(np.percentile(pooled, 99)), 3),
+                "tick_p50_ms": round(float(np.percentile(pooled, 50)), 3),
+                "shed_lanes": int(fl_counters.get("fleet.shed_lanes", 0)),
+                "slo_burns": int(fl_counters.get("fleet.slo_burns", 0)),
+                "rejected": int(fl_counters.get("fleet.rejected", 0)),
+                "seconds": round(fleet_s, 3),
+            }
+        except Exception as e:  # noqa: BLE001 — optional extra; its
+            # failure must not void the already-measured curve
+            fleet_demo = {"error": f"{type(e).__name__}: {e}"}
+
     # ultra-long demo (ISSUE 8): one 10⁶-observation synthetic ARMA
     # series fitted end-to-end through the DARIMA split-and-combine tier
     # — global differencing, obs-axis segmentation, segments streamed as
@@ -975,6 +1046,7 @@ def main():
         "refit_demo": refit_demo,
         "resilience_demo": resilience_demo,
         "serving_demo": serving_demo,
+        "fleet_demo": fleet_demo,
         "long_demo": long_demo,
         "cost_reports": cost_reports,
         "baseline_emulation": {

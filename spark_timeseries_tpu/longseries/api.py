@@ -154,13 +154,21 @@ class LongSeriesFit:
             raise ValueError("forecast needs horizon >= 1")
         import jax.numpy as jnp
 
+        from ..statespace.health import HealthPolicy, initial_health
         from ..statespace.serving import _jitted
 
         ssm, meta, origin = self.forecast_origin()
         offs = jnp.zeros((1, horizon), self._dtype)
+        # the shared serving forecast program is health-aware (PR 9);
+        # a freshly recovered origin is by construction an all-OK lane,
+        # so the default policy + initial health reproduce the plain
+        # mean propagation (quarantine masks nothing)
+        policy = HealthPolicy().validate()
+        health = initial_health(origin)
         with _metrics.span("longseries.forecast"):
-            out = np.asarray(_jitted("forecast")(meta, horizon, ssm,
-                                                 origin, offs))
+            out = np.asarray(_jitted("forecast")(meta, horizon, policy,
+                                                 ssm, origin, health,
+                                                 offs))
         return out[0]
 
     @property

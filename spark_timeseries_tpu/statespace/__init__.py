@@ -18,14 +18,18 @@ Layout: :mod:`ssm` (representation + filter-state pytrees), :mod:`kalman`
 :mod:`convert` (fitted model → state-space form + bootstrap calibration),
 :mod:`health` (per-lane in-graph divergence detection + quarantine),
 :mod:`serving` (warm sessions, tick ingest, lane healing,
-checkpoint/restore).
+checkpoint/restore), :mod:`fleet` (the multi-tenant front-end:
+admission control, tick coalescing onto the shared executables,
+SLO-aware shedding, checkpoint-based lane migration).
 """
 
-from . import convert, health, kalman, serving, ssm  # noqa: F401
+from . import convert, fleet, health, kalman, serving, ssm  # noqa: F401
+from .fleet import (AdmissionPolicy, FleetRestoreMismatch,  # noqa: F401
+                    FleetSaturated, FleetScheduler)
 from .convert import Bootstrapped, bootstrap, to_statespace  # noqa: F401
 from .health import (LANE_DIVERGED, LANE_OK, LANE_SUSPECT,  # noqa: F401
                      HealthPolicy, LaneHealth, initial_health,
-                     monitor_panel, monitored_step)
+                     monitor_panel, monitored_step, shed_priority)
 from .kalman import (FilterResult, concentrated_loglik,  # noqa: F401
                      filter_forecast_origin, filter_panel,
                      filter_panel_parallel, filter_step_panel,
@@ -36,7 +40,7 @@ from .ssm import (FilterState, SSMeta, StateSpace,  # noqa: F401
                   initial_state, state_nbytes)
 
 __all__ = [
-    "ssm", "kalman", "convert", "health", "serving",
+    "ssm", "kalman", "convert", "health", "serving", "fleet",
     "StateSpace", "SSMeta", "FilterState", "initial_state", "state_nbytes",
     "filter_step_panel", "filter_panel", "filter_panel_parallel",
     "filter_forecast_origin", "forecast_mean",
@@ -46,5 +50,7 @@ __all__ = [
     "monitored_step", "monitor_panel",
     "LANE_OK", "LANE_SUSPECT", "LANE_DIVERGED",
     "ServingSession", "TickResult", "start_session",
-    "ServingRestoreMismatch",
+    "ServingRestoreMismatch", "shed_priority",
+    "FleetScheduler", "AdmissionPolicy", "FleetSaturated",
+    "FleetRestoreMismatch",
 ]

@@ -1,0 +1,977 @@
+"""Fleet scheduler: a multi-tenant serving front-end over shared
+executables.
+
+PRs 7–10 built one :class:`~spark_timeseries_tpu.statespace.serving.
+ServingSession` per logical stream — health-monitored, self-healing,
+SLO-windowed, telemetered.  One session per tenant does not survive
+millions of users: every tenant would pay its own device call per tick,
+and nothing protects the process when demand exceeds device throughput.
+This module is the missing fleet layer (ROADMAP item 3): a
+:class:`FleetScheduler` multiplexes many logical tenants onto the small
+set of compiled programs the sessions already share (the update jit is
+keyed on ``(bucket, dtype, SSMeta, HealthPolicy)`` precisely so it CAN be
+shared — ``ServingSession.update_key``), and stays correct and
+responsive under overload and failure.  Four robustness mechanisms, each
+deterministically fault-injectable (``utils.resilience``):
+
+- **admission control + backpressure** — every tenant owns a bounded
+  ingress queue; a deterministic :class:`AdmissionPolicy` decides what
+  saturation means (``"reject"`` raises the named
+  :class:`FleetSaturated`; ``"drop_oldest"`` evicts the stalest queued
+  tick — the newest observation is the valuable one; ``"degrade"``
+  sheds the tenant onto the cached-forecast lane).  Counters:
+  ``fleet.admitted`` / ``fleet.rejected`` / ``fleet.queued``.  The
+  ``tenant_flood`` fault amplifies ingress to drive all three paths.
+- **tick coalescing** — tenants whose sessions share an update key are
+  one *coalescing group*: their pending ticks gather into one wider
+  device call of the very same traced update function (the group's
+  pytrees are concatenated lane-wise, ``monitored_step`` is per-lane
+  math with no cross-lane reductions, and each tenant's slice scatters
+  back through the session's own ``_prepare_tick``/``_absorb_tick``
+  pair), so N tenants cost one dispatch instead of N — and the results
+  are **bitwise** the per-session ticks (pinned by test).  A group
+  flushes when every live tenant has a tick queued, or when the oldest
+  queued tick outlives the **coalescing-window deadline**
+  (``AdmissionPolicy.coalesce_window_s``) — a slow tenant
+  (``coalesce_straggler`` fault) can delay only itself, never the
+  batch.  Group width is padded to a power-of-two slot count so tenant
+  churn compiles at most O(log fleet) programs.
+- **SLO-aware shedding** — the scheduler folds every coalesced
+  dispatch's wall latency into a rolling window; when the p95 burns the
+  ``STS_SERVING_SLO_MS`` budget, tenants shed one per pump in health
+  order (:func:`~spark_timeseries_tpu.statespace.health.shed_priority`:
+  diverged-laden first, then suspect — the lattice from PR 9).  A shed
+  tenant stops dispatching: its ticks buffer in a bounded catch-up ring
+  and its reads serve the **periodicity-aware forecast cache** — the
+  last live forecast path, indexed by elapsed ticks, within a staleness
+  bound — falling back to a predict-only forecast off the frozen state.
+  When the burn clears for ``shed_cooldown`` consecutive pumps, tenants
+  restore in reverse order, replaying their buffered ticks through the
+  warmed per-session executable (zero new compiles).  Overload degrades
+  output quality; it never raises and never crashes.
+- **checkpoint-based lane migration** — :meth:`FleetScheduler.drain`
+  writes one atomic tenant bundle (the session's
+  ``checkpoint_blob`` plus any still-queued ticks, via
+  ``utils.checkpoint.save_pytree_atomic``), and
+  :meth:`FleetScheduler.adopt` restores it into another scheduler — or
+  another process: a ``kill -9`` after the drain commit loses nothing
+  (subprocess-pinned), and the adopted tenant's ticks are bitwise the
+  undrained ones.  A bundle that disagrees with the adopting process
+  raises :class:`FleetRestoreMismatch` naming the differing fields (the
+  ``JournalSpecMismatch`` discipline).
+
+Like a single session, a scheduler is one logical serving plane: not
+thread-safe per instance — shard across schedulers (the compiled
+programs are shared through the jit cache anyway).
+
+Metrics: ``fleet.admitted/rejected/queued/dropped_ticks`` (admission),
+``fleet.coalesced_dispatches/coalesced_ticks`` + the
+``fleet.coalesced_step`` span (coalescing), ``fleet.slo_burns``,
+``fleet.shed_lanes``, ``fleet.shed_tenants`` gauge,
+``fleet.restored_tenants``, ``fleet.cache_serves``, ``fleet.cache_stale``
+(shedding), ``fleet.drained/adopted`` (migration).  ``bench.py`` embeds
+a ``fleet_demo`` block and ``tools/bench_gate.py`` gates
+``fleet_ticks_per_s`` and zero-baselines ``fleet_shed_lanes``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import signal
+import time
+from collections import deque
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..utils import checkpoint as _checkpoint
+from ..utils import metrics as _metrics
+from ..utils import resilience as _resilience
+from ..utils import telemetry as _telemetry
+from .health import shed_priority
+from .serving import ServingSession, TickResult, _jitted, check_label
+
+__all__ = ["AdmissionPolicy", "FleetScheduler", "FleetSaturated",
+           "FleetRestoreMismatch", "TENANT_LIVE", "TENANT_SHED",
+           "DEFAULT_QUEUE_DEPTH"]
+
+# tenant bundle format written by drain() / read by adopt(); bumped when
+# the bundle's fields change incompatibly
+_BUNDLE_FORMAT = 1
+
+DEFAULT_QUEUE_DEPTH = 8
+
+# tenant serving modes
+TENANT_LIVE = "live"    # ticks coalesce onto the device
+TENANT_SHED = "shed"    # ticks buffer; reads serve the forecast cache
+
+_fleet_seq = itertools.count(1)
+
+
+class FleetSaturated(RuntimeError):
+    """A tenant's bounded ingress queue is full under the ``"reject"``
+    admission policy.  Deterministic backpressure: the caller sees WHICH
+    tenant saturated at WHAT depth and can slow down, reroute, or switch
+    the policy — instead of the queue growing without bound until the
+    process dies."""
+
+
+class FleetRestoreMismatch(ValueError):
+    """A tenant bundle disagrees with the adopting scheduler/process
+    (format, label, tick geometry — or, chained underneath, the session
+    half's own :class:`~spark_timeseries_tpu.statespace.serving.
+    ServingRestoreMismatch`).  Raised eagerly by
+    :meth:`FleetScheduler.adopt` with the differing fields spelled out
+    (the ``JournalSpecMismatch`` discipline) — adopting would serve
+    garbage."""
+
+
+class AdmissionPolicy(NamedTuple):
+    """Static knobs of one scheduler's overload behavior — deterministic
+    by construction (no randomness, no wall-clock feeding traced code).
+
+    ``queue_depth`` bounds every tenant's ingress queue; ``on_full`` is
+    what saturation does (``"reject"`` → :class:`FleetSaturated`,
+    ``"drop_oldest"`` → evict the stalest queued tick and admit the new
+    one, ``"degrade"`` → shed the tenant onto the cached-forecast
+    lane); ``coalesce_window_s`` is the coalescing deadline — the
+    longest a queued tick may wait for its group to fill before a
+    partial batch flushes anyway (0 = never wait); ``slo_window`` the
+    rolling dispatch-latency sample count behind the fleet p95;
+    ``shed_cooldown`` how many consecutive clear pumps the p95 burn must
+    stay quiet before shed tenants restore; ``cache_staleness`` the max
+    elapsed ticks a cached forecast path may be phase-shifted by before
+    it is declared stale; ``catchup_ring`` how many ticks a shed tenant
+    buffers for replay-on-restore (older ones drop — degradation is
+    bounded memory, too)."""
+    queue_depth: int = DEFAULT_QUEUE_DEPTH
+    on_full: str = "reject"
+    coalesce_window_s: float = 0.05
+    slo_window: int = 64
+    shed_cooldown: int = 4
+    cache_staleness: int = 32
+    catchup_ring: int = 64
+
+    def validate(self) -> "AdmissionPolicy":
+        if self.queue_depth < 1:
+            raise ValueError(
+                f"queue_depth must be >= 1, got {self.queue_depth}")
+        if self.on_full not in ("reject", "drop_oldest", "degrade"):
+            raise ValueError(
+                f"on_full must be 'reject', 'drop_oldest', or "
+                f"'degrade', got {self.on_full!r}")
+        if self.coalesce_window_s < 0:
+            raise ValueError(
+                f"coalesce_window_s must be >= 0, "
+                f"got {self.coalesce_window_s}")
+        if self.slo_window < 4:
+            raise ValueError(
+                f"slo_window must be >= 4, got {self.slo_window}")
+        if self.shed_cooldown < 1:
+            raise ValueError(
+                f"shed_cooldown must be >= 1, got {self.shed_cooldown}")
+        if self.cache_staleness < 1:
+            raise ValueError(
+                f"cache_staleness must be >= 1, "
+                f"got {self.cache_staleness}")
+        if self.catchup_ring < 1:
+            raise ValueError(
+                f"catchup_ring must be >= 1, got {self.catchup_ring}")
+        return self
+
+
+def _slots_for(n: int) -> int:
+    """Group slot count: next power of two >= n (floor 1), so tenant
+    churn within a power-of-two band reuses one coalesced executable."""
+    s = 1
+    while s < n:
+        s *= 2
+    return s
+
+
+class _Tenant:
+    """One logical tenant: its session plus the scheduler-side state
+    (ingress queue, serving mode, catch-up ring, forecast cache,
+    per-tenant counters).  Internal — the public surface speaks labels."""
+
+    def __init__(self, session: ServingSession, policy: AdmissionPolicy):
+        self.session = session
+        self.label = session.label
+        self.queue: deque = deque()          # (tick, offset, t_arrival)
+        self.mode = TENANT_LIVE
+        self.shed_reason: Optional[str] = None
+        self.catchup: deque = deque(maxlen=policy.catchup_ring)
+        self.cache_fc: Optional[np.ndarray] = None   # (n_series, H)
+        self.cache_stamp = 0                 # `arrived` at cache time
+        self.admitted = 0
+        self.rejected = 0
+        self.dropped = 0
+        self.cache_serves = 0
+        self.ticks_dispatched = 0
+        # monotonic count of ticks that ever ARRIVED for this tenant
+        # (admitted into the queue or the catch-up ring).  The forecast
+        # cache's phase is measured against this, NOT against ring/queue
+        # sizes: a bounded ring saturates (len stops growing while the
+        # stream keeps ticking), which would freeze the phase shift and
+        # let a long-shed tenant serve the same stale path forever.
+        self.arrived = 0
+        self.arrived_prev_pump = 0           # ingress-quiescence probe
+
+    @property
+    def n_series(self) -> int:
+        return self.session.n_series
+
+    def elapsed_since_cache(self) -> int:
+        """Stream ticks that arrived since the cached forecast path was
+        taken — the phase shift a cache read must apply (arrival-based:
+        every tick advances the stream's clock whether it was
+        dispatched, buffered, or evicted from the bounded ring)."""
+        return self.arrived - self.cache_stamp
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "tenant": self.label,
+            "mode": self.mode,
+            "shed_reason": self.shed_reason,
+            "n_series": self.n_series,
+            "queued": len(self.queue),
+            "catchup": len(self.catchup),
+            "admitted": self.admitted,
+            "rejected": self.rejected,
+            "dropped": self.dropped,
+            "cache_serves": self.cache_serves,
+            "ticks_dispatched": self.ticks_dispatched,
+            "health": self.session.health_counts(),
+        }
+
+
+class FleetScheduler:
+    """Multiplex many labeled :class:`ServingSession` tenants onto shared
+    coalesced device calls, with admission control, SLO-aware shedding,
+    and checkpoint-based migration (module docstring for the contract).
+
+    Build one, :meth:`attach` (or :meth:`open_tenant`) tenants,
+    :meth:`warmup`, then :meth:`submit` ticks — dispatch is automatic
+    (``auto_pump``) or explicit via :meth:`pump`.  Reads go through
+    :meth:`forecast`, which transparently serves shed tenants from the
+    cache."""
+
+    def __init__(self, policy: Optional[AdmissionPolicy] = None, *,
+                 registry=None, label: Optional[str] = None,
+                 auto_pump: bool = True):
+        self.policy = (policy if policy is not None
+                       else AdmissionPolicy()).validate()
+        self._reg = registry if registry is not None \
+            else _metrics.get_registry()
+        self.label = check_label(label) if label is not None \
+            else f"fleet{next(_fleet_seq)}"
+        self.auto_pump = bool(auto_pump)
+        self._tenants: Dict[str, _Tenant] = {}
+        self._groups: Dict[Any, List[str]] = {}   # update_key -> labels
+        self._lat: deque = deque(maxlen=self.policy.slo_window)
+        self._slo_ms = _telemetry.env_positive("STS_SERVING_SLO_MS",
+                                               float, None)
+        self._slo_burns = 0
+        self._burning = False
+        self._clear_pumps = 0
+        self._shed_order: List[str] = []     # labels in shed order
+        # gathered-SSM reuse (the SSM is static between heal/splice;
+        # re-concatenating O(tenants·bucket·m²) transition floats every
+        # dispatch would tax exactly the throughput the fleet gate
+        # measures): (group key, participant labels, slots) -> (per-
+        # member ssm object refs, gathered pytree).  Holding the refs
+        # makes the identity check safe — a healed session swaps in a
+        # NEW ssm object, which misses and re-gathers.
+        self._gather_cache: Dict[Any, Tuple[list, Any]] = {}
+        _telemetry.register_fleet(self)
+        _telemetry.ensure_started_from_env()
+        self._reg.inc("fleet.schedulers")
+
+    # -- tenant lifecycle ---------------------------------------------------
+
+    def attach(self, session: ServingSession) -> str:
+        """Register a session as a tenant (its label is the tenant id —
+        unique per scheduler).  Sessions with equal ``update_key``
+        coalesce into one group."""
+        label = check_label(session.label)
+        if label in self._tenants:
+            raise ValueError(
+                f"tenant label {label!r} is already attached to "
+                f"{self.label!r}; labels identify tenants — give the "
+                f"session a distinct label=")
+        t = _Tenant(session, self.policy)
+        self._tenants[label] = t
+        self._groups.setdefault(session.update_key, []).append(label)
+        self._reg.inc("fleet.tenants_attached")
+        self._reg.set_gauge("fleet.tenants", len(self._tenants))
+        return label
+
+    def open_tenant(self, model, history, *, label: Optional[str] = None,
+                    **kwargs) -> str:
+        """Convenience: :meth:`ServingSession.start` + :meth:`attach`."""
+        sess = ServingSession.start(model, history, label=label,
+                                    registry=self._reg, **kwargs)
+        return self.attach(sess)
+
+    def detach(self, label: str) -> ServingSession:
+        """Remove a tenant (undispatched ticks are dropped and counted);
+        returns its session, still live and servable standalone."""
+        t = self._pop_tenant(label)
+        if t.queue or t.catchup:
+            self._reg.inc("fleet.dropped_ticks",
+                          len(t.queue) + len(t.catchup))
+        return t.session
+
+    def _pop_tenant(self, label: str) -> _Tenant:
+        t = self._tenants.pop(label, None)
+        if t is None:
+            raise KeyError(
+                f"no tenant {label!r} in scheduler {self.label!r} "
+                f"(tenants: {sorted(self._tenants) or 'none'})")
+        key = t.session.update_key
+        self._groups[key].remove(label)
+        if not self._groups[key]:
+            del self._groups[key]
+        if label in self._shed_order:
+            self._shed_order.remove(label)
+        self._reg.set_gauge("fleet.tenants", len(self._tenants))
+        return t
+
+    @property
+    def tenants(self) -> List[str]:
+        return sorted(self._tenants)
+
+    @property
+    def n_groups(self) -> int:
+        return len(self._groups)
+
+    def session(self, label: str) -> ServingSession:
+        return self._require(label).session
+
+    def _require(self, label: str) -> _Tenant:
+        t = self._tenants.get(label)
+        if t is None:
+            raise KeyError(
+                f"no tenant {label!r} in scheduler {self.label!r} "
+                f"(tenants: {sorted(self._tenants) or 'none'})")
+        return t
+
+    # -- admission ----------------------------------------------------------
+
+    def submit(self, label: str, tick, offset=None) -> None:
+        """Admit one tick for one tenant through the bounded ingress
+        queue; dispatch happens on the next :meth:`pump` (automatic by
+        default).  Saturation behavior is the :class:`AdmissionPolicy`'s
+        — the only path that raises is the explicit ``"reject"`` policy,
+        and it raises the named :class:`FleetSaturated`."""
+        t = self._require(label)
+        flood = _resilience.fleet_fault("tenant_flood")
+        copies = max(1, int(flood.n_attempts)) if flood is not None else 1
+        for _ in range(copies):
+            self._admit_one(t, tick, offset)
+        if self.auto_pump:
+            self.pump()
+
+    def _admit_one(self, t: _Tenant, tick, offset) -> None:
+        # width is validated HERE, at the admission boundary: a
+        # malformed tick discovered only inside a coalesced dispatch
+        # would already have dequeued the peers' ticks (losing them) and
+        # would raise out of an unrelated tenant's submit — the bad
+        # producer must be the one that sees the error
+        tick = np.asarray(tick).reshape(-1)
+        if tick.shape[0] != t.n_series:
+            raise ValueError(
+                f"tenant {t.label!r} expects one tick per series "
+                f"({t.n_series}), got {tick.shape[0]}")
+        if offset is not None:
+            offset = np.asarray(offset).reshape(-1)
+            if offset.shape[0] != t.n_series:
+                raise ValueError(
+                    f"tenant {t.label!r} expects one exogenous offset "
+                    f"per series ({t.n_series}), got {offset.shape[0]}")
+        if t.mode == TENANT_SHED:
+            # shed lane: ticks buffer for replay-on-restore; the bounded
+            # ring makes overload cost memory-bounded (maxlen evicts)
+            if len(t.catchup) == t.catchup.maxlen:
+                t.dropped += 1
+                self._reg.inc("fleet.dropped_ticks")
+            t.catchup.append((np.array(tick, copy=True),
+                              None if offset is None
+                              else np.array(offset, copy=True)))
+            t.admitted += 1
+            t.arrived += 1
+            self._reg.inc("fleet.admitted")
+            return
+        if len(t.queue) >= self.policy.queue_depth:
+            mode = self.policy.on_full
+            if mode == "reject":
+                t.rejected += 1
+                self._reg.inc("fleet.rejected")
+                raise FleetSaturated(
+                    f"tenant {t.label!r} ingress queue is full "
+                    f"({self.policy.queue_depth} ticks) and the "
+                    f"admission policy is 'reject'; pump() the "
+                    f"scheduler, slow the producer, or use "
+                    f"on_full='drop_oldest'/'degrade'")
+            if mode == "drop_oldest":
+                t.queue.popleft()
+                t.dropped += 1
+                self._reg.inc("fleet.dropped_ticks")
+            else:                     # degrade: shed onto the cache lane
+                self._shed(t, reason="admission")
+                self._admit_one(t, tick, offset)
+                return
+        t.queue.append((np.asarray(tick), offset, time.monotonic()))
+        t.admitted += 1
+        t.arrived += 1
+        self._reg.inc("fleet.admitted")
+        self._reg.inc("fleet.queued")
+
+    # -- coalesced dispatch -------------------------------------------------
+
+    def pump(self, force: bool = False) -> List[Dict[str, Any]]:
+        """Dispatch every ready coalescing group (``force=True``
+        dispatches any group with pending ticks regardless of readiness)
+        and run the shed/restore ladder.  Returns one report dict per
+        dispatched group."""
+        reports = []
+        strag = _resilience.fleet_fault("coalesce_straggler")
+        for key in list(self._groups):
+            labels = self._groups.get(key)
+            if not labels:
+                continue
+            members = [self._tenants[la] for la in labels]
+            live = [m for m in members if m.mode == TENANT_LIVE]
+            stragglers = set()
+            if strag is not None:
+                stragglers = {m.label for i, m in enumerate(live)
+                              if i % max(1, strag.lane_stride) == 0}
+            ready_pool = [m for m in live if m.label not in stragglers]
+            with_ticks = [m for m in ready_pool if m.queue]
+            if not with_ticks:
+                continue
+            all_present = len(with_ticks) == len(ready_pool)
+            oldest = min(m.queue[0][2] for m in with_ticks)
+            expired = self.policy.coalesce_window_s == 0.0 or \
+                (time.monotonic() - oldest) >= self.policy.coalesce_window_s
+            if not (force or all_present or expired):
+                continue
+            reports.append(self._dispatch_group(key, with_ticks))
+        self._shed_restore_step()
+        return reports
+
+    def _dispatch_group(self, key, members: List[_Tenant]
+                        ) -> Dict[str, Any]:
+        """One coalesced device call: pop one queued tick per member,
+        gather the group's pytrees lane-wise, run the SAME jitted update
+        the sessions run solo, scatter each member's slice back through
+        its session's absorb path.  Bitwise the per-session ticks — the
+        math is per-lane, the function object is shared, and the host
+        accounting is the session's own."""
+        import jax
+        import jax.numpy as jnp
+
+        bucket, _dtype, meta, policy = key
+        G = len(members)
+        slots = _slots_for(G)
+        prepped = []
+        for m in members:
+            tick, offset, _ = m.queue.popleft()
+            host, y, off = m.session._prepare_tick(tick, offset)
+            prepped.append((m, host, y, off))
+
+        def gather(*leaves):
+            # pad vacant slots by replicating member 0's leaf: finite,
+            # harmless — their ticks are NaN and their results are
+            # never scattered back
+            parts = list(leaves) + [leaves[0]] * (slots - G)
+            return jnp.concatenate([jnp.asarray(p) for p in parts])
+
+        ckey = (key, tuple(p[0].label for p in prepped), slots)
+        member_ssms = [p[0].session._ssm for p in prepped]
+        cached = self._gather_cache.get(ckey)
+        if cached is not None and len(cached[0]) == G and all(
+                a is b for a, b in zip(cached[0], member_ssms)):
+            ssm = cached[1]
+        else:
+            ssm = jax.tree_util.tree_map(gather, *member_ssms)
+            if len(self._gather_cache) > 64:   # participation churn
+                self._gather_cache.clear()
+            self._gather_cache[ckey] = (member_ssms, ssm)
+        state = jax.tree_util.tree_map(
+            gather, *(p[0].session._state for p in prepped))
+        health = jax.tree_util.tree_map(
+            gather, *(p[0].session._health for p in prepped))
+        y_all = np.full((slots * bucket,), np.nan,
+                        prepped[0][0].session._dtype)
+        off_all = np.zeros_like(y_all)
+        for i, (_, _, y, off) in enumerate(prepped):
+            y_all[i * bucket:(i + 1) * bucket] = y
+            off_all[i * bucket:(i + 1) * bucket] = off
+
+        fn = _jitted("update")
+        t0 = time.perf_counter()
+        with _metrics.span("fleet.coalesced_step"):
+            state2, health2, v, f, ll_inc = fn(meta, policy, ssm, state,
+                                               health, y_all, off_all)
+            outs = []
+            for i, (m, host, _, _) in enumerate(prepped):
+                lo = i * bucket
+                n = m.n_series
+                # materialize inside the span: the latency each session
+                # records must cover real per-tick cost, as in update()
+                outs.append(TickResult(
+                    np.asarray(v[lo:lo + n]),
+                    np.asarray(f[lo:lo + n]),
+                    np.asarray(ll_inc[lo:lo + n]),
+                    np.asarray(health2.status[lo:lo + n])))
+        dt = time.perf_counter() - t0
+
+        def take(i):
+            lo = i * bucket
+            return lambda leaf: leaf[lo:lo + bucket]
+
+        for i, (m, host, _, _) in enumerate(prepped):
+            sub_state = jax.tree_util.tree_map(take(i), state2)
+            sub_health = jax.tree_util.tree_map(take(i), health2)
+            m.session._absorb_tick(host, sub_state, sub_health, outs[i],
+                                   dt)
+            m.ticks_dispatched += 1
+        self._reg.inc("fleet.coalesced_dispatches")
+        self._reg.inc("fleet.coalesced_ticks", G)
+        self._note_latency(dt)
+        return {"key": (bucket, meta.family, meta.m), "tenants": G,
+                "slots": slots, "wall_ms": round(dt * 1e3, 3),
+                "dtype": _dtype}
+
+    def warmup(self) -> None:
+        """Precompile every path a pump can take at the current
+        membership: each group's coalesced executable at EVERY
+        power-of-two slot width up to the full group (partial flushes —
+        window-deadline expiries, stragglers, shed-thinned groups —
+        dispatch at intermediate widths, and an unwarmed width would
+        compile inside the hot pump), the scatter-back slicing at each
+        width, and each group's per-session executable (shared across
+        same-key tenants) for the replays lane migration and
+        shed-restore run.  After this, submit/pump/restore trigger zero
+        XLA compiles at any group size — the scheduler-armed equivalent
+        of ``ServingSession.warmup`` (pinned by test, partial flush
+        included).  Caveat: tenants of the same bucket but different
+        ``n_series`` can still pay a first tiny result-slice program
+        when one lands on a slot position warmed for the other width —
+        bounded, off the steady state, and absent for homogeneous
+        fleets."""
+        import jax
+        import jax.numpy as jnp
+
+        fn = _jitted("update")
+        for key, labels in self._groups.items():
+            bucket, _dtype, meta, policy = key
+            members = [self._tenants[la] for la in labels]
+            members[0].session.warmup()         # the replay-lane program
+            sizes = {len(members)}
+            w = 1
+            while w < len(members):
+                sizes.add(w)
+                w *= 2
+            for G in sorted(sizes):
+                slots = _slots_for(G)
+
+                def gather(*leaves):
+                    parts = (list(leaves)
+                             + [leaves[0]] * (slots - len(leaves)))
+                    return jnp.concatenate(
+                        [jnp.asarray(p) for p in parts])
+
+                srcs = members[:G]
+                ssm = jax.tree_util.tree_map(
+                    gather, *(m.session._ssm for m in srcs))
+                state = jax.tree_util.tree_map(
+                    gather, *(m.session._state for m in srcs))
+                health = jax.tree_util.tree_map(
+                    gather, *(m.session._health for m in srcs))
+                y = np.full((slots * bucket,), np.nan,
+                            srcs[0].session._dtype)
+                off = np.zeros_like(y)
+                with _metrics.span("fleet.warmup"):
+                    state2, health2, v, f, ll = fn(meta, policy, ssm,
+                                                   state, health, y, off)
+                    for i, m in enumerate(srcs):
+                        lo = i * bucket
+                        n = m.n_series
+                        np.asarray(v[lo:lo + n])
+                        np.asarray(f[lo:lo + n])
+                        np.asarray(ll[lo:lo + n])
+                        np.asarray(health2.status[lo:lo + n])
+                        # the scatter-back slice programs
+                        jax.tree_util.tree_map(
+                            lambda leaf, lo=lo: np.asarray(
+                                leaf[lo:lo + bucket]), state2)
+                        jax.tree_util.tree_map(
+                            lambda leaf, lo=lo: np.asarray(
+                                leaf[lo:lo + bucket]), health2)
+
+    # -- SLO shedding -------------------------------------------------------
+
+    def _note_latency(self, dt_s: float) -> None:
+        self._lat.append(float(dt_s))
+        ms = dt_s * 1e3
+        if self._slo_ms is not None and ms > self._slo_ms:
+            self._slo_burns += 1
+            self._reg.inc("fleet.slo_burns")
+
+    def _p95_ms(self) -> Optional[float]:
+        if len(self._lat) < 4:
+            return None
+        arr = np.fromiter(self._lat, dtype=np.float64) * 1e3
+        return float(np.percentile(arr, 95))
+
+    def _burn_active(self) -> bool:
+        if self._slo_ms is None:
+            return False
+        p95 = self._p95_ms()
+        return p95 is not None and p95 > self._slo_ms
+
+    def _shed_restore_step(self) -> None:
+        """The shed ladder, one rung per pump: while the p95 window
+        burns the SLO budget, shed the worst-health live tenant; once
+        the burn stays clear for ``shed_cooldown`` pumps, restore shed
+        tenants (newest shed first) with catch-up replay.  One tenant
+        per pump in each direction keeps the feedback loop damped —
+        shedding everything on one bad sample would oscillate."""
+        burning = self._burn_active()
+        if burning:
+            self._burning = True
+            self._clear_pumps = 0
+            live = [t for t in self._tenants.values()
+                    if t.mode == TENANT_LIVE]
+            if live:
+                worst = max(
+                    live, key=lambda t: (
+                        shed_priority(t.session.lane_status), t.label))
+                self._shed(worst, reason="slo")
+            return
+        if not self._burning and not self._shed_order:
+            return
+        self._clear_pumps += 1
+        if self._clear_pumps < self.policy.shed_cooldown:
+            return
+        # restore newest-shed first: it was shed under the worst burn,
+        # and the oldest shed (worst health) re-earns its slot last
+        restored = None
+        for label in reversed(self._shed_order):
+            t = self._tenants.get(label)
+            if t is not None and t.shed_reason != "admission":
+                restored = t
+                break
+        if restored is None:
+            # only admission-shed tenants remain; they restore only
+            # once their own ingress pressure is gone — no new arrivals
+            # since the previous pump (restoring into a live flood
+            # would just re-saturate the queue and oscillate
+            # shed/replay/shed every cooldown)
+            for label in reversed(self._shed_order):
+                t = self._tenants.get(label)
+                if t is not None and t.arrived == t.arrived_prev_pump:
+                    restored = t
+                    break
+        for t in self._tenants.values():
+            t.arrived_prev_pump = t.arrived
+        if restored is not None:
+            self._restore(restored)
+        if not self._shed_order:
+            self._burning = False
+
+    def _shed(self, t: _Tenant, reason: str) -> None:
+        if t.mode == TENANT_SHED:
+            return
+        t.mode = TENANT_SHED
+        t.shed_reason = reason
+        self._shed_order.append(t.label)
+        self._burning = True        # a shed episode is active until the
+        #                             ladder restores the last tenant
+        # fresh measurement epoch: the p95 that justified this shed is
+        # pre-shed load — keeping it would shed the whole fleet off one
+        # bad window and then never restore (a stale burn with no new
+        # dispatches to clear it)
+        self._lat.clear()
+        self._clear_pumps = 0
+        # undispatched queued ticks roll into the catch-up ring so a
+        # later restore replays them in order
+        while t.queue:
+            tick, offset, _ = t.queue.popleft()
+            if len(t.catchup) == t.catchup.maxlen:
+                t.dropped += 1
+                self._reg.inc("fleet.dropped_ticks")
+            t.catchup.append((np.array(tick, copy=True),
+                              None if offset is None
+                              else np.array(offset, copy=True)))
+        self._reg.inc("fleet.shed_lanes", t.n_series)
+        self._reg.inc("fleet.shed_events")
+        self._reg.set_gauge("fleet.shed_tenants", len(self._shed_order))
+        _metrics.trace_instant(
+            "fleet.tenant_shed",
+            {"tenant": t.label, "reason": reason,
+             "lanes": t.n_series,
+             "p95_ms": self._p95_ms()})
+
+    def _restore(self, t: _Tenant) -> None:
+        """Bring a shed tenant back to the live lane: replay its
+        buffered ticks through the warmed per-session executable (zero
+        new compiles — the (bucket,) program is warm), then clear the
+        shed mark.  Ticks the bounded ring evicted stay lost, counted —
+        the deterministic price of the overload window."""
+        replayed = 0
+        while t.catchup:
+            tick, offset = t.catchup.popleft()
+            t.session.update(tick, offset)
+            replayed += 1
+        t.mode = TENANT_LIVE
+        t.shed_reason = None
+        if t.label in self._shed_order:
+            self._shed_order.remove(t.label)
+        self._reg.inc("fleet.restored_tenants")
+        self._reg.set_gauge("fleet.shed_tenants", len(self._shed_order))
+        _metrics.trace_instant(
+            "fleet.tenant_restored",
+            {"tenant": t.label, "replayed": replayed})
+
+    # -- reads --------------------------------------------------------------
+
+    def forecast(self, label: str, horizon: int,
+                 offsets=None) -> np.ndarray:
+        """h-step forecasts for one tenant.  Live tenants forecast off
+        their filtered state (and refresh the tenant's cache — the
+        periodicity-aware precompute: one device call buys a whole
+        forward path).  Shed tenants never touch the device on the hot
+        path: the cached path is served phase-shifted by the ticks that
+        arrived since it was taken, within the staleness bound; a stale
+        or absent cache degrades to a predict-only forecast off the
+        frozen state (one forecast call, no tick work) and re-caches.
+
+        ``offsets (n_series, horizon)`` carries known future exogenous
+        contributions (ARX).  An offset forecast is request-specific:
+        it passes straight through to the session (live or frozen
+        state) and never enters the shared cache — a phase-shifted
+        replay of someone else's offsets would be silently wrong."""
+        t = self._require(label)
+        horizon = int(horizon)
+        if horizon < 1:
+            raise ValueError("forecast needs horizon >= 1")
+        if offsets is not None:
+            return t.session.forecast(horizon, offsets=offsets)
+        if t.mode == TENANT_LIVE:
+            fc = t.session.forecast(horizon)
+            t.cache_fc = np.array(fc, copy=True)
+            # stamp on the arrival clock, at the state's own position:
+            # queued-but-undispatched ticks are arrivals the filtered
+            # state has not absorbed yet
+            t.cache_stamp = t.arrived - len(t.queue)
+            return fc
+        shift = t.elapsed_since_cache()
+        if t.cache_fc is not None and shift <= self.policy.cache_staleness \
+                and shift + horizon <= t.cache_fc.shape[1]:
+            t.cache_serves += 1
+            self._reg.inc("fleet.cache_serves")
+            return t.cache_fc[:, shift:shift + horizon]
+        # stale (or too-short) cache: predict-only refresh off the
+        # frozen state — still no tick dispatched, still bounded work;
+        # cache far enough ahead to keep serving through the bound
+        self._reg.inc("fleet.cache_stale")
+        depth = horizon + self.policy.cache_staleness
+        fc = t.session.forecast(depth)
+        t.cache_fc = np.array(fc, copy=True)
+        t.cache_stamp = t.arrived
+        return fc[:, :horizon]
+
+    def last_status(self, label: str) -> np.ndarray:
+        return self._require(label).session.lane_status
+
+    # -- migration ----------------------------------------------------------
+
+    def drain(self, label: str, path: str) -> Dict[str, Any]:
+        """Move a tenant out of this scheduler: flush nothing, lose
+        nothing — the bundle carries the session's full
+        ``checkpoint_blob`` PLUS every still-queued/buffered tick, and
+        lands via the atomic pytree writer, so a ``kill -9`` one
+        instruction after :meth:`drain` returns leaves a bundle another
+        process adopts bitwise.  The tenant is detached on success.
+        The ``drop_tenant_process`` fault SIGKILLs right after the
+        commit (forensics bundle first), pinning exactly that."""
+        t = self._require(label)
+
+        def pack(ticks, offsets):
+            """(k, n_series) tick rows + offset rows (or None when no
+            tick in the slice carried one) — drain and adopt must agree
+            on BOTH, or an ARX tenant's replay would silently apply
+            zero exogenous offsets and break the bitwise contract."""
+            rows = [np.asarray(x, t.session._dtype) for x in ticks]
+            stacked = np.stack(rows) if rows else \
+                np.zeros((0, t.session.n_series), t.session._dtype)
+            if not any(o is not None for o in offsets):
+                return stacked, None
+            return stacked, np.stack([
+                np.asarray(o, t.session._dtype) if o is not None
+                else np.zeros(t.session.n_series, t.session._dtype)
+                for o in offsets])
+
+        pending, pending_offs = pack([q[0] for q in t.queue],
+                                     [q[1] for q in t.queue])
+        catchup, catchup_offs = pack([c[0] for c in t.catchup],
+                                     [c[1] for c in t.catchup])
+        bundle = {
+            "format": _BUNDLE_FORMAT,
+            "label": t.label,
+            "mode": t.mode,
+            "n_series": t.session.n_series,
+            "pending": pending,
+            "pending_offsets": pending_offs,
+            "catchup": catchup,
+            "catchup_offsets": catchup_offs,
+            "session": t.session.checkpoint_blob(),
+        }
+        _checkpoint.save_pytree_atomic(path, bundle)
+        self._reg.inc("fleet.drained")
+        _metrics.trace_instant(
+            "fleet.tenant_drained",
+            {"tenant": t.label, "pending": int(pending.shape[0]),
+             "catchup": int(catchup.shape[0])})
+        if _resilience.fleet_fault("drop_tenant_process") is not None:
+            # a real SIGKILL runs no handlers: forensics first, like
+            # the engine's kill_after_chunk
+            from ..utils import flightrec as _flightrec
+            _flightrec.record_incident(
+                "drop_tenant_process",
+                extra={"tenant": t.label, "bundle": path,
+                       "note": "injected SIGKILL after drain commit"},
+                registry=self._reg)
+            os.kill(os.getpid(), signal.SIGKILL)
+        self._pop_tenant(label)
+        return {"tenant": label, "path": path,
+                "pending": int(pending.shape[0]),
+                "catchup": int(catchup.shape[0])}
+
+    def adopt(self, path: str, *, replay: bool = True) -> str:
+        """Restore a drained tenant bundle into this scheduler.
+
+        Validation mirrors the journal's: the bundle's own fields are
+        checked first (:class:`FleetRestoreMismatch` lists every
+        disagreement), then the session half goes through
+        ``ServingSession.from_blob``'s geometry/engine-policy
+        validation — its ``ServingRestoreMismatch`` is chained under a
+        :class:`FleetRestoreMismatch` so one exception type means "this
+        bundle cannot serve here".  ``replay=True`` (default)
+        immediately replays the bundle's undispatched ticks through the
+        session so the adopted tenant is bitwise where the drained one
+        would have been."""
+        try:
+            bundle = _checkpoint.load_pytree(path)
+        except Exception as e:
+            raise FleetRestoreMismatch(
+                f"tenant bundle at {path!r} cannot be read: "
+                f"{type(e).__name__}: {e}") from e
+        diffs = []
+        fmt = bundle.get("format")
+        if fmt != _BUNDLE_FORMAT:
+            diffs.append(f"  format: bundle={fmt!r} vs "
+                         f"adopting-process={_BUNDLE_FORMAT}")
+        label = bundle.get("label")
+        try:
+            check_label(label if isinstance(label, str) else "")
+        except ValueError:
+            diffs.append(f"  label: bundle={label!r} vs "
+                         f"adopting-process=[A-Za-z0-9_-]+")
+        n_series = bundle.get("n_series")
+        pending = np.asarray(bundle.get("pending"))
+        for name, arr in (("pending", pending),
+                          ("catchup", np.asarray(bundle.get("catchup")))):
+            if arr.ndim != 2 or (n_series is not None
+                                 and arr.shape[1] != n_series):
+                diffs.append(
+                    f"  {name}: bundle shape={tuple(arr.shape)} vs "
+                    f"adopting-process=(k, {n_series})")
+        if diffs:
+            raise FleetRestoreMismatch(
+                f"tenant bundle at {path!r} disagrees with the adopting "
+                f"scheduler; differing fields:\n" + "\n".join(diffs))
+        if isinstance(label, str) and label in self._tenants:
+            raise FleetRestoreMismatch(
+                f"tenant bundle at {path!r} names label {label!r}, "
+                f"which is already attached to {self.label!r} — a "
+                f"tenant must live in exactly one scheduler")
+        try:
+            sess = ServingSession.from_blob(
+                bundle["session"], source=path, registry=self._reg,
+                label=label)
+        except ValueError as e:
+            raise FleetRestoreMismatch(
+                f"tenant bundle at {path!r}: the session half refuses "
+                f"this process ({e})") from e
+        self.attach(sess)
+        t = self._tenants[label]
+        self._reg.inc("fleet.adopted")
+        # chronological order is catchup (buffered while shed) FIRST,
+        # then the still-queued pending ticks — both with their saved
+        # exogenous offsets
+        catchup = np.asarray(bundle.get("catchup"))
+        c_offs = bundle.get("catchup_offsets")
+        p_offs = bundle.get("pending_offsets")
+        if replay:
+            if len(catchup):
+                sess.update_batch(catchup.T, offsets=None
+                                  if c_offs is None else c_offs.T)
+            if len(pending):
+                sess.update_batch(pending.T, offsets=None
+                                  if p_offs is None else p_offs.T)
+        else:
+            # deferred ingest: everything lands at the FRONT of the
+            # live queue in stream order (the catch-up ring only drains
+            # on a shed-restore, which a live tenant never takes —
+            # parking ticks there would reorder them behind new
+            # submits, or lose them)
+            now = time.monotonic()
+            deferred = [(np.array(row, copy=True),
+                         None if c_offs is None else c_offs[i], now)
+                        for i, row in enumerate(catchup)]
+            deferred += [(np.array(row, copy=True),
+                          None if p_offs is None else p_offs[i], now)
+                         for i, row in enumerate(pending)]
+            t.queue.extendleft(reversed(deferred))
+            # the deferred ticks are stream arrivals for this tenant:
+            # without advancing the clock, a later cache stamp
+            # (arrived - len(queue)) would go negative and phase-shift
+            # shed reads into the future.  Migration deliberately
+            # bypasses queue_depth — dropping migrated ticks to honor a
+            # backpressure bound would silently lose committed data.
+            t.arrived += len(deferred)
+        _metrics.trace_instant(
+            "fleet.tenant_adopted",
+            {"tenant": label, "replayed": int(replay)
+             and (len(pending) + len(catchup))})
+        return label
+
+    # -- introspection ------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        qd = sum(len(t.queue) for t in self._tenants.values())
+        return {
+            "label": self.label,
+            "tenants": len(self._tenants),
+            "groups": len(self._groups),
+            "queued": qd,
+            "shed_tenants": len(self._shed_order),
+            "slo_ms": self._slo_ms,
+            "slo_burns": self._slo_burns,
+            "p95_ms": self._p95_ms(),
+            "window": len(self._lat),
+        }
+
+    def telemetry_summary(self) -> Dict[str, Any]:
+        """Scrape-ready fleet panel for ``/snapshot.json``
+        (``utils.telemetry.fleet_summaries``): the aggregate plus one
+        row per tenant."""
+        return {**self.stats(),
+                "tenant_rows": [t.summary() for t in
+                                sorted(self._tenants.values(),
+                                       key=lambda t: t.label)]}

@@ -57,7 +57,7 @@ from .ssm import FilterState, SSMeta, StateSpace
 
 __all__ = ["LANE_OK", "LANE_SUSPECT", "LANE_DIVERGED", "LANE_NAMES",
            "HealthPolicy", "LaneHealth", "initial_health",
-           "monitored_step", "monitor_panel"]
+           "monitored_step", "monitor_panel", "shed_priority"]
 
 LANE_OK = 0        # EW standardized-innovation score inside the χ² band
 LANE_SUSPECT = 1   # score out of band but finite — advisory, self-clears
@@ -174,6 +174,20 @@ def monitored_step(ssm: StateSpace, state: FilterState,
     return state2, LaneHealth(ew, status, good_a, good_ring), (v, F)
 
 
+def shed_priority(status) -> Tuple[int, int]:
+    """The fleet shed ladder's per-tenant rank over a lane-status vector:
+    ``(n_diverged, n_suspect)``, compared lexicographically descending —
+    tenants whose lanes are already diverged (quarantined, serving NaN or
+    last-good anyway) shed first under SLO pressure, then suspect-laden
+    tenants, and fully healthy tenants only last.  Pure host math; the
+    scheduler sorts on this (label as the deterministic tie-break)."""
+    import numpy as np
+
+    s = np.asarray(status)
+    return (int(np.sum(s == LANE_DIVERGED)),
+            int(np.sum(s == LANE_SUSPECT)))
+
+
 def monitor_panel(ssm: StateSpace, state: FilterState,
                   health: LaneHealth, ys: jnp.ndarray, meta: SSMeta,
                   policy: HealthPolicy,
@@ -185,6 +199,16 @@ def monitor_panel(ssm: StateSpace, state: FilterState,
     (replaying a backlog through the exact per-tick semantics, health
     transitions included, without n host round-trips)."""
     ys = jnp.asarray(ys)
+    rows = int(state.a.shape[0])
+    if ys.ndim != 2 or int(ys.shape[0]) != rows:
+        # without this, a panel whose width disagrees with the filter
+        # state (a transposed stream, an unbucketed tenant panel)
+        # surfaces as an opaque broadcast error from inside the scan
+        raise ValueError(
+            f"monitor_panel expects a (S, n) tick panel with S == the "
+            f"filter state's {rows} bucketed lanes, got shape "
+            f"{tuple(ys.shape)}; pad the panel to the session bucket "
+            f"(or transpose a time-major stream) first")
     offs = jnp.zeros_like(ys) if offsets is None \
         else jnp.asarray(offsets, ys.dtype)
 

@@ -71,7 +71,7 @@ from .ssm import FilterState, SSMeta, StateSpace, state_nbytes
 
 __all__ = ["ServingSession", "TickResult", "start_session",
            "warmup_update", "WARMUP_FAMILIES", "ServingRestoreMismatch",
-           "DEFAULT_HISTORY_RING", "TICK_LATENCY_WINDOW"]
+           "DEFAULT_HISTORY_RING", "TICK_LATENCY_WINDOW", "check_label"]
 
 # format 2 = health-era checkpoints (lane health + history ring + heal
 # route); format-1 checkpoints predate the health machinery and cannot
@@ -106,12 +106,18 @@ def _serving_slo_ms() -> Optional[float]:
     return _telemetry.env_positive("STS_SERVING_SLO_MS", float, None)
 
 
-def _check_label(label: str) -> str:
+def check_label(label: str) -> str:
+    """The one label contract for every serving-plane name (session
+    labels, fleet tenant labels): non-empty ``[A-Za-z0-9_-]`` — labels
+    name metrics and checkpoint files, so junk must fail eagerly."""
     if not label or not all(ch.isalnum() or ch in "_-" for ch in label):
         raise ValueError(
             f"session label must be non-empty [A-Za-z0-9_-] (it names "
             f"the serving.session.<label>.* metrics), got {label!r}")
     return label
+
+
+_check_label = check_label      # pre-fleet private name
 
 
 class ServingRestoreMismatch(ValueError):
@@ -347,6 +353,68 @@ class ServingSession:
 
     # -- serving ------------------------------------------------------------
 
+    @property
+    def update_key(self):
+        """The hashable key of this session's per-tick update executable:
+        ``(bucket, dtype, SSMeta, HealthPolicy)`` (the state dim rides
+        inside ``meta.m``; the dtype rides the buffers, and mixing it
+        would silently promote a coalesced batch).  Sessions with equal
+        keys share ONE compiled program through the module-level jit
+        cache — the fact the fleet tier's tick coalescing exploits
+        (``statespace.fleet``): same-key ticks can gather into one wider
+        device call of the very same traced function."""
+        return (self._bucket, str(self._dtype), self.meta, self.policy)
+
+    def _prepare_tick(self, ticks, offset=None):
+        """Validate + pad one tick into the bucket-shaped host buffers
+        the update executable consumes, applying the serving-tier fault
+        hooks.  Returns ``(host (n_series,), y (bucket,), off (bucket,))``
+        — shared by :meth:`update` and the fleet scheduler's coalesced
+        dispatch, so both paths see identical tick semantics."""
+        host = np.asarray(ticks, self._dtype).reshape(-1)
+        if host.shape[0] != self.n_series:
+            raise ValueError(
+                f"update expects one tick per series ({self.n_series}), "
+                f"got {host.shape[0]}")
+        host = self._apply_faults(host)
+        y = np.full((self._bucket,), np.nan, self._dtype)
+        y[:self.n_series] = host
+        off = np.zeros((self._bucket,), self._dtype)
+        if offset is not None:
+            off_host = np.asarray(offset, self._dtype).reshape(-1)
+            if off_host.shape[0] != self.n_series:
+                raise ValueError(
+                    f"update expects one exogenous offset per series "
+                    f"({self.n_series}), got {off_host.shape[0]}")
+            off[:self.n_series] = off_host
+        return host, y, off
+
+    def _absorb_tick(self, host, state2, health2, out: TickResult,
+                     dt_s: float) -> TickResult:
+        """Commit one tick's outputs into the session: state/health swap,
+        transition + latency accounting, history-ring push.  ``state2``/
+        ``health2`` are the bucket-width device pytrees (or, from the
+        fleet's coalesced call, that call's per-session slices); ``out``
+        carries the already-materialized real-lane results.  The other
+        half of :meth:`_prepare_tick`; the fleet scheduler calls the
+        pair around its shared device call so coalesced ticks are
+        bitwise the per-session ticks."""
+        self._state = state2
+        self._health = health2
+        self._note_transitions(out.status)
+        self._note_tick_latency(dt_s)
+        # the ring normalizes non-finite arrivals to NaN (the filter
+        # already degrades inf to a missed tick; a verbatim inf would
+        # needlessly poison heal()'s refit window for ring-length ticks)
+        self._hist[:, self._hist_pos] = np.where(np.isfinite(host),
+                                                 host, np.nan)
+        self._hist_pos = (self._hist_pos + 1) % self._hist_len
+        self._hist_fill = min(self._hist_fill + 1, self._hist_len)
+        self.ticks_seen += 1
+        self._reg.inc("serving.updates")
+        self._reg.inc("serving.ticks", self.n_series)
+        return out
+
     def update(self, ticks, offset=None) -> TickResult:
         """Ingest one tick per series — a single cached-executable
         health-monitored Kalman step, O(1) work per tick per series.
@@ -362,18 +430,7 @@ class ServingSession:
         (``serving.diverged`` / ``serving.quarantined``) and marked on
         the trace timeline.
         """
-        host = np.asarray(ticks, self._dtype).reshape(-1)
-        if host.shape[0] != self.n_series:
-            raise ValueError(
-                f"update expects one tick per series ({self.n_series}), "
-                f"got {host.shape[0]}")
-        host = self._apply_faults(host)
-        y = np.full((self._bucket,), np.nan, self._dtype)
-        y[:self.n_series] = host
-        off = np.zeros((self._bucket,), self._dtype)
-        if offset is not None:
-            off[:self.n_series] = np.asarray(offset, self._dtype) \
-                .reshape(-1)
+        host, y, off = self._prepare_tick(ticks, offset)
         fn = _jitted("update")
         t0 = time.perf_counter()
         with _metrics.span("serving.update"):
@@ -388,20 +445,44 @@ class ServingSession:
                 np.asarray(f[:self.n_series]),
                 np.asarray(ll_inc[:self.n_series]),
                 np.asarray(health2.status[:self.n_series]))
-        self._state = state2
-        self._health = health2
-        self._note_transitions(out.status)
-        self._note_tick_latency(time.perf_counter() - t0)
-        # the ring normalizes non-finite arrivals to NaN (the filter
-        # already degrades inf to a missed tick; a verbatim inf would
-        # needlessly poison heal()'s refit window for ring-length ticks)
-        self._hist[:, self._hist_pos] = np.where(np.isfinite(host),
-                                                 host, np.nan)
-        self._hist_pos = (self._hist_pos + 1) % self._hist_len
-        self._hist_fill = min(self._hist_fill + 1, self._hist_len)
-        self.ticks_seen += 1
-        self._reg.inc("serving.updates")
-        self._reg.inc("serving.ticks", self.n_series)
+        return self._absorb_tick(host, state2, health2, out,
+                                 time.perf_counter() - t0)
+
+    def update_batch(self, ticks, offsets=None) -> TickResult:
+        """Bulk catch-up ingest: ``ticks (n_series, k)`` chronological
+        columns, each replayed through the warmed per-tick executable —
+        bitwise the ``k`` individual :meth:`update` calls, zero new
+        compiles on a warmed session (the replay primitive the fleet's
+        ``adopt`` migration uses; shed-restore replays per-tick to
+        honor heterogeneous per-tick offsets).  Returns the LAST tick's
+        :class:`TickResult`.
+
+        A batch whose width disagrees with the session raises a named
+        error up front — without this check a transposed or
+        wrong-tenant panel surfaced as an opaque reshape/broadcast
+        failure from inside the jitted step."""
+        batch = np.asarray(ticks, self._dtype)
+        if batch.ndim != 2 or batch.shape[0] != self.n_series:
+            raise ValueError(
+                f"update_batch expects a (n_series, k) = "
+                f"({self.n_series}, k) chronological tick panel for "
+                f"this session (bucket {self._bucket}), got shape "
+                f"{batch.shape}; transpose a (k, n_series) stream, or "
+                f"route a different-width panel to its own session")
+        if batch.shape[1] == 0:
+            raise ValueError("update_batch needs at least one tick "
+                             "column")
+        offs = None
+        if offsets is not None:
+            offs = np.asarray(offsets, self._dtype)
+            if offs.shape != batch.shape:
+                raise ValueError(
+                    f"update_batch offsets must match the tick panel "
+                    f"shape {batch.shape}, got {offs.shape}")
+        out = None
+        for t in range(batch.shape[1]):
+            out = self.update(batch[:, t],
+                              offs[:, t] if offs is not None else None)
         return out
 
     def _apply_faults(self, host: np.ndarray) -> np.ndarray:
@@ -773,12 +854,14 @@ class ServingSession:
 
     # -- persistence --------------------------------------------------------
 
-    def checkpoint(self, path: str) -> None:
-        """Atomically persist the whole session (``utils.checkpoint``
-        tmp+fsync+rename pytree writer): SSM, filter state, lane health,
-        history ring, heal route, meta, and tick counters —
-        :meth:`restore` resumes serving (and healing) exactly here."""
-        _checkpoint.save_pytree_atomic(path, {
+    def checkpoint_blob(self) -> Dict[str, Any]:
+        """The session's full persistent state as one checkpointable
+        pytree dict (SSM, filter state, lane health, history ring, heal
+        route, meta, tick counters).  :meth:`checkpoint` writes exactly
+        this; the fleet tier's ``drain``/``adopt`` lane migration embeds
+        it inside its tenant bundles — one serialization format, every
+        consumer (the checkpoint-passthrough contract)."""
+        return {
             "format": _CHECKPOINT_FORMAT,
             "meta": self.meta,
             "policy": self.policy,
@@ -792,7 +875,14 @@ class ServingSession:
             "hist": self._hist,
             "hist_pos": self._hist_pos,
             "hist_fill": self._hist_fill,
-        })
+        }
+
+    def checkpoint(self, path: str) -> None:
+        """Atomically persist the whole session (``utils.checkpoint``
+        tmp+fsync+rename pytree writer): SSM, filter state, lane health,
+        history ring, heal route, meta, and tick counters —
+        :meth:`restore` resumes serving (and healing) exactly here."""
+        _checkpoint.save_pytree_atomic(path, self.checkpoint_blob())
         self._reg.inc("serving.checkpoints")
 
     @classmethod
@@ -810,6 +900,17 @@ class ServingSession:
         raises :class:`ServingRestoreMismatch` listing the differing
         fields, instead of serving garbage."""
         blob = _checkpoint.load_pytree(path)
+        return cls.from_blob(blob, source=path, registry=registry,
+                             label=label)
+
+    @classmethod
+    def from_blob(cls, blob: Dict[str, Any], *, source: str = "<blob>",
+                  registry=None,
+                  label: Optional[str] = None) -> "ServingSession":
+        """:meth:`restore`'s validation + construction over an
+        already-loaded :meth:`checkpoint_blob` dict (``source`` names it
+        in errors) — the passthrough the fleet tier's ``adopt`` uses on
+        the session half of a tenant bundle."""
         fmt = blob.get("format")
         if fmt != _CHECKPOINT_FORMAT:
             raise ValueError(
@@ -855,7 +956,7 @@ class ServingSession:
                          f"restoring-process=('exact', 'innovations')")
         if diffs:
             raise ServingRestoreMismatch(
-                f"serving checkpoint at {path!r} disagrees with the "
+                f"serving checkpoint at {source!r} disagrees with the "
                 f"restoring session's engine policy / its own geometry; "
                 f"differing fields:\n" + "\n".join(diffs))
         return cls(ssm, meta, state, n_series,

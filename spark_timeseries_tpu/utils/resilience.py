@@ -62,7 +62,7 @@ __all__ = [
     "classify_series", "unfittable_mask",
     "FitOutcome", "RetryPolicy", "retry_kwargs", "StageResult",
     "FaultSpec", "InjectedOOM", "fault_injection", "fault_spec",
-    "chunk_fault", "serving_fault", "fault_scope_token",
+    "chunk_fault", "serving_fault", "fleet_fault", "fault_scope_token",
     "forced_optimizer_failures", "corrupt_values", "resilient_fit",
 ]
 
@@ -279,6 +279,24 @@ class FaultSpec(NamedTuple):
       mean is overwritten with a huge finite value ONCE per scope per
       session — the numerically-diverged-lane scenario the health
       monitor must quarantine and ``heal()`` must recover.
+
+    Fleet-tier modes (consumed host-side by
+    ``statespace.fleet.FleetScheduler`` via :func:`fleet_fault`; never
+    traced):
+
+    - ``"tenant_flood"``: every ``FleetScheduler.submit`` is amplified
+      to ``n_attempts`` copies of the tick — deterministic ingress
+      overload, driving the bounded queues into their admission policy
+      (reject / drop-oldest / degrade) without a traffic generator;
+    - ``"coalesce_straggler"``: every ``lane_stride``-th tenant of each
+      coalescing group goes silent — its queued ticks are withheld from
+      dispatch and it no longer counts toward group readiness, so the
+      batch can only flush through the coalescing-window deadline (the
+      slow-tenant-must-not-stall-the-batch scenario);
+    - ``"drop_tenant_process"``: SIGKILL the process immediately after a
+      ``drain()`` bundle commits (forensics bundle written first, like
+      ``kill_after_chunk``) — the killed-mid-migration scenario whose
+      bundle another process must ``adopt()`` bitwise.
     """
     mode: str
     n_attempts: int = 1
@@ -297,9 +315,12 @@ class InjectedOOM(RuntimeError):
 _VALID_MODES = ("force_nonconverge", "corrupt_nan", "corrupt_inf",
                 "hang_chunk", "oom_chunk", "kill_after_chunk",
                 "corrupt_journal",
-                "tick_corrupt_nan", "tick_corrupt_inf", "state_poison")
+                "tick_corrupt_nan", "tick_corrupt_inf", "state_poison",
+                "tenant_flood", "coalesce_straggler",
+                "drop_tenant_process")
 _CHUNK_MODES = _VALID_MODES[3:7]
-_SERVING_MODES = _VALID_MODES[7:]
+_SERVING_MODES = _VALID_MODES[7:10]
+_FLEET_MODES = _VALID_MODES[10:]
 _active_fault: List[FaultSpec] = []
 # monotonically increasing id per fault_injection scope entry — never
 # reused, unlike id(spec) (a freed FaultSpec's address can be recycled
@@ -342,6 +363,22 @@ def serving_fault(mode: str) -> Optional[FaultSpec]:
         raise ValueError(
             f"unknown serving fault mode {mode!r}; expected one of "
             f"{_SERVING_MODES}")
+    spec = fault_spec()
+    if spec is not None and spec.mode == mode:
+        return spec
+    return None
+
+
+def fleet_fault(mode: str) -> Optional[FaultSpec]:
+    """The active fault spec when it is a fleet-tier fault of the given
+    ``mode``, else None.  Read host-side by
+    ``statespace.fleet.FleetScheduler`` at submit / coalesced-dispatch /
+    drain time — these modes amplify ingress, withhold straggler ticks,
+    or kill the process; none of them ever enters traced code."""
+    if mode not in _FLEET_MODES:
+        raise ValueError(
+            f"unknown fleet fault mode {mode!r}; expected one of "
+            f"{_FLEET_MODES}")
     spec = fault_spec()
     if spec is not None and spec.mode == mode:
         return spec

@@ -44,7 +44,11 @@ process made observable from outside, with zero new dependencies:
 - **serving session registry**: every live ``ServingSession`` is weakly
   tracked and summarized (label, lane health, rolling tick-latency
   p50/p95, SLO burn count) into ``/snapshot.json``; sessions vanish
-  from the snapshot when garbage-collected, never pinned.
+  from the snapshot when garbage-collected, never pinned.  Live
+  ``FleetScheduler`` instances are tracked the same way — the
+  ``fleets`` section carries each scheduler's aggregate (tenants,
+  groups, queue depth, p95, shed state) plus per-tenant admission/
+  cache rows, the panel ``tools/sts_top.py`` renders.
 
 The incident index in ``/snapshot.json`` comes from
 :mod:`~spark_timeseries_tpu.utils.flightrec` (lazy import — the two
@@ -71,7 +75,8 @@ __all__ = [
     "start", "stop", "server", "ensure_started_from_env",
     "new_job_id", "register_job", "finish_job", "active_jobs",
     "recent_jobs", "register_session", "live_sessions",
-    "session_summaries",
+    "session_summaries", "register_fleet", "live_fleets",
+    "fleet_summaries",
     "snapshot_doc", "healthz_doc", "json_safe", "env_positive",
     "DEFAULT_STALE_FACTOR", "DEFAULT_EXPECTED_CHUNK_S", "RECENT_JOBS_KEPT",
 ]
@@ -391,6 +396,35 @@ def session_summaries() -> List[Dict[str, Any]]:
     return out
 
 
+# live FleetSchedulers, weakly referenced like the sessions (the
+# exporter must never pin a scheduler and its tenants' device buffers)
+_fleets_lock = threading.Lock()
+_fleets: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_fleet(fleet: Any) -> None:
+    with _fleets_lock:
+        _fleets.add(fleet)
+
+
+def live_fleets() -> List[Any]:
+    with _fleets_lock:
+        return list(_fleets)
+
+
+def fleet_summaries() -> List[Dict[str, Any]]:
+    """One per-fleet panel (``telemetry_summary()``: aggregate p95/SLO/
+    shed state + per-tenant rows) for ``/snapshot.json`` — scrape
+    isolation as for sessions."""
+    out = []
+    for fl in live_fleets():
+        try:
+            out.append(json_safe(fl.telemetry_summary()))
+        except Exception as e:  # noqa: BLE001 — scrape isolation
+            out.append({"error": f"{type(e).__name__}: {e}"})
+    return out
+
+
 # ---------------------------------------------------------------------------
 # payload builders (route handlers call these; tests call them directly)
 # ---------------------------------------------------------------------------
@@ -416,6 +450,7 @@ def snapshot_doc(registry: Optional[Any] = None) -> Dict[str, Any]:
         "jobs": [p.to_dict() for p in active_jobs()],
         "recent_jobs": [p.to_dict() for p in recent_jobs()],
         "serving_sessions": session_summaries(),
+        "fleets": fleet_summaries(),
     }
     jx = sys.modules.get("jax")
     if jx is not None:

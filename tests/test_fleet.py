@@ -1,0 +1,946 @@
+"""Multi-tenant fleet scheduler (ISSUE 12).
+
+The acceptance scenarios live here:
+
+- coalesced multi-tenant ticks are **bitwise** the per-session ticks
+  (≥3 tenants sharing one bucket, one device call per round);
+- a flooded tenant queue rejects with the named error and recovers the
+  moment the flood clears (admission control + backpressure);
+- an SLO burn sheds the worst-health tenant onto the cached-forecast
+  lane, reads keep answering, and the tenant restores — with catch-up
+  replay — when the burn clears, landing bitwise where an unshed
+  session would be;
+- ``drain``/``adopt`` move a tenant across schedulers and across a
+  ``kill -9`` process boundary bitwise (subprocess pair);
+- bundle/geometry mismatches refuse with :class:`FleetRestoreMismatch`;
+- the warmed tick path stays at **zero** recompiles with the scheduler
+  armed (submit → coalesced pump → forecast).
+
+Fast in-process scenarios run in tier-1; the subprocess pair and the
+end-to-end shed ladder are ``slow`` and run via ``make verify-fleet``
+(the ``fleet`` marker), which ``verify-faults`` also drives under
+``STS_FAULT_INJECT=1``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_timeseries_tpu import statespace as ss
+from spark_timeseries_tpu.models import arima
+from spark_timeseries_tpu.statespace.fleet import (
+    AdmissionPolicy, FleetRestoreMismatch, FleetSaturated, FleetScheduler,
+    TENANT_LIVE, TENANT_SHED, _slots_for)
+from spark_timeseries_tpu.statespace.health import (
+    LANE_DIVERGED, shed_priority)
+from spark_timeseries_tpu.utils import metrics, resilience
+
+pytestmark = pytest.mark.fleet
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+S, N_HIST = 4, 120       # one shared panel geometry -> one shared fit
+#                          executable and one serving bucket (8) across
+#                          the whole module
+
+
+def _ar2_panel(n_series, n, seed=0):
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=(n_series, n + 16))
+    y = np.zeros((n_series, n + 16))
+    for t in range(2, n + 16):
+        y[:, t] = 0.3 + 0.5 * y[:, t - 1] - 0.2 * y[:, t - 2] + e[:, t]
+    return y[:, 16:]
+
+
+def _tenant_fixtures(n_tenants, *, registry=None, seed0=1):
+    """(models, hists) for n same-geometry tenants — same (p,d,q) and
+    shape, so every session lands in ONE coalescing group."""
+    hists = [_ar2_panel(S, N_HIST, seed=seed0 + i)
+             for i in range(n_tenants)]
+    models = [arima.fit(2, 0, 0, jnp.asarray(h), warn=False)
+              for h in hists]
+    return models, hists
+
+
+def _build_fleet(n_tenants, policy=None, *, registry=None, seed0=1):
+    reg = registry if registry is not None else metrics.MetricsRegistry()
+    models, hists = _tenant_fixtures(n_tenants, seed0=seed0)
+    sched = FleetScheduler(policy, registry=reg, auto_pump=False)
+    for i, (m, h) in enumerate(zip(models, hists)):
+        sess = ss.ServingSession.start(m, h, label=f"t{i}", registry=reg)
+        sched.attach(sess)
+    return sched, models, hists, reg
+
+
+# ---------------------------------------------------------------------------
+# policy + plumbing
+# ---------------------------------------------------------------------------
+
+def test_admission_policy_validation_rejects_nonsense():
+    with pytest.raises(ValueError, match="queue_depth"):
+        AdmissionPolicy(queue_depth=0).validate()
+    with pytest.raises(ValueError, match="on_full"):
+        AdmissionPolicy(on_full="banana").validate()
+    with pytest.raises(ValueError, match="coalesce_window_s"):
+        AdmissionPolicy(coalesce_window_s=-1.0).validate()
+    with pytest.raises(ValueError, match="cache_staleness"):
+        AdmissionPolicy(cache_staleness=0).validate()
+    assert AdmissionPolicy().validate() == AdmissionPolicy()
+
+
+def test_slots_are_powers_of_two():
+    assert [_slots_for(n) for n in (1, 2, 3, 4, 5, 8, 9)] \
+        == [1, 2, 4, 4, 8, 8, 16]
+
+
+def test_shed_priority_ranks_diverged_then_suspect():
+    assert shed_priority(np.array([2, 2, 0, 1])) == (2, 1)
+    assert shed_priority(np.array([0, 0])) == (0, 0)
+    # lexicographic: one diverged lane outranks any number of suspects
+    assert shed_priority(np.array([2])) > shed_priority(
+        np.array([1, 1, 1, 1]))
+
+
+def test_fleet_fault_accessor_validates_modes():
+    with pytest.raises(ValueError, match="fleet fault"):
+        resilience.fleet_fault("banana")
+    with pytest.raises(ValueError, match="serving fault"):
+        resilience.serving_fault("tenant_flood")
+    assert resilience.fleet_fault("tenant_flood") is None
+    with resilience.fault_injection("tenant_flood", n_attempts=4):
+        spec = resilience.fleet_fault("tenant_flood")
+        assert spec is not None and spec.n_attempts == 4
+
+
+def test_attach_detach_and_unknown_tenant():
+    sched, models, hists, _ = _build_fleet(2)
+    assert sched.tenants == ["t0", "t1"]
+    assert sched.n_groups == 1               # same key -> one group
+    with pytest.raises(ValueError, match="already attached"):
+        sched.attach(sched.session("t0"))
+    with pytest.raises(KeyError, match="no tenant"):
+        sched.submit("nope", np.zeros(S))
+    sess = sched.detach("t1")
+    assert sched.tenants == ["t0"]
+    assert sess.n_series == S                # still servable standalone
+    sess.update(hists[1][:, -1])
+
+
+# ---------------------------------------------------------------------------
+# the tentpole pin: coalesced == sequential, bitwise
+# ---------------------------------------------------------------------------
+
+def test_coalesced_ticks_bitwise_equal_per_session():
+    """≥3 tenants sharing one bucket: every round of ticks dispatches as
+    ONE coalesced device call, and every per-lane artifact — filter
+    state, covariance, likelihood, health EW, TickResult fields, and the
+    forecasts that follow — is bitwise identical to ticking each session
+    on its own."""
+    n_t = 3
+    models, hists = _tenant_fixtures(n_t)
+    ref = [ss.ServingSession.start(m, h, label=f"ref{i}",
+                                   registry=metrics.MetricsRegistry())
+           for i, (m, h) in enumerate(zip(models, hists))]
+    sched, _, _, reg = _build_fleet(0)
+    for i, (m, h) in enumerate(zip(models, hists)):
+        sched.attach(ss.ServingSession.start(
+            m, h, label=f"t{i}", registry=reg))
+    rng = np.random.default_rng(9)
+    ticks = rng.normal(size=(n_t, S, 6))
+    for t in range(6):
+        for i in range(n_t):
+            sched.submit(f"t{i}", ticks[i, :, t])
+        reports = sched.pump()
+        assert len(reports) == 1, reports    # ONE device call per round
+        assert reports[0]["tenants"] == n_t
+        for i in range(n_t):
+            ref[i].update(ticks[i, :, t])
+            np.testing.assert_array_equal(
+                np.asarray(sched.session(f"t{i}")._state.a),
+                np.asarray(ref[i]._state.a))
+    for i in range(n_t):
+        a, b = sched.session(f"t{i}"), ref[i]
+        assert a.ticks_seen == b.ticks_seen == N_HIST + 6
+        np.testing.assert_array_equal(np.asarray(a._state.P),
+                                      np.asarray(b._state.P))
+        np.testing.assert_array_equal(a.loglik, b.loglik)
+        np.testing.assert_array_equal(np.asarray(a._health.ew),
+                                      np.asarray(b._health.ew))
+        np.testing.assert_array_equal(a.lane_status, b.lane_status)
+        np.testing.assert_array_equal(a._ring_history(),
+                                      b._ring_history())
+        np.testing.assert_array_equal(sched.forecast(f"t{i}", 5),
+                                      b.forecast(5))
+    snap = reg.snapshot()["counters"]
+    assert snap["fleet.coalesced_dispatches"] == 6
+    assert snap["fleet.coalesced_ticks"] == 6 * n_t
+
+
+def test_coalesced_tickresults_match_sequential():
+    """The per-tick TickResult surfaces (innovations, variances, loglik
+    increments, status) agree bitwise too — not just the end state."""
+    models, hists = _tenant_fixtures(2, seed0=21)
+    ref = [ss.ServingSession.start(m, h, registry=metrics.MetricsRegistry())
+           for m, h in zip(models, hists)]
+    sched, _, _, reg = _build_fleet(0)
+    tenants = []
+    for i, (m, h) in enumerate(zip(models, hists)):
+        tenants.append(sched.attach(ss.ServingSession.start(
+            m, h, label=f"t{i}", registry=reg)))
+    rng = np.random.default_rng(33)
+    tick = rng.normal(size=(2, S))
+    tick[0, 1] = np.nan                      # a missing tick rides along
+    for i, la in enumerate(tenants):
+        sched.submit(la, tick[i])
+    sched.pump()
+    for i, la in enumerate(tenants):
+        want = ref[i].update(tick[i])
+        sess = sched.session(la)
+        # the last absorbed outcome is observable through state deltas;
+        # re-derive the innovation check from the public surfaces
+        np.testing.assert_array_equal(sess.loglik, ref[i].loglik)
+        np.testing.assert_array_equal(sess.lane_status, want.status)
+
+
+# ---------------------------------------------------------------------------
+# admission control: flood -> reject -> recover
+# ---------------------------------------------------------------------------
+
+def test_flood_reject_recover():
+    sched, models, hists, reg = _build_fleet(
+        2, AdmissionPolicy(queue_depth=3, on_full="reject"))
+    rng = np.random.default_rng(5)
+    # deterministic ingress flood: one submit amplifies into 16 copies
+    with resilience.fault_injection("tenant_flood", n_attempts=16):
+        with pytest.raises(FleetSaturated, match="t0.*queue is full"):
+            sched.submit("t0", rng.normal(size=S))
+    snap = reg.snapshot()["counters"]
+    assert snap["fleet.rejected"] >= 1
+    assert snap["fleet.admitted"] == 3       # the queue really is bounded
+    # recovery: drain the backlog, then normal traffic serves again
+    sched.pump(force=True)
+    before = sched.session("t0").ticks_seen
+    sched.submit("t0", rng.normal(size=S))
+    sched.submit("t1", rng.normal(size=S))
+    sched.pump()
+    assert sched.session("t0").ticks_seen > before
+    assert all(t.mode == TENANT_LIVE
+               for t in sched._tenants.values())
+
+
+def test_drop_oldest_policy_keeps_newest_tick():
+    sched, models, hists, reg = _build_fleet(
+        1, AdmissionPolicy(queue_depth=2, on_full="drop_oldest"))
+    t = sched._require("t0")
+    for k in range(5):
+        sched.submit("t0", np.full(S, float(k)))
+    assert len(t.queue) == 2
+    # the two newest survive; three oldest were evicted and counted
+    assert [float(q[0][0]) for q in t.queue] == [3.0, 4.0]
+    assert reg.snapshot()["counters"]["fleet.dropped_ticks"] == 3
+
+
+def test_degrade_policy_sheds_tenant_onto_cache_lane():
+    sched, models, hists, reg = _build_fleet(
+        1, AdmissionPolicy(queue_depth=2, on_full="degrade",
+                           shed_cooldown=1))
+    sched.forecast("t0", 4)                  # prime the cache while live
+    for k in range(4):
+        sched.submit("t0", np.full(S, float(k)))
+    t = sched._require("t0")
+    assert t.mode == TENANT_SHED and t.shed_reason == "admission"
+    # reads keep answering from the cache lane (no tick dispatched)
+    fc = sched.forecast("t0", 2)
+    assert fc.shape == (S, 2)
+    assert reg.snapshot()["counters"].get("fleet.cache_serves", 0) \
+        + reg.snapshot()["counters"].get("fleet.cache_stale", 0) >= 1
+    # pressure gone -> the pump ladder restores and replays the buffer
+    for _ in range(3):
+        sched.pump()
+    assert t.mode == TENANT_LIVE
+    assert sched.session("t0").ticks_seen == N_HIST + 4
+
+
+# ---------------------------------------------------------------------------
+# coalescing window: a straggler cannot stall the batch
+# ---------------------------------------------------------------------------
+
+def test_straggler_cannot_stall_the_batch():
+    sched, models, hists, _ = _build_fleet(
+        3, AdmissionPolicy(coalesce_window_s=10.0))
+    rng = np.random.default_rng(11)
+    with resilience.fault_injection("coalesce_straggler", lane_stride=3):
+        for i in range(3):
+            sched.submit(f"t{i}", rng.normal(size=S))
+        reports = sched.pump()
+    # the two non-straggler tenants flushed as one batch immediately —
+    # the silent tenant delayed only itself
+    assert len(reports) == 1 and reports[0]["tenants"] == 2
+    assert sched.session("t0").ticks_seen == N_HIST      # held
+    assert sched.session("t1").ticks_seen == N_HIST + 1
+    assert sched.session("t2").ticks_seen == N_HIST + 1
+    # fault gone: the held tick is a partial batch again (the others
+    # have no ticks), so it flushes on force (or the window deadline)
+    reports = sched.pump(force=True)
+    assert len(reports) == 1 and reports[0]["tenants"] == 1
+    assert sched.session("t0").ticks_seen == N_HIST + 1
+
+
+def test_partial_batch_flushes_after_window_deadline():
+    sched, models, hists, _ = _build_fleet(
+        2, AdmissionPolicy(coalesce_window_s=0.02))
+    sched.submit("t0", np.zeros(S))          # t1 stays silent
+    assert sched.pump() == []                # window still open: wait
+    time.sleep(0.1)
+    reports = sched.pump()                   # deadline: flush partial
+    assert len(reports) == 1 and reports[0]["tenants"] == 1
+    assert sched.session("t0").ticks_seen == N_HIST + 1
+
+
+# ---------------------------------------------------------------------------
+# SLO shedding: shed -> cache-serve -> restore (bitwise catch-up)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_slo_burn_sheds_worst_health_first_then_restores_bitwise(
+        monkeypatch):
+    monkeypatch.setenv("STS_SERVING_SLO_MS", "0.0001")   # every dispatch
+    #                                                      burns
+    reg = metrics.MetricsRegistry()
+    models, hists = _tenant_fixtures(2, seed0=41)
+    sessions = [ss.ServingSession.start(m, h, label=f"t{i}",
+                                        registry=reg)
+                for i, (m, h) in enumerate(zip(models, hists))]
+    # t1 carries quarantined lanes: the shed ladder must pick it first
+    rng = np.random.default_rng(3)
+    with resilience.fault_injection("state_poison", lane_stride=2):
+        sessions[1].update(rng.normal(size=S))
+    sessions[0].update(rng.normal(size=S))   # keep tick counts aligned
+    assert shed_priority(sessions[1].lane_status) \
+        > shed_priority(sessions[0].lane_status)
+
+    sched = FleetScheduler(
+        AdmissionPolicy(slo_window=4, shed_cooldown=2,
+                        cache_staleness=16, catchup_ring=64),
+        registry=reg, auto_pump=False)
+    for sess in sessions:
+        sched.attach(sess)
+    for la in sched.tenants:
+        sched.forecast(la, 4)                # prime the caches
+
+    ticks = np.random.default_rng(7).normal(size=(2, S, 10))
+    shed_at = None
+    for t in range(10):
+        for i in range(2):
+            sched.submit(f"t{i}", ticks[i, :, t])
+        sched.pump()
+        modes = [sched._tenants[f"t{i}"].mode for i in range(2)]
+        if TENANT_SHED in modes:
+            shed_at = t
+            assert modes[1] == TENANT_SHED and modes[0] == TENANT_LIVE, \
+                "the diverged-laden tenant must shed first"
+            break
+    assert shed_at is not None, "the burn never shed anything"
+    assert reg.snapshot()["counters"]["fleet.shed_lanes"] >= S
+    assert reg.snapshot()["counters"]["fleet.slo_burns"] >= 1
+
+    # reads on the shed tenant keep answering without tick dispatches:
+    # the first read refreshes the (now phase-shifted) cache, the
+    # second serves straight from it
+    dispatches = reg.snapshot()["counters"]["fleet.coalesced_dispatches"]
+    fc = sched.forecast("t1", 3)
+    assert fc.shape == (S, 3)
+    fc2 = sched.forecast("t1", 3)
+    np.testing.assert_array_equal(fc, fc2)
+    assert reg.snapshot()["counters"].get("fleet.cache_serves", 0) >= 1
+    assert reg.snapshot()["counters"]["fleet.coalesced_dispatches"] \
+        == dispatches                        # no tick work for reads
+
+    # burn clears -> ladder restores everything, replaying the buffer
+    monkeypatch.delenv("STS_SERVING_SLO_MS")
+    sched._slo_ms = None
+    for _ in range(10):
+        sched.pump()
+    assert all(sched._tenants[la].mode == TENANT_LIVE
+               for la in sched.tenants)
+    assert reg.snapshot()["counters"]["fleet.restored_tenants"] >= 1
+    # nothing was lost: every tick submitted before the break reached
+    # both sessions (t1's buffered ones through the restore replay)
+    for i in range(2):
+        assert sched.session(f"t{i}").ticks_seen \
+            == N_HIST + 1 + shed_at + 1
+
+
+@pytest.mark.slow
+def test_shed_restore_catchup_is_bitwise_sequential(monkeypatch):
+    """A tenant that rode out an overload window shed+restored must land
+    bitwise where a never-shed session fed the same stream lands (the
+    catch-up replay goes through the same warmed executable)."""
+    reg = metrics.MetricsRegistry()
+    models, hists = _tenant_fixtures(1, seed0=61)
+    sched = FleetScheduler(
+        AdmissionPolicy(slo_window=4, shed_cooldown=100,
+                        catchup_ring=64),
+        registry=reg, auto_pump=False)
+    sess = ss.ServingSession.start(models[0], hists[0], label="t0",
+                                   registry=reg)
+    sched.attach(sess)
+    mirror = ss.ServingSession.start(models[0], hists[0],
+                                     registry=metrics.MetricsRegistry())
+    rng = np.random.default_rng(13)
+    ticks = rng.normal(size=(S, 12))
+    for t in range(4):                       # live phase
+        sched.submit("t0", ticks[:, t])
+        sched.pump()
+    sched._shed(sched._require("t0"), reason="slo")   # overload hits
+    for t in range(4, 9):                    # shed phase: ticks buffer
+        sched.submit("t0", ticks[:, t])
+        sched.pump()
+    assert sess.ticks_seen == N_HIST + 4     # nothing dispatched
+    sched._restore(sched._require("t0"))     # burn clears
+    for t in range(9, 12):                   # live again
+        sched.submit("t0", ticks[:, t])
+        sched.pump()
+    for t in range(12):
+        mirror.update(ticks[:, t])
+    np.testing.assert_array_equal(np.asarray(sess._state.a),
+                                  np.asarray(mirror._state.a))
+    np.testing.assert_array_equal(np.asarray(sess._state.P),
+                                  np.asarray(mirror._state.P))
+    np.testing.assert_array_equal(sess.loglik, mirror.loglik)
+    np.testing.assert_array_equal(sched.forecast("t0", 6),
+                                  mirror.forecast(6))
+
+
+# ---------------------------------------------------------------------------
+# zero-recompile pin with the scheduler armed
+# ---------------------------------------------------------------------------
+
+def test_warmed_fleet_pump_triggers_zero_compiles():
+    metrics.install_jax_hooks()
+    sched, models, hists, _ = _build_fleet(3, seed0=71)
+    sched.warmup()
+    for la in sched.tenants:
+        sched.forecast(la, 5)                # warm this horizon
+    rng = np.random.default_rng(17)
+    before = metrics.jax_stats()["jit_compiles"]
+    for t in range(4):
+        for i in range(3):
+            sched.submit(f"t{i}", rng.normal(size=S))
+        sched.pump()
+    for la in sched.tenants:
+        sched.forecast(la, 5)
+    assert metrics.jax_stats()["jit_compiles"] - before == 0, \
+        "compiles leaked into the warmed coalesced tick path"
+
+
+# ---------------------------------------------------------------------------
+# migration: drain/adopt (in-process, mismatches, kill -9 pair)
+# ---------------------------------------------------------------------------
+
+def test_drain_adopt_roundtrip_with_pending_ticks(tmp_path):
+    sched, models, hists, _ = _build_fleet(1, seed0=81)
+    mirror = ss.ServingSession.start(models[0], hists[0],
+                                     registry=metrics.MetricsRegistry())
+    rng = np.random.default_rng(19)
+    ticks = rng.normal(size=(S, 6))
+    for t in range(4):
+        sched.submit("t0", ticks[:, t])
+        sched.pump()
+    sched.submit("t0", ticks[:, 4])          # two ticks still queued
+    sched.submit("t0", ticks[:, 5])
+    path = str(tmp_path / "t0.bundle")
+    rep = sched.drain("t0", path)
+    assert rep["pending"] == 2
+    assert sched.tenants == []
+    sched2 = FleetScheduler(registry=metrics.MetricsRegistry(),
+                            auto_pump=False)
+    assert sched2.adopt(path) == "t0"
+    for t in range(6):
+        mirror.update(ticks[:, t])
+    sess = sched2.session("t0")
+    assert sess.ticks_seen == mirror.ticks_seen
+    np.testing.assert_array_equal(np.asarray(sess._state.a),
+                                  np.asarray(mirror._state.a))
+    np.testing.assert_array_equal(sess.loglik, mirror.loglik)
+    np.testing.assert_array_equal(sched2.forecast("t0", 4),
+                                  mirror.forecast(4))
+
+
+def test_adopt_rejects_mismatched_bundles(tmp_path):
+    from spark_timeseries_tpu.utils import checkpoint as ckpt
+
+    sched, models, hists, _ = _build_fleet(1, seed0=91)
+    path = str(tmp_path / "ok.bundle")
+    sched.drain("t0", path)
+    blob = ckpt.load_pytree(path)
+
+    # wrong bundle format
+    p = str(tmp_path / "fmt.bundle")
+    ckpt.save_pytree_atomic(p, dict(blob, format=99))
+    with pytest.raises(FleetRestoreMismatch, match="format"):
+        FleetScheduler(registry=metrics.MetricsRegistry()).adopt(p)
+
+    # pending geometry vs n_series
+    p = str(tmp_path / "geom.bundle")
+    ckpt.save_pytree_atomic(p, dict(blob, pending=np.zeros((1, S + 3))))
+    with pytest.raises(FleetRestoreMismatch, match="pending"):
+        FleetScheduler(registry=metrics.MetricsRegistry()).adopt(p)
+
+    # the session half's own geometry validation chains through
+    bad_sess = dict(blob["session"], bucket=16)
+    p = str(tmp_path / "sess.bundle")
+    ckpt.save_pytree_atomic(p, dict(blob, session=bad_sess))
+    with pytest.raises(FleetRestoreMismatch, match="session half"):
+        FleetScheduler(registry=metrics.MetricsRegistry()).adopt(p)
+
+    # unreadable path
+    with pytest.raises(FleetRestoreMismatch, match="cannot be read"):
+        FleetScheduler(registry=metrics.MetricsRegistry()).adopt(
+            str(tmp_path / "missing.bundle"))
+
+    # duplicate label in the adopting scheduler
+    sched3 = FleetScheduler(registry=metrics.MetricsRegistry(),
+                            auto_pump=False)
+    sched3.adopt(path)
+    with pytest.raises(FleetRestoreMismatch, match="exactly one"):
+        sched3.adopt(path)
+
+
+_MIGRATE_CHILD = """
+import contextlib, os
+import numpy as np
+import jax.numpy as jnp
+from spark_timeseries_tpu import statespace as ss
+from spark_timeseries_tpu.models import arima
+from spark_timeseries_tpu.utils import resilience
+
+def panel(n_series, n, seed):
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=(n_series, n + 16))
+    y = np.zeros((n_series, n + 16))
+    for t in range(2, n + 16):
+        y[:, t] = 0.3 + 0.5*y[:, t-1] - 0.2*y[:, t-2] + e[:, t]
+    return y[:, 16:]
+
+S = 4
+hist = panel(S, 120, 7)
+live = panel(S, 40, 8)
+model = arima.fit(2, 0, 0, jnp.asarray(hist), warn=False)
+sched = ss.FleetScheduler(auto_pump=False)
+sess = ss.ServingSession.start(model, hist, label="mig")
+sched.attach(sess)
+for t in range(12):
+    sched.submit("mig", live[:, t])
+    sched.pump()
+sched.submit("mig", live[:, 12])   # two undispatched ticks ride the
+sched.submit("mig", live[:, 13])   # bundle
+with resilience.fault_injection("drop_tenant_process"):
+    sched.drain("mig", os.environ["STS_TEST_BUNDLE"])
+print("UNREACHABLE: drain survived drop_tenant_process", flush=True)
+raise SystemExit(3)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(900)
+def test_drain_kill9_adopt_subprocess_pair(tmp_path):
+    """The migration acceptance pin: a process SIGKILLed the instant its
+    drain bundle commits loses nothing — another process adopts the
+    bundle, replays the queued ticks, and every subsequent tick and
+    forecast is bitwise an uninterrupted session's."""
+    bundle = str(tmp_path / "mig.bundle")
+    inc_dir = str(tmp_path / "incidents")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               STS_TEST_BUNDLE=bundle, STS_INCIDENT_DIR=inc_dir)
+    out = subprocess.run([sys.executable, "-c", _MIGRATE_CHILD],
+                         capture_output=True, text=True, cwd=REPO,
+                         env=env, timeout=600)
+    assert out.returncode == -9, (out.returncode, out.stderr[-2000:])
+    assert os.path.exists(bundle + ".npz")
+    assert os.path.exists(bundle + ".tree.json")
+    # the pre-kill forensics bundle landed too
+    incidents = [f for f in os.listdir(inc_dir)
+                 if "drop_tenant_process" in f] if os.path.isdir(inc_dir) \
+        else []
+    assert incidents, os.listdir(inc_dir) if os.path.isdir(inc_dir) \
+        else "no incident dir"
+
+    # adopt in THIS process; the uninterrupted mirror recomputes the
+    # child's whole stream locally (fits are cross-process bitwise
+    # deterministic — the journal resume suite already pins that)
+    def panel(n_series, n, seed):
+        rng = np.random.default_rng(seed)
+        e = rng.normal(size=(n_series, n + 16))
+        y = np.zeros((n_series, n + 16))
+        for t in range(2, n + 16):
+            y[:, t] = 0.3 + 0.5 * y[:, t - 1] - 0.2 * y[:, t - 2] \
+                + e[:, t]
+        return y[:, 16:]
+
+    hist = panel(S, 120, 7)
+    live = panel(S, 40, 8)
+    model = arima.fit(2, 0, 0, jnp.asarray(hist), warn=False)
+    mirror = ss.ServingSession.start(model, hist,
+                                     registry=metrics.MetricsRegistry())
+    sched = FleetScheduler(registry=metrics.MetricsRegistry(),
+                           auto_pump=False)
+    label = sched.adopt(bundle)              # replays the 2 queued ticks
+    assert label == "mig"
+    for t in range(14):
+        mirror.update(live[:, t])
+    sess = sched.session("mig")
+    assert sess.ticks_seen == mirror.ticks_seen == 120 + 14
+    np.testing.assert_array_equal(np.asarray(sess._state.a),
+                                  np.asarray(mirror._state.a))
+    np.testing.assert_array_equal(np.asarray(sess._state.P),
+                                  np.asarray(mirror._state.P))
+    np.testing.assert_array_equal(sess.loglik, mirror.loglik)
+    # and the adopted tenant keeps serving bitwise
+    for t in range(14, 18):
+        sched.submit("mig", live[:, t])
+        sched.pump()
+        mirror.update(live[:, t])
+    np.testing.assert_array_equal(sched.forecast("mig", 6),
+                                  mirror.forecast(6))
+
+
+# ---------------------------------------------------------------------------
+# serving satellites: batch-width errors
+# ---------------------------------------------------------------------------
+
+def test_update_batch_width_mismatch_is_named_error():
+    models, hists = _tenant_fixtures(1, seed0=95)
+    sess = ss.ServingSession.start(models[0], hists[0],
+                                   registry=metrics.MetricsRegistry())
+    with pytest.raises(ValueError, match="update_batch expects"):
+        sess.update_batch(np.zeros((S + 2, 3)))
+    with pytest.raises(ValueError, match="at least one tick"):
+        sess.update_batch(np.zeros((S, 0)))
+    with pytest.raises(ValueError, match="offset per series"):
+        sess.update(np.zeros(S), offset=np.zeros(S + 1))
+    with pytest.raises(ValueError, match="offsets must match"):
+        sess.update_batch(np.zeros((S, 2)), offsets=np.zeros((S, 3)))
+    # the happy path is bitwise the sequential updates
+    mirror = ss.ServingSession.start(models[0], hists[0],
+                                     registry=metrics.MetricsRegistry())
+    rng = np.random.default_rng(23)
+    batch = rng.normal(size=(S, 4))
+    sess.update_batch(batch)
+    for t in range(4):
+        mirror.update(batch[:, t])
+    np.testing.assert_array_equal(np.asarray(sess._state.a),
+                                  np.asarray(mirror._state.a))
+    np.testing.assert_array_equal(sess.loglik, mirror.loglik)
+
+
+def test_monitor_panel_width_mismatch_is_named_error():
+    from spark_timeseries_tpu.statespace.health import monitor_panel
+
+    models, hists = _tenant_fixtures(1, seed0=97)
+    sess = ss.ServingSession.start(models[0], hists[0],
+                                   registry=metrics.MetricsRegistry())
+    with pytest.raises(ValueError, match="monitor_panel expects"):
+        monitor_panel(sess._ssm, sess._state, sess._health,
+                      jnp.zeros((S, 5)),     # un-bucketed width
+                      sess.meta, sess.policy)
+
+
+# ---------------------------------------------------------------------------
+# telemetry + tooling wiring
+# ---------------------------------------------------------------------------
+
+def test_fleet_panel_lands_in_snapshot_and_sts_top():
+    from spark_timeseries_tpu.utils import telemetry
+    from tools.sts_top import render_snapshot
+
+    sched, models, hists, _ = _build_fleet(2, seed0=99)
+    sched.submit("t0", np.zeros(S))
+    sched.pump(force=True)
+    doc = telemetry.snapshot_doc()
+    fleets = [f for f in doc["fleets"]
+              if f.get("label") == sched.label]
+    assert fleets, doc["fleets"]
+    panel = fleets[0]
+    assert panel["tenants"] == 2
+    rows = {r["tenant"]: r for r in panel["tenant_rows"]}
+    assert rows["t0"]["mode"] == TENANT_LIVE
+    assert rows["t0"]["admitted"] == 1
+    frame = render_snapshot(json.loads(json.dumps(doc)))
+    assert "FLEET" in frame
+    assert sched.label in frame
+    assert "t0" in frame
+
+
+def test_bench_gate_extracts_fleet_metrics():
+    from tools.bench_gate import METRICS, extract_metrics
+
+    names = [m[0] for m in METRICS]
+    assert "fleet_ticks_per_s" in names
+    assert "fleet_shed_lanes" in names
+
+    h = {"value": 1.0, "fleet_demo": {
+        "fleet_ticks_per_s": 5000.0, "sessions": 64}}
+    got = extract_metrics(h)
+    assert got["fleet_ticks_per_s"] == 5000.0
+    assert got["fleet_shed_lanes"] == 0.0    # block present -> measured 0
+
+    h = {"value": 1.0, "fleet_demo": {
+        "fleet_ticks_per_s": 5000.0, "shed_lanes": 32}}
+    assert extract_metrics(h)["fleet_shed_lanes"] == 32.0
+
+    # pre-fleet rounds and errored demos fabricate nothing
+    assert "fleet_ticks_per_s" not in extract_metrics({"value": 1.0})
+    assert "fleet_shed_lanes" not in extract_metrics({"value": 1.0})
+    assert "fleet_shed_lanes" not in extract_metrics(
+        {"value": 1.0, "fleet_demo": {"error": "boom"}})
+
+
+# ---------------------------------------------------------------------------
+# review-finding pins
+# ---------------------------------------------------------------------------
+
+def test_cache_phase_keeps_advancing_past_ring_saturation():
+    """Review pin: the forecast cache's phase shift is arrival-based —
+    once the bounded catch-up ring saturates, its length stops growing,
+    but the stream's clock must not: a long-shed tenant's cache goes
+    STALE (and refreshes) instead of freezing on one phase forever."""
+    sched, models, hists, reg = _build_fleet(
+        1, AdmissionPolicy(catchup_ring=4, cache_staleness=2,
+                           shed_cooldown=100))
+    sched.forecast("t0", 3)                  # prime while live
+    sched._shed(sched._require("t0"), reason="slo")
+    rng = np.random.default_rng(29)
+    for _ in range(10):                      # 10 arrivals >> ring of 4
+        sched.submit("t0", rng.normal(size=S))
+    t = sched._require("t0")
+    assert len(t.catchup) == 4               # ring saturated
+    assert t.elapsed_since_cache() > sched.policy.cache_staleness
+    sched.forecast("t0", 3)                  # must refresh, not freeze
+    assert reg.snapshot()["counters"]["fleet.cache_stale"] >= 1
+    # right after the refresh the phase is 0 again: cache-serve
+    sched.forecast("t0", 3)
+    assert reg.snapshot()["counters"]["fleet.cache_serves"] >= 1
+    # and new arrivals advance the phase past the bound once more
+    for _ in range(4):
+        sched.submit("t0", rng.normal(size=S))
+    stale_before = reg.snapshot()["counters"]["fleet.cache_stale"]
+    sched.forecast("t0", 3)
+    assert reg.snapshot()["counters"]["fleet.cache_stale"] \
+        == stale_before + 1
+
+
+def test_drain_adopt_preserves_catchup_and_offsets(tmp_path):
+    """Review pin: the bundle carries the catch-up ring's ticks WITH
+    their exogenous offsets (and the queue's), so an adopted tenant that
+    was shed mid-drain replays bitwise — offsets included."""
+    sched, models, hists, _ = _build_fleet(
+        1, AdmissionPolicy(shed_cooldown=100))
+    mirror = ss.ServingSession.start(models[0], hists[0],
+                                     registry=metrics.MetricsRegistry())
+    rng = np.random.default_rng(31)
+    ticks = rng.normal(size=(S, 4))
+    offs = rng.normal(size=(S, 4)) * 0.1
+    sched._shed(sched._require("t0"), reason="slo")
+    sched.submit("t0", ticks[:, 0], offset=offs[:, 0])   # -> catchup
+    sched.submit("t0", ticks[:, 1], offset=offs[:, 1])
+    t = sched._require("t0")
+    t.mode = TENANT_LIVE                    # queue the rest as pending
+    sched._shed_order.remove("t0")
+    t.shed_reason = None
+    sched.submit("t0", ticks[:, 2], offset=offs[:, 2])
+    sched.submit("t0", ticks[:, 3], offset=offs[:, 3])
+    path = str(tmp_path / "offs.bundle")
+    rep = sched.drain("t0", path)
+    assert rep["pending"] == 2 and rep["catchup"] == 2
+    sched2 = FleetScheduler(registry=metrics.MetricsRegistry(),
+                            auto_pump=False)
+    sched2.adopt(path)
+    for k in range(4):
+        mirror.update(ticks[:, k], offs[:, k])
+    sess = sched2.session("t0")
+    np.testing.assert_array_equal(np.asarray(sess._state.a),
+                                  np.asarray(mirror._state.a))
+    np.testing.assert_array_equal(sess.loglik, mirror.loglik)
+
+
+def test_adopt_deferred_ingest_keeps_stream_order(tmp_path):
+    """Review pin: adopt(replay=False) parks the bundle's ticks at the
+    FRONT of the live queue in stream order (catch-up first), so later
+    submits can never overtake them."""
+    sched, models, hists, _ = _build_fleet(
+        1, AdmissionPolicy(shed_cooldown=100))
+    mirror = ss.ServingSession.start(models[0], hists[0],
+                                     registry=metrics.MetricsRegistry())
+    rng = np.random.default_rng(37)
+    ticks = rng.normal(size=(S, 5))
+    sched._shed(sched._require("t0"), reason="slo")
+    sched.submit("t0", ticks[:, 0])          # catchup
+    t = sched._require("t0")
+    t.mode = TENANT_LIVE
+    sched._shed_order.remove("t0")
+    t.shed_reason = None
+    sched.submit("t0", ticks[:, 1])          # pending
+    sched.submit("t0", ticks[:, 2])
+    path = str(tmp_path / "order.bundle")
+    sched.drain("t0", path)
+    sched2 = FleetScheduler(registry=metrics.MetricsRegistry(),
+                            auto_pump=False)
+    sched2.adopt(path, replay=False)
+    # deferred ticks count as stream arrivals (the cache phase clock)
+    assert sched2._require("t0").arrived == 3
+    sched2.submit("t0", ticks[:, 3])         # newer traffic
+    sched2.submit("t0", ticks[:, 4])
+    for _ in range(5):
+        sched2.pump(force=True)
+    for k in range(5):
+        mirror.update(ticks[:, k])
+    sess = sched2.session("t0")
+    assert sess.ticks_seen == mirror.ticks_seen
+    np.testing.assert_array_equal(np.asarray(sess._state.a),
+                                  np.asarray(mirror._state.a))
+    np.testing.assert_array_equal(sess.loglik, mirror.loglik)
+
+
+def test_warmed_partial_flushes_trigger_zero_compiles():
+    """Review pin: warmup covers every power-of-two slot width, so a
+    window-deadline/straggler partial flush (G < full group) compiles
+    nothing inside the hot pump."""
+    metrics.install_jax_hooks()
+    sched, models, hists, _ = _build_fleet(3, seed0=51)
+    sched.warmup()
+    rng = np.random.default_rng(41)
+    before = metrics.jax_stats()["jit_compiles"]
+    # G=2 flush (slots 2): two tenants only
+    sched.submit("t0", rng.normal(size=S))
+    sched.submit("t1", rng.normal(size=S))
+    sched.pump(force=True)
+    # G=1 flush (slots 1)
+    sched.submit("t2", rng.normal(size=S))
+    sched.pump(force=True)
+    # full G=3 flush (slots 4)
+    for i in range(3):
+        sched.submit(f"t{i}", rng.normal(size=S))
+    sched.pump()
+    assert metrics.jax_stats()["jit_compiles"] - before == 0, \
+        "a partial-width flush compiled inside the warmed pump"
+
+
+def test_degrade_shed_does_not_oscillate_under_sustained_flood():
+    """Review pin: an admission-shed tenant restores only once its
+    ingress goes quiet — a producer that keeps flooding must not drive
+    a shed/replay/shed oscillation every cooldown."""
+    sched, models, hists, reg = _build_fleet(
+        1, AdmissionPolicy(queue_depth=2, on_full="degrade",
+                           shed_cooldown=1))
+    rng = np.random.default_rng(43)
+    for k in range(3):                       # saturate -> degrade-shed
+        sched.submit("t0", rng.normal(size=S))
+    t = sched._require("t0")
+    assert t.mode == TENANT_SHED
+    for _ in range(6):                       # sustained flood: one
+        sched.submit("t0", rng.normal(size=S))   # arrival per pump
+        sched.pump()
+        assert t.mode == TENANT_SHED, \
+            "restored into a live flood (oscillation)"
+    assert reg.snapshot()["counters"].get("fleet.restored_tenants",
+                                          0) == 0
+    sched.pump()                             # quiet pumps: pressure gone
+    sched.pump()
+    assert t.mode == TENANT_LIVE
+    assert reg.snapshot()["counters"]["fleet.restored_tenants"] == 1
+
+
+def test_malformed_submit_rejected_at_admission_boundary():
+    """Review pin: a wrong-width tick fails at submit() — the producer's
+    own call — and never reaches a coalesced dispatch where it would
+    destroy co-grouped peers' already-dequeued ticks."""
+    sched, models, hists, _ = _build_fleet(2, seed0=53)
+    sched.submit("t0", np.zeros(S))          # a healthy peer queues
+    with pytest.raises(ValueError, match="t1.*one tick per series"):
+        sched.submit("t1", np.zeros(S + 2))
+    with pytest.raises(ValueError, match="t1.*offset per series"):
+        sched.submit("t1", np.zeros(S), offset=np.zeros(S + 1))
+    # the peer's queued tick survived the neighbor's bad submit
+    assert len(sched._require("t0").queue) == 1
+    sched.submit("t1", np.zeros(S))
+    reports = sched.pump()
+    assert reports and reports[0]["tenants"] == 2
+
+
+def test_fleet_forecast_offsets_passthrough():
+    """Review pin: exogenous offsets flow through the fleet read path
+    (request-specific — never cached), live and shed alike."""
+    sched, models, hists, _ = _build_fleet(
+        1, AdmissionPolicy(shed_cooldown=100))
+    offs = np.full((S, 4), 0.5)
+    base = sched.forecast("t0", 4)
+    shifted = sched.forecast("t0", 4, offsets=offs)
+    assert shifted.shape == (S, 4)
+    assert not np.array_equal(base, shifted)
+    want = sched.session("t0").forecast(4, offsets=offs)
+    np.testing.assert_array_equal(shifted, want)
+    # shed: still served (off the frozen state), still not cached
+    sched._shed(sched._require("t0"), reason="slo")
+    shed_shifted = sched.forecast("t0", 4, offsets=offs)
+    np.testing.assert_array_equal(shed_shifted, want)
+    assert sched._require("t0").cache_fc is None or not \
+        np.array_equal(sched._require("t0").cache_fc[:, :4], shifted)
+
+
+def test_gathered_ssm_is_reused_until_session_heals():
+    """Review pin: the static SSM gather is cached per participation
+    pattern and re-gathered only when a member's SSM object is swapped
+    (heal/splice/restore) — the hot pump must not re-upload O(G·B·m²)
+    transition floats every round."""
+    import jax
+
+    sched, models, hists, _ = _build_fleet(2, seed0=57)
+    rng = np.random.default_rng(59)
+    for _ in range(2):
+        for i in range(2):
+            sched.submit(f"t{i}", rng.normal(size=S))
+        sched.pump()
+    assert len(sched._gather_cache) == 1
+    (refs, gathered), = sched._gather_cache.values()
+    for i in range(2):
+        sched.submit(f"t{i}", rng.normal(size=S))
+    sched.pump()
+    (refs2, gathered2), = sched._gather_cache.values()
+    assert gathered2 is gathered             # reused, not re-gathered
+    # simulate a heal: the session swaps in a NEW ssm pytree
+    sess = sched.session("t0")
+    sess._ssm = jax.tree_util.tree_map(lambda x: x, sess._ssm)
+    for i in range(2):
+        sched.submit(f"t{i}", rng.normal(size=S))
+    sched.pump()
+    (refs3, gathered3), = sched._gather_cache.values()
+    assert gathered3 is not gathered         # invalidated + refreshed
+
+
+def test_bench_gate_flags_first_shedding_round():
+    from tools.bench_gate import evaluate
+
+    def mk(r, shed=0):
+        return {"round": r, "rc": 0, "path": f"r{r}", "headline": {
+            "metric": "t", "value": 100.0, "platform": "cpu",
+            "fleet_demo": {"fleet_ticks_per_s": 5000.0,
+                           "shed_lanes": shed}}}
+
+    clean = [mk(r) for r in range(1, 4)]
+    verdict = evaluate(clean + [mk(4, shed=16)])
+    row = next(r for r in verdict["rows"]
+               if r["metric"] == "fleet_shed_lanes")
+    assert row["status"] == "REGRESSED"
+    verdict = evaluate(clean + [mk(4)])
+    row = next(r for r in verdict["rows"]
+               if r["metric"] == "fleet_shed_lanes")
+    assert row["status"] == "ok"

@@ -244,8 +244,11 @@ def test_fit_long_forecast_agrees_with_full_series_filter():
     seq = filter_panel(ssm, initial_state(ssm, meta0),
                        jnp.asarray(diffed[None]), meta0).state
     seq = seq._replace(ring=jnp.asarray(fl._ring[None]))
+    from spark_timeseries_tpu.statespace.health import (HealthPolicy,
+                                                        initial_health)
     want = np.asarray(_jitted("forecast")(
-        meta, h, ssm, seq, jnp.zeros((1, h), diffed.dtype)))[0]
+        meta, h, HealthPolicy().validate(), ssm, seq,
+        initial_health(seq), jnp.zeros((1, h), diffed.dtype)))[0]
     np.testing.assert_allclose(got, want, rtol=1e-7, atol=1e-7)
     # the reported likelihood is the σ²-concentrated exact loglik on
     # the model's own convention — NOT the unit-scale filter total

@@ -33,6 +33,8 @@ automated check (``make gate``):
   heal_p50                      ``metrics.spans["serving.heal"]`` p50         higher
   long_obs_per_s                headline ``long_demo.obs_per_s``              lower
   incidents_written             ``metrics.telemetry["incidents_written"]``    higher
+  fleet_ticks_per_s             headline ``fleet_demo.fleet_ticks_per_s``     lower
+  fleet_shed_lanes              headline ``fleet_demo.shed_lanes``            higher
   ============================  ============================================  ======
 
   (``engine_cache_misses`` is the streaming engine's executable-cache
@@ -83,6 +85,18 @@ automated check (``make gate``):
   the first such round is flagged against an all-zero history.
   Tolerated-absent in rounds that predate the telemetry block.
 
+  ``fleet_ticks_per_s`` is the fleet tier's aggregate throughput
+  (ISSUE 12): the bench's ``fleet_demo`` multiplexes ≥64 tenant
+  sessions onto coalesced update dispatches through the
+  ``FleetScheduler`` and reports lane-ticks ingested per second — a
+  >25% drop means the coalescing path regressed (ticks stopped
+  sharing device calls, a recompile leaked into the pump, the gather/
+  scatter grew host overhead).  ``fleet_shed_lanes`` is zero-baselined
+  like the reliability counters: the demo's nominal load must not burn
+  the SLO, so any round where the scheduler started shedding lanes is
+  flagged against an all-zero history.  Both tolerated-absent in
+  pre-fleet rounds.
+
 - prints a pass/fail table with signed percentage deltas (``--json``
   emits the same verdict as machine-readable JSON for CI, exit codes
   unchanged) and exits 1 on any regression, 0 otherwise.  A newest round that crashed (``rc != 0``)
@@ -128,6 +142,8 @@ METRICS = [
     ("heal_p50", "lower_better", 50.0),
     ("long_obs_per_s", "higher_better", 25.0),
     ("incidents_written", "lower_better", 50.0),
+    ("fleet_ticks_per_s", "higher_better", 25.0),
+    ("fleet_shed_lanes", "lower_better", 50.0),
 ]
 
 
@@ -212,6 +228,20 @@ def extract_metrics(headline: Optional[dict]) -> Dict[str, float]:
     if isinstance(ld, dict) and isinstance(ld.get("obs_per_s"),
                                            (int, float)):
         out["long_obs_per_s"] = float(ld["obs_per_s"])
+    # fleet tier (ISSUE 12): aggregate coalesced lane-tick throughput
+    # across the many-session fleet demo (higher-better) and its shed
+    # counter — a block present with shed_lanes absent is a measured 0
+    # (the zero-baseline rule: a bench fleet must not shed under its
+    # own nominal load), and both are tolerated-absent in rounds that
+    # predate the fleet tier
+    fd = headline.get("fleet_demo")
+    if isinstance(fd, dict):
+        if isinstance(fd.get("fleet_ticks_per_s"), (int, float)):
+            out["fleet_ticks_per_s"] = float(fd["fleet_ticks_per_s"])
+        if "error" not in fd:
+            v = fd.get("shed_lanes", 0)
+            if isinstance(v, (int, float)):
+                out["fleet_shed_lanes"] = float(v)
     m = headline.get("metrics")
     if isinstance(m, dict):
         spans = m.get("spans")

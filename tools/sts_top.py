@@ -12,6 +12,9 @@ exporter's ``/snapshot.json`` (``utils.telemetry``; armed with
 - **serving**: per-session lane health and the rolling tick-latency
   window — p50/p95 ms, SLO burns against ``STS_SERVING_SLO_MS``,
   quarantined lanes;
+- **fleet**: per-scheduler admission/coalescing/shed state — tenants
+  (live vs shed, queue depth, admitted/rejected/dropped, cache
+  serves) under the aggregate p95 and SLO burn count;
 - **incidents**: the flight recorder's newest bundles (kind, age,
   size) so a crash's forensics are one glance away.
 
@@ -125,6 +128,26 @@ def _serving_rows(sessions: List[Dict[str, Any]]) -> List[List[str]]:
     return rows
 
 
+def _fleet_tenant_rows(rows: List[Dict[str, Any]]) -> List[List[str]]:
+    out = []
+    for t in rows:
+        health = t.get("health") or {}
+        hstr = " ".join(f"{k}:{v}" for k, v in sorted(health.items())) \
+            or "-"
+        out.append([
+            str(t.get("tenant", "?")),
+            str(t.get("mode", "?")).upper(),
+            str(t.get("n_series", "?")),
+            str(t.get("queued", 0)),
+            str(t.get("admitted", 0)),
+            str(t.get("rejected", 0)),
+            str(t.get("dropped", 0)),
+            str(t.get("cache_serves", 0)),
+            hstr,
+        ])
+    return out
+
+
 def _incident_rows(incidents: List[Dict[str, Any]],
                    now: float) -> List[List[str]]:
     rows = []
@@ -182,6 +205,34 @@ def render_snapshot(snap: Dict[str, Any]) -> str:
             _serving_rows(sessions))
     else:
         lines.append("  (no live serving sessions)")
+    lines.append("")
+
+    fleets = list(snap.get("fleets") or [])
+    lines.append(f"FLEET ({len(fleets)} schedulers)")
+    if fleets:
+        for fl in fleets:
+            if "error" in fl and "label" not in fl:
+                lines.append(f"  (scrape error: {fl['error'][:60]})")
+                continue
+            p95 = fl.get("p95_ms")
+            p95s = f"{p95:.3f}ms" if isinstance(p95, (int, float)) \
+                else "-"
+            lines.append(
+                f"  {fl.get('label', '?')}: "
+                f"{fl.get('tenants', '?')} tenants / "
+                f"{fl.get('groups', '?')} groups  "
+                f"queued {fl.get('queued', 0)}  "
+                f"shed {fl.get('shed_tenants', 0)}  p95 {p95s}  "
+                f"slo_burns {fl.get('slo_burns', 0)}  "
+                f"slo_ms {fl.get('slo_ms') or '-'}")
+            rows = list(fl.get("tenant_rows") or [])
+            if rows:
+                lines += ["    " + ln for ln in _table(
+                    ["TENANT", "MODE", "SERIES", "QUEUED", "ADM",
+                     "REJ", "DROP", "CACHE", "HEALTH"],
+                    _fleet_tenant_rows(rows))]
+    else:
+        lines.append("  (no live fleet schedulers)")
     lines.append("")
 
     incidents = list(snap.get("incidents") or [])
