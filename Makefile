@@ -15,7 +15,8 @@ STS_COMPILE_CACHE ?=
 
 .PHONY: help verify compileall tier1 verify-faults verify-durability \
 	verify-perf verify-serving verify-long verify-telemetry verify-fleet \
-	gate trace lint lint-baseline contracts verify-static warmup
+	verify-backtest gate trace lint lint-baseline contracts verify-static \
+	warmup
 
 help:
 	@echo "Targets:"
@@ -39,6 +40,8 @@ help:
 	@echo "                serving SLO windows, flight-recorder bundles incl. kill -9 forensics)"
 	@echo "  verify-fleet  multi-tenant fleet suite (admission/backpressure, coalesced ticks"
 	@echo "                bitwise-pinned, SLO shedding + cached forecasts, drain/adopt kill -9)"
+	@echo "  verify-backtest rolling-origin backtest suite (pinned-gain replay vs sequential"
+	@echo "                oracle, NumPy metric oracles, champion determinism, kill -9 resume)"
 	@echo "  verify-perf   perf gate: newest BENCH_r*.json vs trailing-median baseline"
 	@echo "  gate          same as verify-perf (tools/bench_gate.py; exit 1 on regression)"
 	@echo "  trace         run a small demo workload, write trace.json (open in ui.perfetto.dev)"
@@ -165,6 +168,18 @@ verify-fleet:
 # filter; includes the slow 10⁶-obs end-to-end case tier-1 skips
 verify-long:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m long \
+		--continue-on-collection-errors -p no:cacheprovider \
+		-p no:xdist -p no:randomly
+
+# backtest-tier gate (ISSUE 13): the `backtest`-marked subset — origin
+# schedule/grid planning, pinned-gain origin replay pinned against the
+# sequential refilter oracle to 1e-9, metric kernels against NumPy
+# oracles incl. NaN-masked lanes, champion selection determinism (digest
+# equality across runs) and the seeded true-model recovery acceptance,
+# and the kill -9 mid-grid journal-resume subprocess pair; includes the
+# slow cases tier-1 skips
+verify-backtest:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m backtest \
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly
 

@@ -440,6 +440,16 @@ def main():
                      if k.startswith("serving.")})
         if serv:
             block["serving"] = serv
+        # the backtest tier's accounting: sweeps run, candidates/series/
+        # origins evaluated, journal resume hits, dead lanes (the
+        # headline accuracy numbers live in backtest_demo — these are
+        # the volume counters behind them)
+        bt = {k: v for k, v in snap["counters"].items()
+              if k.startswith("backtest.")}
+        bt.update({k: v for k, v in snap["gauges"].items()
+                   if k.startswith("backtest.")})
+        if bt:
+            block["backtest"] = bt
         block["telemetry"] = _telemetry_block(snap)
         block["static_analysis"] = _static_analysis_block()
         return block
@@ -932,6 +942,89 @@ def main():
             # failure must not void the already-measured curve
             long_demo = {"error": f"{type(e).__name__}: {e}"}
 
+    # backtest demo (ISSUE 13): the repo's FIRST ACCURACY HEADLINE — a
+    # pinned synthetic panel of three known generating processes (AR(1),
+    # ARMA(1,1), SES local level) swept through backtest_panel's
+    # 4-candidate grid: per-candidate streamed fits, pinned-gain origin
+    # replay, in-graph NaN-masked metrics, champion selection.
+    # champion_smape / champion_mase are the panel-mean out-of-sample
+    # errors of each series' champion; tools/bench_gate.py gates BOTH as
+    # higher-is-regression once two rounds carry them — a modeling-path
+    # change that silently degrades forecast quality now fails the gate
+    # even if throughput is unchanged.  The panel is seeded and the
+    # whole sweep deterministic on CPU, so the gated numbers move only
+    # when the math does.
+    backtest_demo = None
+    if error is None and os.environ.get("BENCH_BACKTEST", "1") == "1":
+        try:
+            from spark_timeseries_tpu.backtest import (CandidateGrid,
+                                                       backtest_panel)
+
+            bt_S = max(6, int(os.environ.get("BENCH_BACKTEST_SERIES",
+                                             "16")))
+            bt_n = max(256, int(os.environ.get("BENCH_BACKTEST_OBS",
+                                               "768")))
+            bt_burn = 256
+
+            def _bt_arma(S, phi, theta, seed):
+                r = np.random.default_rng(seed)
+                e = r.standard_normal((S, bt_n + bt_burn))
+                y = np.zeros((S, bt_n + bt_burn))
+                for t in range(1, bt_n + bt_burn):
+                    ar = sum(p * y[:, t - 1 - i]
+                             for i, p in enumerate(phi))
+                    ma = sum(q * e[:, t - 1 - i]
+                             for i, q in enumerate(theta))
+                    y[:, t] = 2.0 + ar + e[:, t] + ma
+                return y[:, bt_burn:]
+
+            def _bt_ses(S, alpha, seed):
+                r = np.random.default_rng(seed)
+                e = r.standard_normal((S, bt_n))
+                y = np.zeros((S, bt_n))
+                lvl = np.full(S, 10.0)
+                for t in range(bt_n):
+                    y[:, t] = lvl + e[:, t]
+                    lvl = lvl + alpha * e[:, t]
+                return y
+
+            bt_panel = np.concatenate([
+                _bt_arma(bt_S, (0.8,), (), 101),
+                _bt_arma(bt_S, (0.4,), (0.9,), 102),
+                _bt_ses(bt_S, 0.4, 103),
+            ]).astype(np_dtype)
+            bt_truth = np.repeat([0, 2, 3], bt_S)
+            bt_grid = CandidateGrid({"ar": [1, 2], "arima": [(1, 0, 1)],
+                                     "ewma": True}, horizons=(1, 2, 4))
+            with metrics.span("bench.backtest_demo"):
+                t0 = time.perf_counter()
+                bt_rep = backtest_panel(bt_panel, bt_grid,
+                                        n_origins=128, stride=2,
+                                        min_train=bt_n - 256)
+                bt_s = time.perf_counter() - t0
+            bt_sm = bt_rep.champion_score("smape")
+            bt_ms = bt_rep.champion_score("mase")
+            backtest_demo = {
+                "n_series": int(bt_panel.shape[0]),
+                "n_obs": bt_n,
+                "n_candidates": len(bt_rep.candidates),
+                "n_origins": bt_rep.schedule.n_origins,
+                "horizons": list(bt_rep.horizons),
+                "champion_smape": round(float(np.nanmean(bt_sm)), 4),
+                "champion_mase": round(float(np.nanmean(bt_ms)), 4),
+                "true_model_recovery": round(float(
+                    np.mean(bt_rep.champion == bt_truth)), 4),
+                "champion_counts": bt_rep.champion_counts(),
+                "coverage_mean": round(float(np.nanmean(
+                    bt_rep.horizon_table("coverage"))), 4),
+                "series_per_s": round(
+                    bt_panel.shape[0] * len(bt_rep.candidates) / bt_s, 1),
+                "seconds": round(bt_s, 3),
+            }
+        except Exception as e:  # noqa: BLE001 — optional extra; its
+            # failure must not void the already-measured curve
+            backtest_demo = {"error": f"{type(e).__name__}: {e}"}
+
     # compiled-program cost accounting (ISSUE 3): ask XLA what one
     # compiled fit of the benched chunk shape costs — FLOPs, bytes, peak
     # memory, HLO op mix — per family in BENCH_COST_FAMILIES (default:
@@ -1048,6 +1141,7 @@ def main():
         "serving_demo": serving_demo,
         "fleet_demo": fleet_demo,
         "long_demo": long_demo,
+        "backtest_demo": backtest_demo,
         "cost_reports": cost_reports,
         "baseline_emulation": {
             "kind": "per-series scipy Powell on the same CSS objective",

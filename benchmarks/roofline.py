@@ -125,7 +125,7 @@ def main():
          f"chained x{R})",
          t_ne, tangent_share=round(1 - t_resid / t_ne, 3))
 
-    # the production pass: hand-fused carry accumulation (design.md §9)
+    # the production pass: hand-fused carry accumulation (design.md §9b)
     from spark_timeseries_tpu.models.arima import _arma_normal_eqs
 
     def fused_scalar(prm, y):

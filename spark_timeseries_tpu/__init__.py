@@ -19,10 +19,12 @@ import logging as _logging
 # utils.observability.configure_logging(level).
 _logging.getLogger(__name__).addHandler(_logging.NullHandler())
 
-from . import engine, io, longseries, models, ops  # noqa: F401,E402
+from . import backtest, engine, io, longseries, models, ops  # noqa: F401,E402
 from . import parallel, stats, statespace, time, utils  # noqa: F401,E402
+from .backtest import BacktestReport, backtest_panel  # noqa: F401,E402
 from .panel import Panel, lagged_pair_key, lagged_string_key  # noqa: F401
 
-__all__ = ["engine", "io", "longseries", "models", "ops", "parallel",
-           "stats", "statespace", "time", "utils", "Panel",
+__all__ = ["backtest", "engine", "io", "longseries", "models", "ops",
+           "parallel", "stats", "statespace", "time", "utils", "Panel",
+           "backtest_panel", "BacktestReport",
            "lagged_pair_key", "lagged_string_key", "__version__"]

@@ -1,0 +1,32 @@
+"""Backtest tier: vectorized rolling-origin evaluation and per-series
+champion selection (ROADMAP item 5).
+
+Nothing else in the stack answers "which model family and order is best
+for each of my million series" with *out-of-sample* evidence —
+``auto_fit_panel`` ranks by in-sample AIC only.  This subsystem
+evaluates a (family × order × horizon × origin) grid as bucketed
+batches instead of per-(series, origin) refits:
+
+- :mod:`grid` — candidate grids, rolling-origin schedules (expanding /
+  sliding fit windows, min-train floors), per-family adapters;
+- :mod:`evaluate` — fit-once / replay-every-origin scoring: pinned-gain
+  ``affine_recurrence`` state paths in O(log n) depth, one gathered row
+  per origin, in-graph NaN-masked sMAPE / MASE / RMSE / interval
+  coverage (with a sequential-refilter oracle path for tests);
+- :mod:`api` — ``backtest_panel`` streaming the grid through
+  ``engine.stream_fit`` (journal-backed crash-consistent sweeps,
+  per-candidate telemetry labels) into a :class:`~api.BacktestReport`
+  of per-series champions, per-horizon error tables, and per-origin
+  error bars.
+"""
+
+from . import api, evaluate, grid  # noqa: F401
+from .api import BacktestReport, backtest_panel  # noqa: F401
+from .evaluate import CandidateEval, evaluate_candidate  # noqa: F401
+from .grid import (Candidate, CandidateGrid, OriginSchedule,  # noqa: F401
+                   default_grid, plan_origins)
+
+__all__ = ["backtest_panel", "BacktestReport", "evaluate_candidate",
+           "CandidateEval", "Candidate", "CandidateGrid",
+           "OriginSchedule", "plan_origins", "default_grid",
+           "grid", "evaluate", "api"]

@@ -812,6 +812,7 @@ class FitEngine:
                    degrade_floor: Optional[int] = None,
                    resilient: bool = False,
                    on_progress: Optional[Callable[[Any], None]] = None,
+                   job_label: Optional[str] = None,
                    **kwargs) -> StreamResult:
         """Fit a panel larger than device memory by streaming chunks.
 
@@ -895,7 +896,14 @@ class FitEngine:
         jobs; per-job fidelity lives in ``/snapshot.json``).
         ``on_progress`` (optional callable) receives the ``JobProgress``
         after every chunk completion; a callback that raises is dropped
-        after counting ``engine.progress_cb_errors``.  With
+        after counting ``engine.progress_cb_errors``.  ``job_label``
+        overrides the family string shown on the job's telemetry row
+        (``/snapshot.json`` jobs panel, ``sts_top``) — multi-stream
+        sweeps (the backtest tier's per-candidate fits, the longseries
+        tier's segment streams) label each stream so an operator can
+        read per-stage ETAs instead of a wall of identical
+        ``arima-<pid>-<n>`` ids; purely observational, never part of
+        the journal spec.  With
         ``STS_INCIDENT_DIR`` set, chunk deaths, deadline expiries,
         OOM-at-floor, the ``kill_after_chunk`` fault, and any exception
         escaping this call each leave a forensic incident bundle
@@ -996,8 +1004,9 @@ class FitEngine:
         # can watch the run from chunk 0; the STS_TELEMETRY_PORT opt-in
         # is honored here (no exporter thread exists without it)
         _telemetry.ensure_started_from_env()
+        label = str(job_label) if job_label else family
         progress = _telemetry.JobProgress(
-            _telemetry.new_job_id(family), family, n_series,
+            _telemetry.new_job_id(label), label, n_series,
             len(partition), chunk, journal_path=journal or None,
             resilient=resilient)
         _telemetry.register_job(progress, self._reg)

@@ -353,7 +353,8 @@ def fit_long(ts, order: Tuple[int, int, int] = (2, 1, 2),
                 panel, "arima", chunk_size=int(chunk_segments),
                 collect=True, journal=journal, job_meta=meta,
                 deadline_s=deadline_s, retry=chunk_retry,
-                degrade=degrade, p=p, d=0, q=q, **fit_kwargs)
+                degrade=degrade, p=p, d=0, q=q,
+                job_label=f"longseries:arima({p},{d},{q})", **fit_kwargs)
             stream_stats = dict(result.stats)
             stream_stats["n_chunks"] = result.n_chunks
             stream_stats["chunk_failures"] = len(result.chunk_failures)

@@ -133,7 +133,7 @@ def _arma_normal_eqs(params: jnp.ndarray, y: jnp.ndarray,
     so ``JᵀJ += T Tᵀ``, ``Jᵀr += T e``, ``sse += e²`` accumulate per step.
     Replacing the autodiff (linearize) pass with this cuts the pass's HBM
     traffic ~4x and measures 1.8x faster at the bench chunk shape
-    (16.2 -> 9.2 ms at 131072x128 f32, v5e) — see docs/design.md §9.
+    (16.2 -> 9.2 ms at 131072x128 f32, v5e) — see docs/design.md §9b.
 
     ``mask`` (k,) reproduces the masked-residual objective
     ``r(x ∘ mask)``: the recurrence runs at the masked point and the
@@ -793,6 +793,13 @@ class ARIMAModel(NamedTuple):
         (ref ``ARIMA.scala:826-830``)."""
         ll = self.log_likelihood_css(ts)
         return -2.0 * ll + 2.0 * (self.p + self.q + self._icpt)
+
+    @property
+    def n_params(self) -> int:
+        """Estimated-parameter count (intercept + AR + MA) — the AIC
+        penalty's k, and the parsimony key the backtest tier's champion
+        tie-break orders near-equal out-of-sample scores by."""
+        return self.p + self.q + self._icpt
 
     # -- distributed-combination exports (the longseries tier) --------------
 

@@ -40,6 +40,16 @@ class ARModel(NamedTuple):
     def order(self) -> int:
         return self.coefficients.shape[-1]
 
+    @property
+    def n_params(self) -> int:
+        """Estimated-parameter count (intercept slot + AR lags) — the
+        parsimony key the backtest tier's champion tie-break orders
+        near-equal out-of-sample scores by.  The intercept slot counts
+        even for ``no_intercept`` fits (the model pytree does not record
+        the constraint); tie-breaking only needs a consistent ordering
+        across candidates, not an exact likelihood penalty."""
+        return self.order + 1
+
     def remove_time_dependent_effects(self, ts: jnp.ndarray) -> jnp.ndarray:
         """``out[i] = ts[i] - c - Σ_j coef_j · ts[i-j-1]`` with out-of-range
         terms dropped (ref ``Autoregression.scala:62-77``) — fully

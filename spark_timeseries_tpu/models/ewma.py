@@ -43,6 +43,13 @@ class EWMAModel(NamedTuple):
     smoothing: jnp.ndarray
     diagnostics: Optional[FitDiagnostics] = None
 
+    @property
+    def n_params(self) -> int:
+        """Estimated-parameter count (the smoothing scalar) — the
+        parsimony key the backtest tier's champion tie-break orders
+        near-equal out-of-sample scores by."""
+        return 1
+
     def add_time_dependent_effects(self, ts: jnp.ndarray) -> jnp.ndarray:
         """Smooth i.i.d. observations: ``S_t = a X_t + (1-a) S_{t-1}``
         (ref ``EWMA.scala:135-143``).  ``ts (..., n)``; scan over time with
@@ -114,7 +121,7 @@ class EWMAModel(NamedTuple):
 def _ewma_normal_eqs(params: jnp.ndarray, series: jnp.ndarray,
                      n_valid=None):
     """Fused-carry Gauss-Newton pass for the one-step SSE residuals (same
-    trick as ``arima._arma_normal_eqs``, docs/design.md §9): with
+    trick as ``arima._arma_normal_eqs``, docs/design.md §9b): with
     ``s_t = a x_t + (1-a) s_{t-1}`` and ``e_t = x_{t+1} - s_t``, the
     tangent obeys ``ds_t = x_t - s_{t-1} + (1-a) ds_{t-1}``, so JᵀJ, Jᵀr,
     and sse accumulate in the scan carry and no ``(1, m)`` Jacobian is

@@ -262,7 +262,7 @@ def _hw_sse_value_and_grad(params: jnp.ndarray, series: jnp.ndarray,
     step's (level, trend, season-ring) carry for the backward sweep; here
     the hand tangent recurrences ride the forward carry instead (the same
     fused-accumulator shape as ``arima._arma_normal_eqs``, docs/design.md
-    §9).  Differentiating the update equations of ``HoltWintersModel._run``
+    §9b).  Differentiating the update equations of ``HoltWintersModel._run``
     w.r.t. θ = (α, β, γ), with ``e_α/e_β/e_γ`` the unit vectors:
 
         dlw  = -ds_i                (additive)  |  -(x/s_i²)·ds_i  (mult.)

@@ -467,6 +467,25 @@ class Panel:
             eng = engine if engine is not None else default_engine()
             return eng.stream_fit(self.values, family, **kwargs)
 
+    def backtest(self, grid=None, **kwargs):
+        """Rolling-origin backtest + per-series champion selection over
+        this panel — the
+        :func:`~spark_timeseries_tpu.backtest.backtest_panel` front
+        door: every grid candidate is fitted once per series on the
+        schedule's fit window (streamed through ``engine.stream_fit`` —
+        journaled, deadline-guarded, labelled per candidate in
+        ``sts_top``), every origin is replayed through the pinned-gain
+        filter path, and sMAPE / MASE / RMSE / interval coverage are
+        scored in-graph with NaN lanes masked.  ``grid`` a
+        :class:`~spark_timeseries_tpu.backtest.CandidateGrid` (default:
+        a modest AR/ARIMA/EWMA grid); schedule, selection, and
+        streaming knobs pass through (``n_origins``, ``mode``,
+        ``min_train``, ``select_by``, ``journal``, ...).  Returns a
+        :class:`~spark_timeseries_tpu.backtest.BacktestReport`."""
+        from .backtest import backtest_panel
+        with _metrics.span("panel.backtest"):
+            return backtest_panel(self.values, grid, **kwargs)
+
     def describe_costs(self, family: str = "arima") -> dict:
         """What would one compiled ``family`` fit of this panel cost?
         Asks XLA directly (``utils.costs.fit_cost_report`` at this

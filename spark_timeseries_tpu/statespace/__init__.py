@@ -33,7 +33,7 @@ from .health import (LANE_DIVERGED, LANE_OK, LANE_SUSPECT,  # noqa: F401
 from .kalman import (FilterResult, concentrated_loglik,  # noqa: F401
                      filter_forecast_origin, filter_panel,
                      filter_panel_parallel, filter_step_panel,
-                     forecast_mean)
+                     forecast_mean, pinned_state_path, steady_gain)
 from .serving import (ServingRestoreMismatch, ServingSession,  # noqa: F401
                       TickResult, start_session)
 from .ssm import (FilterState, SSMeta, StateSpace,  # noqa: F401
@@ -44,6 +44,7 @@ __all__ = [
     "StateSpace", "SSMeta", "FilterState", "initial_state", "state_nbytes",
     "filter_step_panel", "filter_panel", "filter_panel_parallel",
     "filter_forecast_origin", "forecast_mean",
+    "pinned_state_path", "steady_gain",
     "concentrated_loglik", "FilterResult",
     "to_statespace", "bootstrap", "Bootstrapped",
     "HealthPolicy", "LaneHealth", "initial_health",

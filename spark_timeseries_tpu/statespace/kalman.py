@@ -48,7 +48,8 @@ from .ssm import FilterState, SSMeta, StateSpace
 
 __all__ = ["filter_step_one", "filter_step_panel", "filter_panel",
            "filter_panel_parallel", "concentrated_loglik", "FilterResult",
-           "forecast_mean", "steady_gain", "filter_forecast_origin"]
+           "forecast_mean", "steady_gain", "filter_forecast_origin",
+           "pinned_state_path"]
 
 
 class FilterResult(NamedTuple):
@@ -385,6 +386,42 @@ def filter_forecast_origin(ssm: StateSpace, state: FilterState, ys,
         n_obs = n_obs + jnp.asarray(part.shape[1], n_obs.dtype)
     return FilterState(a=x, P=origin.P, ring=origin.ring, loglik=ll,
                        ssq=ssq, sumlogf=slf, n_obs=n_obs)
+
+
+def pinned_state_path(ssm: StateSpace, x0: jnp.ndarray, ys: jnp.ndarray,
+                      K: jnp.ndarray) -> jnp.ndarray:
+    """Every predicted state along a series under a pinned per-lane gain,
+    in O(log n) depth — the backtest tier's origin-replay primitive.
+
+    With the gain pinned the state recursion is the affine map
+    ``x_t = (T - K Z) x_{t-1} + c + K (y_t - d)`` (a missing — NaN —
+    tick drops the gain term: ``x_t = T x_{t-1} + c``), so
+    :func:`~spark_timeseries_tpu.ops.scan_parallel.affine_recurrence`
+    evaluates the whole path at once.  Unlike
+    :func:`filter_panel_parallel` (which folds the path into likelihood
+    sums) the *path itself* is returned: ``ys (S, n)``, ``x0 (S, m)``
+    the state predicted for the first tick, ``K (S, m)`` a pinned
+    prediction-form gain (:func:`steady_gain` output for converged
+    exact-mode lanes, ``ssm.gain`` for innovations-mode lanes); returns
+    ``(n + 1, S, m)`` with ``path[k]`` the state predicted after
+    consuming the first ``k`` observations (``path[0] = x0``) — exactly
+    the forecast origin conditioned on those ticks, so rolling-origin
+    evaluation gathers one row per origin instead of refiltering.
+    """
+    from ..ops.scan_parallel import affine_recurrence
+
+    ys = jnp.asarray(ys)
+    dtype = ys.dtype
+    obs = jnp.isfinite(ys)                                   # (S, n)
+    y_eff = jnp.where(obs, ys, jnp.zeros((), dtype))
+    gz = jnp.einsum("si,sj->sij", K, ssm.Z)                  # (S, m, m)
+    a_obs = ssm.T - gz
+    A = jnp.where(obs.T[:, :, None, None], a_obs[None], ssm.T[None])
+    b = ssm.c[None] + jnp.where(
+        obs.T[:, :, None],
+        K[None] * (y_eff.T - ssm.d[None])[..., None], 0.0)
+    xs = affine_recurrence(A, b, x0=x0)                      # (n, S, m)
+    return jnp.concatenate([x0[None], xs], axis=0)
 
 
 def filter_panel_parallel(ssm: StateSpace, state: FilterState,

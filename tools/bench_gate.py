@@ -35,6 +35,8 @@ automated check (``make gate``):
   incidents_written             ``metrics.telemetry["incidents_written"]``    higher
   fleet_ticks_per_s             headline ``fleet_demo.fleet_ticks_per_s``     lower
   fleet_shed_lanes              headline ``fleet_demo.shed_lanes``            higher
+  backtest_champion_smape       headline ``backtest_demo.champion_smape``     higher
+  backtest_champion_mase        headline ``backtest_demo.champion_mase``      higher
   ============================  ============================================  ======
 
   (``engine_cache_misses`` is the streaming engine's executable-cache
@@ -97,6 +99,18 @@ automated check (``make gate``):
   flagged against an all-zero history.  Both tolerated-absent in
   pre-fleet rounds.
 
+  ``backtest_champion_smape`` / ``backtest_champion_mase`` are the
+  repo's first ACCURACY gates (ISSUE 13): the bench's ``backtest_demo``
+  sweeps a pinned, seeded synthetic panel (known AR(1) / ARMA(1,1) /
+  SES generators) through ``backtest_panel``'s candidate grid and
+  reports the panel-mean out-of-sample error of each series' champion
+  model.  Higher is a regression: a change to the fit math, the origin
+  replay, or champion selection that degrades forecast quality now
+  fails the gate even when every throughput metric improves — quality
+  is gated, not just speed.  The demo is deterministic per platform, so
+  both thresholds trip on real modeling changes rather than noise;
+  tolerated-absent in rounds that predate the tier.
+
 - prints a pass/fail table with signed percentage deltas (``--json``
   emits the same verdict as machine-readable JSON for CI, exit codes
   unchanged) and exits 1 on any regression, 0 otherwise.  A newest round that crashed (``rc != 0``)
@@ -144,6 +158,8 @@ METRICS = [
     ("incidents_written", "lower_better", 50.0),
     ("fleet_ticks_per_s", "higher_better", 25.0),
     ("fleet_shed_lanes", "lower_better", 50.0),
+    ("backtest_champion_smape", "lower_better", 25.0),
+    ("backtest_champion_mase", "lower_better", 25.0),
 ]
 
 
@@ -242,6 +258,17 @@ def extract_metrics(headline: Optional[dict]) -> Dict[str, float]:
             v = fd.get("shed_lanes", 0)
             if isinstance(v, (int, float)):
                 out["fleet_shed_lanes"] = float(v)
+    # backtest tier (ISSUE 13): the first accuracy (not throughput)
+    # gates — panel-mean champion out-of-sample error on the pinned
+    # synthetic demo panel, higher-is-regression; tolerated-absent in
+    # rounds that predate the tier (no fabricated zeros)
+    bt = headline.get("backtest_demo")
+    if isinstance(bt, dict):
+        for key, name in (("champion_smape", "backtest_champion_smape"),
+                          ("champion_mase", "backtest_champion_mase")):
+            v = bt.get(key)
+            if isinstance(v, (int, float)):
+                out[name] = float(v)
     m = headline.get("metrics")
     if isinstance(m, dict):
         spans = m.get("spans")
