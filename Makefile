@@ -15,18 +15,26 @@ STS_COMPILE_CACHE ?=
 
 .PHONY: help verify compileall tier1 verify-faults verify-durability \
 	verify-perf verify-serving verify-long verify-telemetry verify-fleet \
-	verify-backtest gate trace lint lint-baseline contracts verify-static \
-	warmup
+	verify-backtest verify-races gate trace lint lint-baseline contracts \
+	verify-static jax-audit warmup
 
 help:
 	@echo "Targets:"
 	@echo "  verify        byte-compile + sts-lint + tier-1 test sweep"
 	@echo "  warmup        precompile fit executables at bench shapes (WARMUP_FAMILIES/"
 	@echo "                WARMUP_SHAPES; set STS_COMPILE_CACHE=dir to persist across processes)"
-	@echo "  lint          sts-lint static analysis (tracer safety, dtype, recompiles)"
+	@echo "  lint          sts-lint static analysis (tracer safety, dtype, recompiles,"
+	@echo "                lock discipline STS101-STS104)"
 	@echo "  lint-baseline regenerate tools/sts_lint/baseline.json (the debt ledger)"
-	@echo "  contracts     jaxpr/HLO contract checks for all ten fit families"
-	@echo "  verify-static lint + contracts (the full static-analysis gate)"
+	@echo "  contracts     jaxpr/HLO contract checks: ten fit families + the serving"
+	@echo "                update, long-combine, fleet pump, backtest metric kernel,"
+	@echo "                and pinned-state-path programs"
+	@echo "  verify-races  runtime race harness: seeded deterministic scheduler, racy"
+	@echo "                fixture trip, known-hot pairs (scrape vs inc, watchdog vs"
+	@echo "                materialize, fleet pump vs scrape, journal vs flightrec)"
+	@echo "  verify-static lint + contracts + verify-races (the full static-analysis gate)"
+	@echo "  jax-audit     inventory version-sensitive JAX API touchpoints (monitoring,"
+	@echo "                profiler, compilation cache, shard_map, pallas) pre-upgrade"
 	@echo "  verify-faults tier-1 sweep with STS_FAULT_INJECT=1 (retry/fallback paths forced),"
 	@echo "                plus the verify-durability subset and the serving suite under"
 	@echo "                the serving-tier fault modes (tick corruption, state poison)"
@@ -60,12 +68,30 @@ lint:
 lint-baseline:
 	$(PY) -m tools.sts_lint spark_timeseries_tpu --write-baseline
 
-# Level 2: trace + lower every fit family from ShapeDtypeStructs and
-# assert the no-f64 / no-host-callback / stable-jaxpr contracts.
+# Level 2: trace + lower every fit family — plus the serving update,
+# longseries combine, fleet coalesced pump, backtest metric kernel, and
+# pinned-state-path programs — from ShapeDtypeStructs and assert the
+# no-f64 / no-host-callback / stable-jaxpr contracts (45 checks).
 contracts:
 	JAX_PLATFORMS=cpu $(PY) -m spark_timeseries_tpu.utils.contracts
 
-verify-static: lint contracts
+# Level 2 of the concurrency tier (ISSUE 14): the `races`-marked suite —
+# seeded-schedule determinism, the racy fixture the adversarial
+# scheduler provably trips, the runtime lock-order graph (acyclic across
+# the known-hot pairs: scrape vs inc, watchdog expiry vs materialize,
+# fleet pump vs scrape, journal commit vs flight-recorder read), and the
+# warmed-tick 0-recompile pin with every lock in the process wrapped.
+verify-races:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m races \
+		--continue-on-collection-errors -p no:cacheprovider \
+		-p no:xdist -p no:randomly
+
+verify-static: lint contracts verify-races
+
+# static inventory of version-sensitive JAX API touchpoints — ROADMAP
+# item 2 requires this audit before the JAX upgrade refactor lands.
+jax-audit:
+	$(PY) -m tools.jax_audit spark_timeseries_tpu
 
 # precompile the default fit families at the bench chunk shapes through
 # the streaming engine's AOT executable cache; with STS_COMPILE_CACHE set

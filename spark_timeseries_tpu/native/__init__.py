@@ -71,21 +71,37 @@ def fastcsv() -> Optional[ctypes.CDLL]:
     with _lock:
         if "fastcsv" in _cached:
             return _cached["fastcsv"]
-        lib = None
-        so = _build(_SRC, "fastcsv")
-        if so is not None:
-            try:
-                lib = ctypes.CDLL(so)
-                LL = ctypes.c_longlong
-                lib.sts_format_csv.restype = LL
-                lib.sts_format_csv.argtypes = [
-                    ctypes.c_char_p, LL, ctypes.c_void_p, LL, LL,
-                    ctypes.c_void_p]
-                lib.sts_parse_csv.restype = LL
-                lib.sts_parse_csv.argtypes = [
-                    ctypes.c_char_p, LL, LL, LL, ctypes.c_void_p,
-                    ctypes.c_void_p, ctypes.POINTER(LL)]
-            except Exception:             # noqa: BLE001
-                lib = None
-        _cached["fastcsv"] = lib
-        return lib
+    # build OUTSIDE the lock (STS103): _build runs g++ for up to 120s,
+    # and holding _lock across it would stall every thread that merely
+    # wants the (possibly None) handle.  A duplicate concurrent build is
+    # harmless — racing builders agree via the atomic rename — and the
+    # publish below prefers a non-None result, the same
+    # compile-outside-the-lock idiom as the fit engine's executable cache
+    lib = None
+    so = _build(_SRC, "fastcsv")
+    if so is not None:
+        try:
+            lib = ctypes.CDLL(so)
+            LL = ctypes.c_longlong
+            lib.sts_format_csv.restype = LL
+            lib.sts_format_csv.argtypes = [
+                ctypes.c_char_p, LL, ctypes.c_void_p, LL, LL,
+                ctypes.c_void_p]
+            lib.sts_parse_csv.restype = LL
+            lib.sts_parse_csv.argtypes = [
+                ctypes.c_char_p, LL, LL, LL, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.POINTER(LL)]
+        except Exception:             # noqa: BLE001
+            lib = None
+    return _publish(lib)
+
+
+def _publish(lib: Optional[ctypes.CDLL]) -> Optional[ctypes.CDLL]:
+    """First NON-None result wins: a racing builder whose g++ timed out
+    (lib=None) must not pin the failure over a concurrent success.  A
+    lone failure still caches None, so a toolchain-less host pays one
+    build attempt per process, not one per call."""
+    with _lock:
+        if _cached.get("fastcsv") is None:
+            _cached["fastcsv"] = lib
+        return _cached["fastcsv"]

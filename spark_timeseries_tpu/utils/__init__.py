@@ -12,10 +12,10 @@ and the compiled-program cost/memory analysis tier (``costs``).
 """
 
 from . import (checkpoint, costs, metrics, observability,  # noqa: F401
-               resilience, tracing)
+               races, resilience, tracing)
 
 __all__ = ["checkpoint", "costs", "metrics", "observability", "plot",
-           "resilience", "tracing"]
+           "races", "resilience", "tracing"]
 
 
 def __getattr__(name):

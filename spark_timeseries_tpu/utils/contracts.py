@@ -3,10 +3,13 @@
 ``tools/sts_lint`` (level 1) reads the *source*; this module checks what
 actually **lowers** — the ARIMA_PLUS lesson (PAPERS.md) that plan-time
 validation beats runtime failure, applied to XLA instead of a query
-planner.  Each of the ten fit families is traced and lowered from
-``jax.ShapeDtypeStruct`` specs (the ``utils.costs.representative_fit``
-path — shapes only, no data, no fitting) and three machine-checkable
-contracts are asserted:
+planner.  Each family of the compiled surface — the ten fit families
+plus the program tier (the health-monitored serving update, the
+longseries combiner, the fleet coalesced pump, the backtest metric
+kernel, and the pinned-gain replay primitive ``pinned_state_path``) —
+is traced and lowered from ``jax.ShapeDtypeStruct`` specs (the
+``utils.costs.representative_fit`` path — shapes only, no data, no
+fitting) and three machine-checkable contracts are asserted:
 
 - **no-f64** — under the default x64-off config, no operation in the
   jaxpr produces (or converts to) ``float64``/``complex128``.  Trivially
@@ -49,7 +52,8 @@ __all__ = ["pad_bucket", "jaxpr_fingerprint", "trace_family",
            "check_jaxpr_stability", "check_family", "check_all",
            "ContractResult", "CONTRACT_FAMILIES"]
 
-# the same ten families utils.costs knows how to lower
+# the same families utils.costs knows how to lower (ten fits + the
+# serving/long/fleet/backtest/replay program tier)
 from .costs import COST_FAMILIES as CONTRACT_FAMILIES  # noqa: E402
 
 # padding-bucket policy: defined by the streaming fit engine (its hot

@@ -48,11 +48,21 @@ N_XREG = 2
 
 COST_FAMILIES = ("arima", "arimax", "ar", "arx", "ewma", "garch",
                  "argarch", "egarch", "holt_winters", "regression_arima",
-                 "serving_update", "long_combine")
+                 "serving_update", "long_combine", "fleet_pump",
+                 "backtest_metrics", "pinned_state_path")
 
 # the long_combine representative's statics: ARIMA(2,?,2) segment
 # estimates mapped into a 12-term AR truncation — the fit_long defaults
 LONG_COMBINE_N_AR = 12
+
+# the fleet_pump representative's group size: 3 tenants coalesce into a
+# power-of-two slot pad of 4 (fleet._slots_for), so the pump program is
+# the monitored update at 4x the per-tenant bucket width
+FLEET_PUMP_TENANTS = 3
+
+# the backtest_metrics representative's statics: the default smape/mase
+# scoring horizons of a horizon-4 table
+BACKTEST_METRIC_HORIZONS = (1, 4)
 
 
 def _long_combine_representative(n_series: int, n_obs: int,
@@ -124,6 +134,75 @@ def _serving_update_representative(n_series: int,
     return update, args
 
 
+def _fleet_pump_representative(n_series: int,
+                               dtype) -> Tuple[Callable, Tuple]:
+    """The fleet scheduler's coalesced pump program: one group of
+    :data:`FLEET_PUMP_TENANTS` same-key tenants gathered lane-wise and
+    run through the SAME jitted monitored update the sessions run solo
+    (``fleet.FleetScheduler._dispatch_group``), so the device program is
+    ``_update_impl`` at the power-of-two slot width.  Contract-checking
+    it at coalesced width proves the pump path — not just the solo
+    session path — stays f64-free, callback-free, and trace-stable."""
+    from ..statespace.fleet import _slots_for
+
+    return _serving_update_representative(
+        _slots_for(FLEET_PUMP_TENANTS) * n_series, dtype)
+
+
+def _backtest_metrics_representative(n_series: int, n_obs: int,
+                                     dtype) -> Tuple[Callable, Tuple]:
+    """The backtest tier's one jitted NaN-masked metric kernel
+    (``backtest.evaluate._metric_tables_fn``): per-(S,H) sMAPE/MASE/
+    RMSE/coverage tables plus per-origin score vectors over an
+    ``(S, O, H)`` forecast block.  ``n_obs`` maps to the origin count
+    (``O = n_obs // 8``) so the stable-jaxpr bucket pair lands on one
+    origin geometry."""
+    import jax
+
+    from ..backtest.evaluate import _metric_tables_fn
+
+    horizon = max(BACKTEST_METRIC_HORIZONS)
+    n_origins = max(n_obs // 8, 2)
+    blk = jax.ShapeDtypeStruct((n_series, n_origins, horizon), dtype)
+    half = jax.ShapeDtypeStruct((n_series, horizon), dtype)
+    scale = jax.ShapeDtypeStruct((n_series,), dtype)
+
+    def kernel(fcst, actual, hw, sc):
+        return _metric_tables_fn(fcst, actual, hw, sc,
+                                 BACKTEST_METRIC_HORIZONS)
+
+    return kernel, (blk, blk, half, scale)
+
+
+def _pinned_state_path_representative(n_series: int, n_obs: int,
+                                      dtype) -> Tuple[Callable, Tuple]:
+    """The backtest/longseries replay primitive
+    (``statespace.kalman.pinned_state_path``): every predicted state
+    along the series under a pinned per-lane gain via
+    ``affine_recurrence`` (O(log n) depth), at the ARIMA(2,1,2) state
+    dimension the demo grids exercise."""
+    import jax
+
+    from ..statespace.kalman import pinned_state_path
+    from ..statespace.ssm import StateSpace
+
+    m = 3
+    s = n_series
+
+    def sd(*shape):
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    args = (sd(s, m, m), sd(s, m), sd(s, m), sd(s), sd(s),
+            sd(s, m, m), sd(s, m),                   # StateSpace leaves
+            sd(s, m), sd(s, n_obs), sd(s, m))        # x0, ys, K
+
+    def path(*leaves):
+        ssm = StateSpace(*leaves[:7])
+        return pinned_state_path(ssm, leaves[7], leaves[8], leaves[9])
+
+    return path, args
+
+
 def representative_fit(family: str, n_series: int, n_obs: int,
                        dtype=None) -> Tuple[Callable, Tuple]:
     """A representative batched fit closure + abstract args for one
@@ -170,18 +249,31 @@ def representative_fit(family: str, n_series: int, n_obs: int,
             lambda ts, xr: m.regression_arima.fit(
                 ts, xr, "cochrane-orcutt"), (v, x)),
     }
-    if family == "serving_update":
-        # built only on request: the classic families' reports must not
-        # depend on the statespace package importing
-        fit_fn, args = _serving_update_representative(n_series, dtype)
-    elif family == "long_combine":
-        fit_fn, args = _long_combine_representative(n_series, n_obs, dtype)
+    # the program-tier families are built only on request: the classic
+    # families' reports must not depend on the statespace/backtest
+    # packages importing
+    program_tier = {
+        "serving_update":
+            lambda: _serving_update_representative(n_series, dtype),
+        "long_combine":
+            lambda: _long_combine_representative(n_series, n_obs, dtype),
+        "fleet_pump":
+            lambda: _fleet_pump_representative(n_series, dtype),
+        "backtest_metrics":
+            lambda: _backtest_metrics_representative(n_series, n_obs,
+                                                     dtype),
+        "pinned_state_path":
+            lambda: _pinned_state_path_representative(n_series, n_obs,
+                                                      dtype),
+    }
+    if family in program_tier:
+        fit_fn, args = program_tier[family]()
     elif family in table:
         fit_fn, args = table[family]
     else:
         raise ValueError(
             f"unknown model family {family!r}; expected one of "
-            f"{sorted(table) + ['serving_update', 'long_combine']}")
+            f"{sorted(table) + sorted(program_tier)}")
     return arrays_only(fit_fn), args
 
 

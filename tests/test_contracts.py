@@ -22,6 +22,12 @@ from spark_timeseries_tpu.utils import contracts
 FAST_FAMILIES = ("arima", "arimax", "ar", "arx", "ewma", "holt_winters",
                  "regression_arima")
 SLOW_FAMILIES = ("garch", "argarch", "egarch")
+# the compiled-program tier (ISSUE 14 widened the sweep to the whole
+# compiled surface): serving update + longseries combine landed earlier;
+# fleet coalesced pump, backtest metric kernel, and pinned_state_path
+# are the post-PR-8 programs
+PROGRAM_FAMILIES = ("serving_update", "long_combine", "fleet_pump",
+                    "backtest_metrics", "pinned_state_path")
 
 
 def _assert_all_ok(results):
@@ -164,6 +170,24 @@ def test_contracts_hold_slow(family):
     from jax.experimental import disable_x64
     with disable_x64():
         _assert_all_ok(contracts.check_family(family))
+
+
+@pytest.mark.parametrize("family", PROGRAM_FAMILIES)
+def test_contracts_hold_program_tier(family):
+    """The whole compiled surface, not just the fit families: the
+    serving/fleet tick program, the longseries combiner, the backtest
+    metric kernel, and the pinned-gain replay primitive all hold the
+    same three contracts (ISSUE 14 acceptance: sweep >= 42 checks)."""
+    from jax.experimental import disable_x64
+    with disable_x64():
+        _assert_all_ok(contracts.check_family(family))
+
+
+def test_sweep_covers_the_whole_compiled_surface():
+    fams = set(contracts.CONTRACT_FAMILIES)
+    assert set(PROGRAM_FAMILIES) <= fams
+    # 3 contracts per family; the acceptance floor is 42
+    assert 3 * len(fams) >= 42
 
 
 def test_check_all_summary_schema():

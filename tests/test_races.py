@@ -1,0 +1,461 @@
+"""Runtime race harness (ISSUE 14 level 2) + the known-hot pairs.
+
+What is pinned here:
+
+- **seeded-schedule determinism**: the adversarial scheduler's decision
+  trace is a pure function of (seed, thread programs) — same seed, same
+  interleaving, byte for byte;
+- a **deliberately racy fixture** (unlocked read-modify-write around a
+  yield point) is *provably* tripped by the scheduler — lost updates on
+  every tried seed — while its lock-guarded twin never loses one;
+- the **lock-order graph actually exercised** is recorded and acyclic
+  across the tree's known-hot concurrent pairs (the runtime cross-check
+  of sts-lint STS102): concurrent scrape vs ``inc()``, watchdog expiry
+  vs chunk materialize, fleet pump vs telemetry scrape, journal commit
+  vs flight-recorder read;
+- the **warmed-tick 0-recompile pin re-asserted with instrumentation
+  armed** — wrapping every lock in the process must not leak a compile
+  into the serving hot path;
+- the native build-outside-lock fix (the one real STS103 finding on the
+  shipped tree) stays fixed.
+
+Fast harness-unit cases run in tier-1; the jax-heavy pairs are ``slow``
+and run via ``make verify-races`` (the ``races`` marker).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu.utils import metrics, races
+
+pytestmark = pytest.mark.races
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# deadline knobs shared with test_durability (STS_TEST_DEADLINE_S=2
+# widens margins in slow containers)
+_TEST_DEADLINE_S = float(os.environ.get("STS_TEST_DEADLINE_S", "0.25"))
+_TEST_HANG_S = max(8.0 * _TEST_DEADLINE_S, 1.0)
+
+SEEDS = range(6)
+
+
+# ---------------------------------------------------------------------------
+# scheduler determinism
+# ---------------------------------------------------------------------------
+
+def _locked_increments(seed):
+    with races.instrument(seed=seed) as h:
+        counter = {"v": 0}
+        lock = threading.Lock()
+
+        def worker():
+            for _ in range(5):
+                with lock:
+                    counter["v"] += 1
+                races.yield_point()
+
+        h.spawn(worker, label="a")
+        h.spawn(worker, label="b")
+        h.join_all()
+        h.raise_errors()
+        return h.schedule_trace, counter["v"]
+
+
+def test_same_seed_same_interleaving():
+    t1, v1 = _locked_increments(7)
+    t2, v2 = _locked_increments(7)
+    assert t1 == t2, "same seed must replay the same schedule"
+    assert v1 == v2 == 10
+    assert len(t1) > 10          # the schedule actually interleaved
+
+
+def test_different_seeds_explore_different_interleavings():
+    traces = {tuple(_locked_increments(s)[0]) for s in SEEDS}
+    assert len(traces) > 1, \
+        "six seeds produced one interleaving — the RNG is not wired in"
+
+
+# ---------------------------------------------------------------------------
+# the racy fixture the harness must provably trip
+# ---------------------------------------------------------------------------
+
+class RacyCounter:
+    """Textbook check-then-act: read, yield, write.  Unlocked."""
+
+    def __init__(self):
+        self.value = 0
+
+    def bump(self):
+        v = self.value
+        races.yield_point()
+        self.value = v + 1
+
+
+class LockedCounter:
+    def __init__(self):
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def bump(self):
+        with self._lock:
+            v = self.value
+            races.yield_point()
+            self.value = v + 1
+
+
+def _drive(counter_cls, seed, per_thread=4):
+    with races.instrument(seed=seed) as h:
+        c = counter_cls()
+        if hasattr(c, "_lock"):
+            c._lock = h.wrap("fixture.lock", c._lock)
+
+        def w():
+            for _ in range(per_thread):
+                c.bump()
+
+        h.spawn(w, label="a")
+        h.spawn(w, label="b")
+        h.join_all()
+        h.raise_errors()
+        return c.value
+
+
+def test_racy_fixture_provably_trips():
+    racy = {s: _drive(RacyCounter, s) for s in SEEDS}
+    assert any(v < 8 for v in racy.values()), \
+        f"no seed lost an update on the racy fixture: {racy}"
+
+
+def test_locked_fixture_never_trips():
+    locked = {s: _drive(LockedCounter, s) for s in SEEDS}
+    assert all(v == 8 for v in locked.values()), locked
+
+
+def test_stall_at_post_acquire_boundary_releases_lock(monkeypatch):
+    """A SchedulerStall raised at the post-acquire boundary must unwind
+    the just-taken inner lock: the wrapper is removed when instrument()
+    exits, and a still-held inner lock would deadlock the rest of the
+    process — a silent hang masking the named stall."""
+    with races.instrument(seed=0) as h:
+        traced = threading.Lock()        # TracedLock via the factory
+        sched = h.scheduler
+        monkeypatch.setattr(sched, "participating", lambda: True)
+
+        def stalling_boundary(what):
+            if what.startswith("acquire:"):
+                raise races.SchedulerStall("injected")
+
+        monkeypatch.setattr(sched, "boundary", stalling_boundary)
+        with pytest.raises(races.SchedulerStall):
+            traced.acquire()
+        assert traced._inner.acquire(False), "inner lock leaked by stall"
+        traced._inner.release()
+
+
+def test_scheduler_stall_is_named():
+    # a scheduled thread blocking on something the scheduler cannot see
+    # must surface as SchedulerStall, not a silent hang (bounded by the
+    # per-run stall_timeout_s knob)
+    with races.instrument(seed=0, stall_timeout_s=1.0) as h:
+        gate = races._REAL_LOCK()
+        gate.acquire()            # never released, invisible to the
+        #                           scheduler (raw lock, not traced)
+
+        def stuck():
+            gate.acquire()
+
+        def fine():
+            races.yield_point()
+
+        h.spawn(stuck, label="stuck")
+        h.spawn(fine, label="fine")
+        h.start_all()
+        time.sleep(0.1)
+        for t in list(h._threads):
+            t.join(5.0)
+        assert h.errors and isinstance(h.errors[0], races.SchedulerStall)
+        assert "stall_timeout_s" in str(h.errors[0])
+        gate.release()
+
+
+# ---------------------------------------------------------------------------
+# recording: order graph, cycles, restoration
+# ---------------------------------------------------------------------------
+
+def test_order_graph_records_nesting_and_detects_cycles():
+    with races.instrument() as h:
+        l1 = threading.Lock()
+        l2 = threading.Lock()
+        with l1:
+            with l2:
+                pass
+        g = h.order_graph()
+        assert any(g[a] for a in g), "nested acquisition recorded no edge"
+        h.assert_acyclic()
+    with races.instrument() as h:
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert h.cycles(), "ABBA order not detected at runtime"
+        with pytest.raises(AssertionError, match="cycle"):
+            h.assert_acyclic()
+
+
+def test_factories_and_known_locks_restored():
+    from spark_timeseries_tpu.utils import telemetry
+    before = telemetry._jobs_lock
+    with races.instrument() as h:
+        assert isinstance(telemetry._jobs_lock, races.TracedLock)
+        assert threading.Lock is not races._REAL_LOCK
+        lock = threading.Lock()
+    assert telemetry._jobs_lock is before
+    assert threading.Lock is races._REAL_LOCK
+    assert threading.RLock is races._REAL_RLOCK
+    assert threading.Thread.start is races._REAL_THREAD_START
+    # a traced lock that outlives the block degrades to passthrough
+    with lock:
+        pass
+    assert not h.active
+
+
+def test_instrument_blocks_do_not_nest():
+    with races.instrument():
+        with pytest.raises(RuntimeError, match="nest"):
+            with races.instrument():
+                pass
+
+
+def test_registry_lock_wrapped_in_place():
+    reg = metrics.get_registry()
+    inner = reg._lock
+    with races.instrument() as h:
+        assert isinstance(reg._lock, races.TracedLock)
+        reg.inc("races.test.wrap_probe")
+        assert any(name == "metrics.registry"
+                   for _t, _op, name in h.events)
+    assert reg._lock is inner
+
+
+# ---------------------------------------------------------------------------
+# hot pair 1: concurrent scrape vs inc() (scheduled, deterministic)
+# ---------------------------------------------------------------------------
+
+def test_scrape_vs_inc_under_adversarial_schedule():
+    reg = metrics.get_registry()
+    name = "races.test.scrape_vs_inc"
+    with races.instrument(seed=3) as h:
+        seen = []
+
+        def writer():
+            for _ in range(30):
+                reg.inc(name)
+
+        def scraper():
+            for _ in range(6):
+                snap = reg.snapshot()
+                seen.append(snap["counters"].get(name, 0))
+                reg.to_prometheus()
+
+        h.spawn(writer, label="writer")
+        h.spawn(scraper, label="scraper")
+        h.join_all()
+        h.raise_errors()
+        h.assert_acyclic()
+    final = reg.snapshot()["counters"][name]
+    assert final >= 30           # no lost increments, ever
+    assert seen == sorted(seen), \
+        f"scrapes observed a counter going backwards: {seen}"
+
+
+# ---------------------------------------------------------------------------
+# hot pair 2: watchdog expiry vs chunk materialize (slow, real threads)
+# ---------------------------------------------------------------------------
+
+def _ar_panel(n_series, n_obs, seed=0):
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=(n_series, n_obs)).astype(np.float32)
+    y = np.zeros((n_series, n_obs), np.float32)
+    for t in range(1, n_obs):
+        y[:, t] = 0.6 * y[:, t - 1] + e[:, t]
+    return y
+
+
+@pytest.mark.slow
+def test_watchdog_expiry_vs_materialize_instrumented():
+    from spark_timeseries_tpu import engine as E
+    from spark_timeseries_tpu.utils import resilience as res
+
+    v = _ar_panel(64, 48, seed=5)
+    eng = E.FitEngine()
+    # precompile so the tight deadline races only the injected hang
+    eng.warmup(("ar",), [(32, 48)], dtype=np.float32,
+               variants=("dense",), bucket=False, max_lag=2)
+    with races.instrument() as h:
+        with res.fault_injection("hang_chunk", chunk_index=0,
+                                 hang_s=_TEST_HANG_S):
+            out = eng.stream_fit(v, "ar", chunk_size=32, max_lag=2,
+                                 deadline_s=_TEST_DEADLINE_S, retry=0)
+        h.assert_acyclic()
+        assert any(op == "spawn" for _t, op, _n in h.events), \
+            "watchdog worker spawn not recorded"
+    assert out.stats["dead_chunks"] == 1
+    assert out.chunk_failures[0]["kind"] == "deadline"
+    assert out.n_fitted == 32    # the other chunk survived the expiry
+    # don't leak the abandoned hung worker into later tests
+    for t in threading.enumerate():
+        if t.name.startswith("sts-chunk-"):
+            t.join(_TEST_HANG_S + 30.0)
+
+
+# ---------------------------------------------------------------------------
+# hot pair 3: fleet pump vs telemetry scrape (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_fleet_pump_vs_scrape_instrumented():
+    import jax.numpy as jnp
+
+    from spark_timeseries_tpu import statespace as ss
+    from spark_timeseries_tpu.models import arima
+    from spark_timeseries_tpu.statespace.fleet import FleetScheduler
+    from spark_timeseries_tpu.utils import telemetry
+
+    hists = [_ar_panel(4, 120, seed=10 + i) for i in range(2)]
+    models = [arima.fit(1, 0, 0, jnp.asarray(hh), warn=False)
+              for hh in hists]
+    sched = FleetScheduler(auto_pump=False)
+    for i, (m, hh) in enumerate(zip(models, hists)):
+        sched.attach(ss.ServingSession.start(m, hh, label=f"rt{i}"))
+    sched.warmup()
+    ticks = _ar_panel(4, 8, seed=99)
+    with races.instrument() as h:
+        stop = {"flag": False}
+
+        def scraper():
+            while not stop["flag"]:
+                telemetry.snapshot_doc()
+                telemetry.fleet_summaries()
+
+        t = h.spawn(scraper, label="scraper")
+        for k in range(8):
+            for lbl in sched.tenants:
+                sched.submit(lbl, ticks[:, k])
+            sched.pump(force=True)
+        stop["flag"] = True
+        t.join(30.0)
+        h.raise_errors()
+        h.assert_acyclic()
+    assert sched.stats()["tenants"] == 2
+
+
+# ---------------------------------------------------------------------------
+# hot pair 4: journal commit vs flight-recorder read (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_journal_commit_vs_flightrec_read_instrumented(tmp_path,
+                                                       monkeypatch):
+    from spark_timeseries_tpu import engine as E
+    from spark_timeseries_tpu.utils import flightrec, telemetry
+
+    monkeypatch.setenv("STS_INCIDENT_DIR", str(tmp_path / "incidents"))
+    v = _ar_panel(96, 48, seed=6)
+    journal = str(tmp_path / "journal")
+    with races.instrument() as h:
+        stop = {"flag": False}
+
+        def reader():
+            while not stop["flag"]:
+                flightrec.list_incidents(limit=4)
+                telemetry.snapshot_doc()
+
+        t = h.spawn(reader, label="reader")
+        out = E.FitEngine().stream_fit(v, "ar", chunk_size=32,
+                                       max_lag=2, journal=journal)
+        stop["flag"] = True
+        t.join(30.0)
+        h.raise_errors()
+        h.assert_acyclic()
+    assert out.n_fitted == 96
+    assert out.stats["journal_commits"] == 3
+
+
+# ---------------------------------------------------------------------------
+# the warmed-tick 0-recompile pin, instrumentation armed
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_warmed_tick_zero_recompiles_with_instrumentation():
+    import jax.numpy as jnp
+
+    from spark_timeseries_tpu import statespace as ss
+    from spark_timeseries_tpu.models import arima
+
+    metrics.install_jax_hooks()
+    panel = _ar_panel(4, 60, seed=41)
+    model = arima.fit(1, 0, 1, jnp.asarray(panel), warn=False)
+    sess = ss.ServingSession.start(model, panel)
+    sess.warmup()
+    before = metrics.jax_stats()["jit_compiles"]
+    with races.instrument() as h:
+        for t in range(5):
+            sess.update(panel[:, t])
+        h.assert_acyclic()
+    after = metrics.jax_stats()["jit_compiles"]
+    assert after - before == 0, \
+        f"{after - before} compiles leaked into the instrumented tick path"
+
+
+# ---------------------------------------------------------------------------
+# regression: the one real STS103 finding on the shipped tree
+# ---------------------------------------------------------------------------
+
+def test_native_build_runs_outside_lock(monkeypatch):
+    """native.fastcsv() used to hold the module lock across _build()
+    (a g++ subprocess, up to 120s): every thread wanting the handle
+    stalled behind the compile.  Pinned: the build runs unlocked, the
+    result is still published exactly once."""
+    from spark_timeseries_tpu import native
+
+    monkeypatch.delenv("STS_NO_NATIVE", raising=False)
+    monkeypatch.setattr(native, "_cached", {})
+    observed = {}
+
+    def fake_build(src, tag):
+        observed["locked_during_build"] = native._lock.locked()
+        return None
+
+    monkeypatch.setattr(native, "_build", fake_build)
+    assert native.fastcsv() is None
+    assert observed["locked_during_build"] is False
+
+    def boom(src, tag):
+        raise AssertionError("rebuilt despite cache")
+
+    monkeypatch.setattr(native, "_build", boom)
+    assert native.fastcsv() is None      # second call: cached, no build
+
+
+def test_native_publish_prefers_nonnull_result(monkeypatch):
+    """Racing builders: a timed-out build (None) must never pin the
+    failure over a concurrent success, while a lone failure still
+    caches (one build attempt per process on toolchain-less hosts)."""
+    from spark_timeseries_tpu import native
+
+    monkeypatch.setattr(native, "_cached", {})
+    sentinel = object()
+    assert native._publish(None) is None          # failure caches...
+    assert native._publish(sentinel) is sentinel  # ...success upgrades
+    assert native._publish(None) is sentinel      # later failure loses
+    assert native._publish(object()) is sentinel  # first success sticks
+    assert native._cached["fastcsv"] is sentinel

@@ -160,6 +160,8 @@ METRICS = [
     ("fleet_shed_lanes", "lower_better", 50.0),
     ("backtest_champion_smape", "lower_better", 25.0),
     ("backtest_champion_mase", "lower_better", 25.0),
+    ("lint_findings", "lower_better", 50.0),
+    ("contracts_failed", "lower_better", 50.0),
 ]
 
 
@@ -341,6 +343,27 @@ def extract_metrics(headline: Optional[dict]) -> Dict[str, float]:
             v = tel.get("incidents_written", 0)
             if isinstance(v, (int, float)):
                 out["incidents_written"] = float(v)
+        # static-analysis gates (ISSUE 14), zero-baselined in the house
+        # style: the static_analysis block landed in PR 4 and is
+        # embedded in every record since — block present with the
+        # findings key absent means lint ran clean (bench only records
+        # error keys on failure), a measured 0.  Two non-measurements
+        # must NOT read as clean zeros: a lint_error/contracts_error
+        # key (the sub-check CRASHED) and contracts_checked == 0 (the
+        # sweep was skipped via BENCH_CONTRACT_FAMILIES="" — bench
+        # writes 0/0 then, which is absence of evidence, not evidence).
+        sa = m.get("static_analysis")
+        if isinstance(sa, dict):
+            if "lint_error" not in sa:
+                v = sa.get("findings", 0)
+                if isinstance(v, (int, float)):
+                    out["lint_findings"] = float(v)
+            checked = sa.get("contracts_checked", 0)
+            if "contracts_error" not in sa \
+                    and isinstance(checked, (int, float)) and checked > 0:
+                v = sa.get("contracts_failed", 0)
+                if isinstance(v, (int, float)):
+                    out["contracts_failed"] = float(v)
     return out
 
 

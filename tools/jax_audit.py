@@ -1,0 +1,212 @@
+"""Static inventory of version-sensitive JAX API touchpoints.
+
+ROADMAP item 2 (the multi-device tier) is gated on a JAX upgrade, and
+its first instruction is *audit the version-sensitive touchpoints
+first*: the APIs this tree leans on that have moved, been renamed, or
+changed shape across recent JAX releases.  This tool is that audit,
+automated — a pure-AST scan (no JAX import required to run it) over the
+package that emits a machine-readable report of every site touching:
+
+====================  =====================================================
+category              what is matched
+====================  =====================================================
+``monitoring``        ``jax.monitoring.*`` (the PR 1 recompile/compile-
+                      seconds hooks — ``register_event_listener`` et al.
+                      have moved between ``jax.monitoring`` and internal
+                      modules across versions)
+``profiler``          ``jax.profiler.*`` incl. ``TraceAnnotation`` (the
+                      span forwarding in ``utils.metrics``)
+``compilation_cache`` ``jax_compilation_cache_dir`` config updates and
+                      ``jax.experimental.compilation_cache`` imports (the
+                      engine's persistent executable cache)
+``shard_map``         ``jax.shard_map`` / ``jax.experimental.shard_map``
+                      (dead on 0.4.37 pristine HEAD — the upgrade target)
+``pallas``            ``jax.experimental.pallas`` imports/uses
+                      (``ops/pallas_arma.py``)
+``experimental``      any other ``jax.experimental.*`` reference — the
+                      namespace with no stability promise at all
+====================  =====================================================
+
+Usage: ``python -m tools.jax_audit`` (or ``make jax-audit``); ``--json
+PATH`` writes the report (``-`` = stdout).  Exit code 0 always — this
+is an inventory, not a gate; the upgrade PR consumes it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence
+
+from .sts_lint.analysis import ModuleModel, canonical_tail
+from .sts_lint.engine import _iter_py_files
+
+CATEGORIES = ("monitoring", "profiler", "compilation_cache", "shard_map",
+              "pallas", "experimental")
+
+
+def _category(tail: str) -> Optional[str]:
+    if tail.startswith("jax.monitoring"):
+        return "monitoring"
+    if tail.startswith("jax.profiler"):
+        return "profiler"
+    if "compilation_cache" in tail:
+        return "compilation_cache"
+    if tail.startswith(("jax.shard_map", "jax.experimental.shard_map")):
+        return "shard_map"
+    if tail.startswith("jax.experimental.pallas"):
+        return "pallas"
+    if tail.startswith("jax.experimental."):
+        return "experimental"
+    return None
+
+
+def _enclosing_symbol(mod: ModuleModel, node: ast.AST) -> str:
+    best = ""
+    for fi in mod.functions:
+        n = fi.node
+        if hasattr(n, "lineno") and n.lineno <= node.lineno and (
+                getattr(n, "end_lineno", None) is None
+                or node.lineno <= n.end_lineno):
+            best = fi.qualname
+    return best
+
+
+def audit_module(mod: ModuleModel) -> List[Dict[str, Any]]:
+    """Touchpoint records for one module: canonical-name references
+    (through the import table), import statements, and config-string
+    constants (``jax.config.update("jax_compilation_cache_dir", ...)``)."""
+    hits: List[Dict[str, Any]] = []
+    seen = set()
+
+    def add(node: ast.AST, category: str, detail: str) -> None:
+        # one record per (line, category): ast.walk visits the outer
+        # (most specific) attribute chain before its bases, so the
+        # first hit is the fullest dotted path
+        key = (node.lineno, category)
+        if key in seen:
+            return
+        seen.add(key)
+        hits.append({
+            "category": category,
+            "path": mod.relpath,
+            "line": node.lineno,
+            "symbol": _enclosing_symbol(mod, node),
+            "detail": detail,
+        })
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom):
+            base = ("." * node.level) + (node.module or "")
+            for a in node.names:
+                canon = canonical_tail(f"{base}.{a.name}"
+                                       if base else a.name)
+                cat = _category(canon)
+                if cat:
+                    add(node, cat, f"from {base or '.'} import {a.name}")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                cat = _category(a.name)
+                if cat:
+                    add(node, cat, f"import {a.name}")
+        elif isinstance(node, ast.Attribute):
+            # bare Names (an aliased `pl`) are just uses of an import
+            # already recorded at its import site — only dotted chains
+            # carry API-shape information
+            canon = mod.resolve(node)
+            if canon is None:
+                continue
+            cat = _category(canonical_tail(canon))
+            if cat:
+                add(node, cat, canonical_tail(canon))
+        elif isinstance(node, ast.Constant) \
+                and isinstance(node.value, str) \
+                and node.value.startswith("jax_") \
+                and "cache" in node.value:
+            add(node, "compilation_cache", f"config key {node.value!r}")
+    return hits
+
+
+def audit_paths(paths: Sequence[str],
+                root: Optional[str] = None) -> Dict[str, Any]:
+    root = os.path.abspath(root or os.getcwd())
+    touchpoints: List[Dict[str, Any]] = []
+    parse_errors: List[str] = []
+    files = _iter_py_files(paths)
+    for path in files:
+        ap = os.path.abspath(path)
+        rel = os.path.relpath(ap, root).replace(os.sep, "/")
+        try:
+            source = open(ap, encoding="utf-8").read()
+            tree = ast.parse(source, filename=path)
+        except (OSError, SyntaxError) as e:
+            parse_errors.append(f"{rel}: {e}")
+            continue
+        touchpoints.extend(audit_module(ModuleModel(ap, rel, source,
+                                                    tree)))
+    touchpoints.sort(key=lambda t: (t["path"], t["line"], t["category"]))
+    counts = {c: 0 for c in CATEGORIES}
+    for t in touchpoints:
+        counts[t["category"]] += 1
+    jax_version = None
+    try:                         # report-only; never initializes jax
+        from importlib import metadata
+        jax_version = metadata.version("jax")
+    except Exception:  # noqa: BLE001 — version is informational
+        pass
+    return {
+        "version": 1,
+        "tool": "jax-audit",
+        "jax_version": jax_version,
+        "files_scanned": len(files),
+        "counts": counts,
+        "touchpoints": touchpoints,
+        "parse_errors": parse_errors,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.jax_audit",
+        description="Inventory version-sensitive JAX API touchpoints "
+                    "(monitoring, profiler, compilation cache, "
+                    "shard_map, pallas) ahead of a JAX upgrade.")
+    ap.add_argument("paths", nargs="*", default=["spark_timeseries_tpu"],
+                    help="files or directories to audit "
+                         "(default: spark_timeseries_tpu)")
+    ap.add_argument("--root", default=None,
+                    help="path touchpoints are reported relative to "
+                         "(default: cwd)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="write the JSON report here ('-' = stdout)")
+    args = ap.parse_args(argv)
+
+    report = audit_paths(args.paths, root=args.root)
+    human_out = sys.stderr if args.json_out == "-" else sys.stdout
+    for t in report["touchpoints"]:
+        where = f" [in {t['symbol']}]" if t["symbol"] else ""
+        print(f"{t['path']}:{t['line']}: {t['category']:<18s} "
+              f"{t['detail']}{where}", file=human_out)
+    for e in report["parse_errors"]:
+        print(f"PARSE ERROR: {e}", file=sys.stderr)
+    counts = ", ".join(f"{c}={n}" for c, n in report["counts"].items()
+                       if n)
+    print(f"jax-audit: {report['files_scanned']} files, "
+          f"{len(report['touchpoints'])} touchpoint(s) "
+          f"({counts or 'none'}); jax=={report['jax_version']}",
+          file=human_out)
+    if args.json_out:
+        payload = json.dumps(report, indent=1)
+        if args.json_out == "-":
+            print(payload)
+        else:
+            with open(args.json_out, "w", encoding="utf-8") as fh:
+                fh.write(payload + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,17 +18,40 @@ invariants the last three PRs only promised in prose:
 - ``STS006`` recompile hazards: ``jax.jit`` of a fresh lambda/closure
   per call (defeats the global jit cache — every call retraces).
 
+The STS100 series is the *concurrency* tier (ISSUE 14), built on a
+whole-tree model of which names are locks, which statements run holding
+them, and which functions run on threads:
+
+- ``STS101`` write to lock-guarded shared state (class attribute /
+  module global) outside the owning lock;
+- ``STS102`` cycle in the cross-module lock-acquisition-order graph
+  (potential ABBA deadlock);
+- ``STS103`` blocking call (``time.sleep``, I/O, device sync, user
+  callback) while holding a lock;
+- ``STS104`` thread-lifecycle hygiene (non-daemon thread never joined,
+  ``Event`` set without a waiter, thread target that can raise past its
+  outermost try).
+
+Level 2 of the concurrency tier is the *runtime* race harness
+(``spark_timeseries_tpu.utils.races``): instrumented locks record the
+acquisition-order graph actually exercised (cross-checking STS102) and
+a seeded deterministic scheduler adversarially permutes thread
+interleavings at instrumented boundaries (``make verify-races``).
+
 Suppression: append ``# sts: noqa[STS0xx]`` (or bare ``# sts: noqa``)
 to the offending line.  Known-and-accepted findings live in the
 checked-in baseline (``tools/sts_lint/baseline.json``); only *new*
-findings fail the build.  ``python -m tools.sts_lint --help`` for the
-CLI; ``make lint`` / ``make verify-static`` are the canonical entry
-points.
+findings fail the build — and the baseline is kept EMPTY for the
+tracer-safety and concurrency rules (those are fixed or suppressed
+in-source with a justification, never carried as debt).
+``python -m tools.sts_lint --help`` for the CLI; ``make lint`` /
+``make verify-static`` are the canonical entry points.
 """
 
 from .engine import (Finding, LintResult, lint_paths, load_baseline,
                      write_baseline, DEFAULT_BASELINE)
-from .rules import RULES
+from .rules import CONCURRENCY_RULES, RULES, TRACER_SAFETY_RULES
 
 __all__ = ["Finding", "LintResult", "lint_paths", "load_baseline",
-           "write_baseline", "DEFAULT_BASELINE", "RULES"]
+           "write_baseline", "DEFAULT_BASELINE", "RULES",
+           "TRACER_SAFETY_RULES", "CONCURRENCY_RULES"]

@@ -637,6 +637,683 @@ def _const_strings(node: ast.AST) -> List[str]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# concurrency model (the STS100-series substrate)
+# ---------------------------------------------------------------------------
+#
+# The STS0xx rules need "is this function traced?"; the STS1xx rules need
+# the host-side mirror image: which names are *locks*, which statements
+# run *holding* which locks, which functions run on *threads*, and what
+# the whole-tree lock-acquisition-order graph looks like.  Same modeling
+# stance as the tracer model above: misses under-report (a lock stored
+# in a dict, or an object whose type the model cannot see, is invisible),
+# and over-reporting is bounded by only ever reasoning about names the
+# inventory proved to be locks.
+
+_LOCK_FACTORY_TAILS = frozenset({
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "threading.BoundedSemaphore",
+})
+
+# container-mutating method names: a call `<state>.append(...)` mutates
+# the shared object just as surely as `<state>[k] = v`
+_MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "add", "pop", "popleft", "popitem", "update",
+    "clear", "extend", "extendleft", "remove", "discard", "insert",
+    "setdefault",
+})
+
+# calls that block the calling thread (directly); held locks make these
+# whole-process stalls
+_BLOCKING_CALL_TAILS = frozenset({
+    "time.sleep", "subprocess.run", "subprocess.call", "subprocess.Popen",
+    "subprocess.check_output", "subprocess.check_call",
+    "urllib.request.urlopen", "socket.create_connection", "os.fsync",
+    "input",
+})
+_BLOCKING_METHODS = frozenset({"block_until_ready", "wait", "join",
+                               "recv", "accept"})
+# ...except join on obvious string-building (", ".join(xs)) and wait on
+# the condition variable being held (Condition.wait RELEASES its lock)
+
+_HTTP_HANDLER_METHODS = frozenset({"do_GET", "do_POST", "do_PUT",
+                                   "do_DELETE", "do_HEAD", "handle"})
+
+
+def _modbase(mod: ModuleModel) -> str:
+    """Short module name.  Unlike the traced-function registry this
+    maps ``pkg/__init__.py`` to ``pkg`` — import tails resolve through
+    the package name (``from .native import _lock`` ends
+    ``native._lock``)."""
+    parts = mod.relpath.split("/")
+    name = parts[-1].removesuffix(".py")
+    if name == "__init__" and len(parts) >= 2:
+        return parts[-2]
+    return name
+
+
+def _modpath(mod: ModuleModel) -> str:
+    """Fully-qualified dotted module path (collision-free)."""
+    parts = mod.relpath.removesuffix(".py").split("/")
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _contains_lock_factory(mod: ModuleModel, value: ast.AST) -> bool:
+    for n in ast.walk(value):
+        if isinstance(n, ast.Call):
+            canon = mod.resolve(n.func)
+            if canon and canonical_tail(canon) in _LOCK_FACTORY_TAILS:
+                return True
+    return False
+
+
+def _self_attr_target(node: ast.AST) -> Optional[str]:
+    """The attribute name when ``node`` is a write target rooted at
+    ``self.<attr>`` (plain, subscripted, or nested-subscripted)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _global_target(node: ast.AST) -> Optional[str]:
+    """The base name when ``node`` is a subscript write target rooted at
+    a bare name (``_jobs[k] = v``)."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class MutationEvent:
+    """One write to shared state: a ``self.<attr>`` / module-global
+    assignment, subscript store, or mutator-method call."""
+
+    __slots__ = ("node", "fi", "kind", "name", "held", "how")
+
+    def __init__(self, node: ast.AST, fi: FuncInfo, kind: str, name: str,
+                 held: Tuple[str, ...], how: str):
+        self.node = node
+        self.fi = fi
+        self.kind = kind          # "attr" | "global"
+        self.name = name          # attr name / global name
+        self.held = held          # known lock ids held at the write
+        self.how = how            # "assign" | "subscript" | "mutator"
+
+
+class ThreadSpawn:
+    """One ``threading.Thread(...)`` construction site."""
+
+    __slots__ = ("node", "fi", "daemon", "target", "assigned", "joined")
+
+    def __init__(self, node: ast.Call, fi: FuncInfo):
+        self.node = node
+        self.fi = fi
+        self.daemon = False
+        self.target: Optional[FuncInfo] = None
+        self.assigned: Optional[str] = None
+        self.joined = False
+
+
+class ConcurrencyModel:
+    """Whole-tree lock/thread facts, computed once per lint run.
+
+    - ``module_locks``: ``(module basename, global name) -> lock id``;
+    - ``class_locks``: ``(module basename, class name) -> {attr}`` for
+      attributes ever assigned a ``threading`` lock/condition;
+    - per-function statement events annotated with the *known* locks
+      lexically held (``with`` regions only — bare ``.acquire()`` is
+      recorded as an acquisition but opens no region);
+    - the global acquisition-order graph (lock held -> lock acquired,
+      including one level through resolvable calls via transitive
+      per-function acquisition summaries);
+    - transitive blocking-call summaries;
+    - thread spawn sites, thread-entry functions (``Thread(target=...)``
+      plus HTTP-handler methods), and the functions reachable from them
+      through resolvable calls.
+    """
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.module_locks: Dict[Tuple[str, str], str] = {}
+        self.class_locks: Dict[Tuple[str, str], Set[str]] = {}
+        self.class_names: Dict[str, Set[str]] = {}
+        self.module_globals: Dict[str, Set[str]] = {}
+        self.events: Dict[FuncInfo, List[Tuple[ast.AST, Tuple[str, ...]]]] \
+            = {}
+        self.acquires: Dict[FuncInfo, Set[str]] = {}
+        self.acquires_tc: Dict[FuncInfo, Set[str]] = {}
+        self.blocking: Dict[FuncInfo, Optional[str]] = {}
+        self.blocking_tc: Dict[FuncInfo, Optional[str]] = {}
+        self.edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        self.spawns: List[ThreadSpawn] = []
+        self.thread_entries: Set[FuncInfo] = set()
+        self.thread_reachable: Set[FuncInfo] = set()
+        self.mutations: Dict[FuncInfo, List[MutationEvent]] = {}
+        self.event_objects: List[Tuple[ast.AST, FuncInfo, str]] = []
+        self._modkeys: Dict[int, str] = {}
+        self._assign_modkeys()
+        self._inventory()
+        self._walk_all()
+        self._close_summaries()
+        self._call_edges()
+        self._thread_closure()
+
+    # -- module keys --------------------------------------------------------
+
+    def _assign_modkeys(self) -> None:
+        """One unambiguous key per module.  A bare basename is readable
+        (``engine._jit_lock``) but two same-named modules in different
+        packages (``backtest/api.py`` vs ``longseries/api.py``) would
+        silently overwrite each other's inventory — colliding basenames
+        ALL demote to their last-two dotted segments (none keeps the
+        bare name, so a bare import tail can never resolve to the wrong
+        module), and a still-colliding pair falls back to the full
+        dotted path."""
+        by_base: Dict[str, List[ModuleModel]] = {}
+        for mod in self.project.modules:
+            by_base.setdefault(_modbase(mod), []).append(mod)
+        taken: Set[str] = set()
+        for base, mods in by_base.items():
+            if len(mods) == 1 and base not in taken:
+                self._modkeys[id(mods[0])] = base
+                taken.add(base)
+                continue
+            for mod in mods:
+                parts = _modpath(mod).split(".")
+                key = ".".join(parts[-2:])
+                if key in taken:
+                    key = _modpath(mod)
+                self._modkeys[id(mod)] = key
+                taken.add(key)
+
+    def modkey(self, mod: ModuleModel) -> str:
+        return self._modkeys[id(mod)]
+
+    # -- inventory ----------------------------------------------------------
+
+    def _inventory(self) -> None:
+        for mod in self.project.modules:
+            base = self.modkey(mod)
+            classes: Set[str] = {n.name for n in ast.walk(mod.tree)
+                                 if isinstance(n, ast.ClassDef)}
+            self.class_names[base] = classes
+            top: Set[str] = set()
+            for node in mod.tree.body:
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    targets = [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        top.add(t.id)
+                        if node.value is not None and \
+                                _contains_lock_factory(mod, node.value):
+                            self.module_locks[(base, t.id)] = \
+                                f"{base}.{t.id}"
+            self.module_globals[base] = top
+            for fi in mod.functions:
+                cls = self._class_of(fi)
+                if cls is None:
+                    continue
+                for node in iter_scope(fi.node):
+                    if not isinstance(node, ast.Assign) \
+                            or node.value is None:
+                        continue
+                    for t in node.targets:
+                        attr = _self_attr_target(t)
+                        if attr and not isinstance(t, ast.Subscript) \
+                                and _contains_lock_factory(mod, node.value):
+                            self.class_locks.setdefault(
+                                (base, cls), set()).add(attr)
+
+    def _class_of(self, fi: FuncInfo) -> Optional[str]:
+        """Class name for a method (qualname ``C.m``, first param
+        ``self``); None for plain functions and nested defs."""
+        if fi.parent is not None or not fi.params \
+                or fi.params[0] != "self":
+            return None
+        parts = fi.qualname.split(".")
+        if len(parts) != 2:
+            return None
+        cls = parts[0]
+        return cls if cls in self.class_names.get(self.modkey(fi.module),
+                                                  ()) else None
+
+    def lock_ids_of_class(self, mod: ModuleModel, cls: str) -> Set[str]:
+        base = self.modkey(mod)
+        return {f"{base}.{cls}.{a}"
+                for a in self.class_locks.get((base, cls), ())}
+
+    def resolve_lock(self, fi: FuncInfo, expr: ast.AST) -> Optional[str]:
+        """The inventory lock id a ``with`` item / ``.acquire()`` base
+        refers to, or None for anything the inventory doesn't know."""
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            cls = self.method_class(fi)
+            if cls is not None:
+                base = self.modkey(fi.module)
+                if expr.attr in self.class_locks.get((base, cls), ()):
+                    return f"{base}.{cls}.{expr.attr}"
+            return None
+        canon = fi.module.resolve(expr)
+        if canon is None:
+            return None
+        tail = canonical_tail(canon).split(".")
+        if len(tail) == 1:
+            candidates = [(self.modkey(fi.module), tail[0])]
+        else:
+            # a demoted (basename-colliding) module is keyed by its
+            # last-two segments; try the bare tail first — no demoted
+            # module keeps a bare key, so this can never mis-resolve
+            candidates = [(tail[-2], tail[-1])]
+            if len(tail) >= 3:
+                candidates.append((".".join(tail[-3:-1]), tail[-1]))
+        for key in candidates:
+            lid = self.module_locks.get(key)
+            if lid is not None:
+                return lid
+        return None
+
+    def method_class(self, fi: FuncInfo) -> Optional[str]:
+        """Class owning ``fi`` or an enclosing method (a nested def in a
+        method sees the method's ``self``)."""
+        for scope in fi.scope_chain():
+            cls = self._class_of(scope)
+            if cls is not None:
+                return cls
+        return None
+
+    # -- held-region walk ---------------------------------------------------
+
+    def _walk_all(self) -> None:
+        for mod in self.project.modules:
+            for fi in mod.functions:
+                out: List[Tuple[ast.AST, Tuple[str, ...]]] = []
+                direct: Set[str] = set()
+                body = [fi.node.body] if isinstance(fi.node, ast.Lambda) \
+                    else list(fi.node.body)
+                self._walk(body, (), out, direct, fi)
+                self.events[fi] = out
+                self.acquires[fi] = direct
+                self._scan_function(fi, out)
+
+    def _walk(self, stmts: List[ast.AST], held: Tuple[str, ...],
+              out: List[Tuple[ast.AST, Tuple[str, ...]]],
+              direct: Set[str], fi: FuncInfo) -> None:
+        for node in stmts:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                # nested defs execute in their own (usually thread /
+                # callback) context: no lock inherited lexically
+                out.append((node, held))
+                continue
+            if isinstance(node, ast.With):
+                inner = held
+                for item in node.items:
+                    out.append((item.context_expr, inner))
+                    self._walk(list(ast.iter_child_nodes(
+                        item.context_expr)), inner, out, direct, fi)
+                    lid = self.resolve_lock(fi, item.context_expr)
+                    if lid is not None:
+                        self._acquire(lid, inner, fi,
+                                      item.context_expr, direct)
+                        if lid not in inner:
+                            inner = inner + (lid,)
+                self._walk(node.body, inner, out, direct, fi)
+                continue
+            out.append((node, held))
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "acquire":
+                lid = self.resolve_lock(fi, node.func.value)
+                if lid is not None:
+                    self._acquire(lid, held, fi, node, direct)
+            self._walk(list(ast.iter_child_nodes(node)), held, out,
+                       direct, fi)
+
+    def _acquire(self, lid: str, held: Tuple[str, ...], fi: FuncInfo,
+                 node: ast.AST, direct: Set[str]) -> None:
+        direct.add(lid)
+        for h in held:
+            if h != lid:
+                self.edges.setdefault(
+                    (h, lid),
+                    (fi.module.relpath, getattr(node, "lineno", 0),
+                     fi.qualname))
+
+    # -- per-function fact extraction ---------------------------------------
+
+    def _scan_function(self, fi: FuncInfo,
+                       events: List[Tuple[ast.AST, Tuple[str, ...]]]
+                       ) -> None:
+        muts: List[MutationEvent] = []
+        blocking: Optional[str] = None
+        for node, held in events:
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                if isinstance(node, ast.AnnAssign) and node.value is None:
+                    continue          # bare annotation: not a write
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    attr = _self_attr_target(t)
+                    if attr is not None:
+                        how = "subscript" if isinstance(t, ast.Subscript) \
+                            else "assign"
+                        muts.append(MutationEvent(node, fi, "attr", attr,
+                                                  held, how))
+                        continue
+                    g = _global_target(t)
+                    if g is not None and (isinstance(t, ast.Subscript)
+                                          or g in fi_globals(fi)):
+                        muts.append(
+                            MutationEvent(node, fi, "global", g, held,
+                                          "subscript"
+                                          if isinstance(t, ast.Subscript)
+                                          else "assign"))
+            elif isinstance(node, ast.Call):
+                blocking = blocking or self.blocking_reason(fi, node)
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _MUTATOR_METHODS:
+                    attr = _self_attr_target(node.func.value)
+                    if attr is not None:
+                        muts.append(MutationEvent(node, fi, "attr", attr,
+                                                  held, "mutator"))
+                    elif isinstance(node.func.value, ast.Name):
+                        muts.append(MutationEvent(
+                            node, fi, "global", node.func.value.id, held,
+                            "mutator"))
+                canon = fi.module.resolve(node.func)
+                if canon and canonical_tail(canon) == "threading.Thread":
+                    self._record_spawn(node, fi)
+                if canon and canonical_tail(canon) in (
+                        "threading.Event",):
+                    self.event_objects.append((node, fi, "event"))
+        self.mutations[fi] = muts
+        self.blocking[fi] = blocking
+        if fi.name in _HTTP_HANDLER_METHODS \
+                and self._class_of(fi) is not None:
+            self.thread_entries.add(fi)
+
+    def blocking_reason(self, fi: FuncInfo,
+                        node: ast.Call) -> Optional[str]:
+        """A human-readable reason when this call blocks the thread
+        (independent of held locks — the *summary*; STS103 combines it
+        with held regions)."""
+        canon = fi.module.resolve(node.func)
+        tail = canonical_tail(canon) if canon else ""
+        if tail in _BLOCKING_CALL_TAILS or tail == "open":
+            return f"{tail}()"
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _BLOCKING_METHODS:
+            if node.func.attr == "join" and not self._threadish(
+                    fi, node.func.value):
+                return None          # ", ".join(parts) et al.
+            return f".{node.func.attr}()"
+        return None
+
+    def _threadish(self, fi: FuncInfo, base: ast.AST) -> bool:
+        """Is ``<base>.join()`` plausibly a thread/process join?  Only
+        when the base name was assigned a Thread/Process in the same
+        function scope — string joins dominate otherwise."""
+        if not isinstance(base, (ast.Name, ast.Attribute)):
+            return False
+        name = base.id if isinstance(base, ast.Name) else base.attr
+        for node in iter_scope(fi.node):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                canon = fi.module.resolve(node.value.func)
+                tail = canonical_tail(canon) if canon else ""
+                if tail.split(".")[-1] in ("Thread", "Process"):
+                    for t in node.targets:
+                        tn = t.id if isinstance(t, ast.Name) else (
+                            t.attr if isinstance(t, ast.Attribute)
+                            else None)
+                        if tn == name:
+                            return True
+        return False
+
+    def _record_spawn(self, node: ast.Call, fi: FuncInfo) -> None:
+        spawn = ThreadSpawn(node, fi)
+        for kw in node.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is True:
+                spawn.daemon = True
+            elif kw.arg == "target":
+                target = self.project.lookup(
+                    fi.module.resolve(kw.value), fi, fi.module)
+                if target is not None:
+                    spawn.target = target
+                    self.thread_entries.add(target)
+        # the assigned name + later .join(...) / .daemon = True
+        for n in iter_scope(fi.node):
+            if isinstance(n, ast.Assign) and n.value is node:
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        spawn.assigned = t.id
+        if spawn.assigned:
+            for n in iter_scope(fi.node):
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr == "join" \
+                        and isinstance(n.func.value, ast.Name) \
+                        and n.func.value.id == spawn.assigned:
+                    spawn.joined = True
+                if isinstance(n, ast.Assign) \
+                        and len(n.targets) == 1 \
+                        and isinstance(n.targets[0], ast.Attribute) \
+                        and n.targets[0].attr == "daemon" \
+                        and isinstance(n.targets[0].value, ast.Name) \
+                        and n.targets[0].value.id == spawn.assigned \
+                        and isinstance(n.value, ast.Constant) \
+                        and n.value.value is True:
+                    spawn.daemon = True
+        self.spawns.append(spawn)
+
+    # -- call resolution shared by the closures -----------------------------
+
+    def resolve_call(self, fi: FuncInfo,
+                     node: ast.Call) -> Optional[FuncInfo]:
+        """Callee FuncInfo for module functions, imported functions, and
+        same-class ``self.m(...)`` methods."""
+        if isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self":
+            cls = self.method_class(fi)
+            if cls is not None:
+                for other in fi.module.functions:
+                    if other.qualname == f"{cls}.{node.func.attr}":
+                        return other
+            return None
+        return self.project.lookup(fi.module.resolve(node.func), fi,
+                                   fi.module)
+
+    # -- fixpoints ----------------------------------------------------------
+
+    def _close_summaries(self, max_rounds: int = 20) -> None:
+        funcs = [fi for m in self.project.modules for fi in m.functions]
+        self.acquires_tc = {fi: set(self.acquires[fi]) for fi in funcs}
+        self.blocking_tc = dict(self.blocking)
+        for _ in range(max_rounds):
+            changed = False
+            for fi in funcs:
+                for node, _held in self.events[fi]:
+                    if not isinstance(node, ast.Call):
+                        continue
+                    g = self.resolve_call(fi, node)
+                    if g is None or g not in self.acquires_tc:
+                        continue
+                    missing = self.acquires_tc[g] - self.acquires_tc[fi]
+                    if missing:
+                        self.acquires_tc[fi] |= missing
+                        changed = True
+                    if self.blocking_tc.get(g) \
+                            and not self.blocking_tc.get(fi):
+                        self.blocking_tc[fi] = (
+                            f"{g.qualname}() -> "
+                            f"{self.blocking_tc[g]}")
+                        changed = True
+            if not changed:
+                return
+
+    def _call_edges(self) -> None:
+        """Holding lock A, a call into a function that (transitively)
+        acquires B is an A->B edge too — this is how the graph crosses
+        modules."""
+        for m in self.project.modules:
+            for fi in m.functions:
+                for node, held in self.events[fi]:
+                    if not held or not isinstance(node, ast.Call):
+                        continue
+                    g = self.resolve_call(fi, node)
+                    if g is None:
+                        continue
+                    for b in self.acquires_tc.get(g, ()):
+                        for a in held:
+                            if a != b:
+                                self.edges.setdefault(
+                                    (a, b),
+                                    (m.relpath,
+                                     getattr(node, "lineno", 0),
+                                     f"{fi.qualname} -> {g.qualname}"))
+
+    def _thread_closure(self) -> None:
+        frontier = list(self.thread_entries)
+        seen = set(frontier)
+        while frontier:
+            fi = frontier.pop()
+            for node, _held in self.events.get(fi, ()):
+                if not isinstance(node, ast.Call):
+                    continue
+                g = self.resolve_call(fi, node)
+                if g is not None and g not in seen:
+                    seen.add(g)
+                    frontier.append(g)
+        self.thread_reachable = seen
+
+    # -- lock-order cycles --------------------------------------------------
+
+    def lock_cycles(self) -> List[List[str]]:
+        """Strongly connected components of size > 1 in the acquisition-
+        order graph (a self-loop cannot happen: with-reacquisition of the
+        same id is filtered at edge creation).  Each SCC is one potential
+        ABBA deadlock family; returned sorted for determinism.
+
+        The Tarjan body is deliberately mirrored in
+        ``spark_timeseries_tpu/utils/races.py::RaceHarness.cycles`` (the
+        runtime cross-check): neither side may import the other across
+        the tools-vs-shipped-package boundary.  Keep the two in
+        lockstep."""
+        graph: Dict[str, Set[str]] = {}
+        for a, b in self.edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        sccs: List[List[str]] = []
+        counter = [0]
+
+        def strong(v: str) -> None:
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            for w in sorted(graph[v]):
+                if w not in index:
+                    strong(w)
+                    low[v] = min(low[v], low[w])
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+        for v in sorted(graph):
+            if v not in index:
+                strong(v)
+        return sorted(sccs)
+
+
+def fi_globals(fi: FuncInfo) -> Set[str]:
+    """Names the function declares ``global``."""
+    out: Set[str] = set()
+    for node in iter_scope(fi.node):
+        if isinstance(node, ast.Global):
+            out.update(node.names)
+    return out
+
+
+def locally_bound(fi: FuncInfo, name: str) -> bool:
+    """Is ``name`` a local binding of this function (parameter, plain
+    assignment without ``global``, loop/with/except/comprehension
+    target)?  Used to keep a module-global rule from firing on a local
+    that merely shadows the global's name."""
+    if name in fi.params:
+        return True
+    if name in fi_globals(fi):
+        return False
+    for node in iter_scope(fi.node):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name) and n.id == name \
+                            and isinstance(n.ctx, ast.Store):
+                        return True
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            if isinstance(node.target, ast.Name) \
+                    and node.target.id == name:
+                return True
+        elif isinstance(node, ast.For):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name) and n.id == name:
+                    return True
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    for n in ast.walk(item.optional_vars):
+                        if isinstance(n, ast.Name) and n.id == name:
+                            return True
+        elif isinstance(node, ast.ExceptHandler):
+            if node.name == name:
+                return True
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                for n in ast.walk(gen.target):
+                    if isinstance(n, ast.Name) and n.id == name:
+                        return True
+        elif isinstance(node, ast.NamedExpr):
+            if isinstance(node.target, ast.Name) \
+                    and node.target.id == name:
+                return True
+    return False
+
+
+def concurrency_model(project: Project) -> ConcurrencyModel:
+    """The per-run cached concurrency model (built on first rule use)."""
+    model = getattr(project, "_concurrency_model", None)
+    if model is None:
+        model = ConcurrencyModel(project)
+        project._concurrency_model = model
+    return model
+
+
 def _const_ints(node: ast.AST) -> List[int]:
     out = []
     for n in ast.walk(node):

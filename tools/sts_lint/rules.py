@@ -31,8 +31,10 @@ import ast
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterator, Optional
 
-from .analysis import (FuncInfo, ModuleModel, Project, canonical_tail,
-                       iter_scope, local_tainted_names, taint_expr)
+from .analysis import (ConcurrencyModel, FuncInfo, ModuleModel, Project,
+                       _self_attr_target, canonical_tail,
+                       concurrency_model, iter_scope, local_tainted_names,
+                       locally_bound, taint_expr)
 
 
 @dataclass
@@ -419,6 +421,305 @@ def _check_recompile_hazard(project: Project, mod: ModuleModel
 
 
 # ---------------------------------------------------------------------------
+# STS101 — shared-state write outside the owning lock
+# ---------------------------------------------------------------------------
+#
+# Guard inference, not annotation: within a class that owns a lock (an
+# attribute assigned threading.Lock/RLock/Condition), every attribute
+# that is EVER mutated while holding one of the class's locks is
+# *lock-guarded state*; any other mutation of the same attribute outside
+# the lock is a finding.  Module globals get the same treatment against
+# the module's lock globals.  ``__init__`` is exempt (the object is not
+# shared yet), as are private helpers whose every intra-class call site
+# holds the lock (the ``_pop_tenant`` shape: caller-holds-lock
+# conventions are fine as long as every caller in fact holds it).
+
+def _method_name(model: ConcurrencyModel, fi: FuncInfo) -> str:
+    """The top-level method a (possibly nested) function belongs to."""
+    top = fi
+    for scope in fi.scope_chain():
+        top = scope
+    return top.name
+
+
+def _called_locked_methods(model: ConcurrencyModel, mod: ModuleModel,
+                           cls: str, lock_ids) -> set:
+    """Private methods of ``cls`` whose every ``self.m(...)`` call site
+    (at least one exists) runs with one of the class's locks held."""
+    sites: Dict[str, list] = {}
+    for fi in mod.functions:
+        if model.method_class(fi) != cls:
+            continue
+        for node, held in model.events.get(fi, ()):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "self":
+                sites.setdefault(node.func.attr, []).append(
+                    bool(set(held) & lock_ids))
+    return {m for m, ctx in sites.items()
+            if m.startswith("_") and ctx and all(ctx)}
+
+
+def _check_shared_state(project: Project, mod: ModuleModel
+                        ) -> Iterator[RawFinding]:
+    model = concurrency_model(project)
+    base = model.modkey(mod)
+
+    # -- class attributes against the class's own locks -------------------
+    for cls in sorted(model.class_names.get(base, ())):
+        lock_ids = model.lock_ids_of_class(mod, cls)
+        if not lock_ids:
+            continue
+        lock_attrs = model.class_locks.get((base, cls), set())
+        members = [fi for fi in mod.functions
+                   if model.method_class(fi) == cls]
+        guarded = set()
+        for fi in members:
+            for ev in model.mutations.get(fi, ()):
+                if ev.kind == "attr" and set(ev.held) & lock_ids:
+                    guarded.add(ev.name)
+        if not guarded:
+            continue
+        relieved = _called_locked_methods(model, mod, cls, lock_ids)
+        for fi in members:
+            method = _method_name(model, fi)
+            if method == "__init__" or method in relieved:
+                continue
+            for ev in model.mutations.get(fi, ()):
+                if ev.kind != "attr" or ev.name not in guarded \
+                        or ev.name in lock_attrs \
+                        or set(ev.held) & lock_ids:
+                    continue
+                reach = " (thread-reachable)" \
+                    if fi in model.thread_reachable else ""
+                yield RawFinding(
+                    "STS101", ev.node.lineno, ev.node.col_offset,
+                    fi.qualname,
+                    f"write to lock-guarded state self.{ev.name} outside "
+                    f"`with {sorted(lock_ids)[0].rsplit('.', 1)[-1]}` "
+                    f"({cls} mutates it under its lock elsewhere)"
+                    f"{reach}: a concurrent reader/writer can observe a "
+                    f"torn or lost update")
+
+    # -- module globals against the module's lock globals -----------------
+    mod_locks = {lid for (b, _n), lid in model.module_locks.items()
+                 if b == base}
+    if not mod_locks:
+        return
+    guarded_globals = set()
+    for fi in mod.functions:
+        for ev in model.mutations.get(fi, ()):
+            if ev.kind == "global" \
+                    and ev.name in model.module_globals.get(base, ()) \
+                    and not locally_bound(ev.fi, ev.name) \
+                    and set(ev.held) & mod_locks:
+                guarded_globals.add(ev.name)
+    for fi in mod.functions:
+        for ev in model.mutations.get(fi, ()):
+            if ev.kind != "global" or ev.name not in guarded_globals \
+                    or locally_bound(ev.fi, ev.name) \
+                    or set(ev.held) & mod_locks:
+                continue
+            reach = " (thread-reachable)" \
+                if fi in model.thread_reachable else ""
+            yield RawFinding(
+                "STS101", ev.node.lineno, ev.node.col_offset, fi.qualname,
+                f"write to lock-guarded module global {ev.name} outside "
+                f"its module lock (it is mutated under "
+                f"{sorted(mod_locks)[0]} elsewhere){reach}: concurrent "
+                f"mutation can tear or lose the update")
+
+
+# ---------------------------------------------------------------------------
+# STS102 — lock-acquisition-order cycles (potential ABBA deadlock)
+# ---------------------------------------------------------------------------
+
+def _check_lock_order(project: Project, mod: ModuleModel
+                      ) -> Iterator[RawFinding]:
+    model = concurrency_model(project)
+    for cycle in model.lock_cycles():
+        in_cycle = set(cycle)
+        edges = sorted((pair, loc) for pair, loc in model.edges.items()
+                       if pair[0] in in_cycle and pair[1] in in_cycle)
+        if not edges:
+            continue
+        anchor_pair, anchor = edges[0]
+        if anchor[0] != mod.relpath:
+            continue          # reported once, in the first edge's module
+        detail = "; ".join(
+            f"{a}->{b} at {loc[0]}:{loc[1]} ({loc[2]})"
+            for (a, b), loc in edges[:4])
+        yield RawFinding(
+            "STS102", anchor[1], 0, anchor[2],
+            f"lock-acquisition-order cycle {' -> '.join(cycle)} -> "
+            f"{cycle[0]}: two threads taking these locks in opposite "
+            f"orders deadlock (ABBA).  Edges: {detail}.  Pick one global "
+            f"order (see docs/design.md §6d lock-ordering table) and "
+            f"restructure the out-of-order acquisition")
+
+
+# ---------------------------------------------------------------------------
+# STS103 — blocking call while holding a lock
+# ---------------------------------------------------------------------------
+
+def _check_blocking_under_lock(project: Project, mod: ModuleModel
+                               ) -> Iterator[RawFinding]:
+    model = concurrency_model(project)
+    for fi in mod.functions:
+        for node, held in model.events.get(fi, ()):
+            if not held or not isinstance(node, ast.Call):
+                continue
+            # Condition.wait on the lock being held RELEASES that lock
+            # while waiting — the one legitimate blocking-wait-under-lock
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "wait" \
+                    and model.resolve_lock(fi, node.func.value) in held:
+                continue
+            reason = model.blocking_reason(fi, node)
+            if reason is not None:
+                yield RawFinding(
+                    "STS103", node.lineno, node.col_offset, fi.qualname,
+                    f"blocking call {reason} while holding "
+                    f"{', '.join(held)}: every thread needing the lock "
+                    f"stalls behind this wait — move the blocking work "
+                    f"outside the `with` block")
+                continue
+            # user-supplied callback invoked under the lock
+            if isinstance(node.func, ast.Name):
+                name = node.func.id
+                in_params = any(name in scope.params
+                                for scope in fi.scope_chain())
+                if in_params and fi.resolve_local(name) is None:
+                    yield RawFinding(
+                        "STS103", node.lineno, node.col_offset,
+                        fi.qualname,
+                        f"user callback {name}() invoked while holding "
+                        f"{', '.join(held)}: arbitrary user code can "
+                        f"block (or re-enter the lock) — snapshot state "
+                        f"under the lock, call the callback after "
+                        f"releasing it")
+                    continue
+            g = model.resolve_call(fi, node)
+            if g is not None and model.blocking_tc.get(g):
+                yield RawFinding(
+                    "STS103", node.lineno, node.col_offset, fi.qualname,
+                    f"call to {g.qualname}() while holding "
+                    f"{', '.join(held)}; it blocks "
+                    f"({model.blocking_tc[g]}) — move it outside the "
+                    f"`with` block")
+
+
+# ---------------------------------------------------------------------------
+# STS104 — thread-lifecycle hygiene
+# ---------------------------------------------------------------------------
+
+def _broad_try(stmt: ast.AST) -> bool:
+    if not isinstance(stmt, ast.Try):
+        return False
+    for h in stmt.handlers:
+        if h.type is None:
+            return True
+        elts = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+        for n in elts:
+            last = n.attr if isinstance(n, ast.Attribute) else (
+                n.id if isinstance(n, ast.Name) else "")
+            if last in ("Exception", "BaseException"):
+                return True
+    return False
+
+
+def _is_trivial_stmt(stmt: ast.AST) -> bool:
+    if isinstance(stmt, (ast.Pass, ast.Global, ast.Nonlocal)):
+        return True
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+        return True          # docstring / bare literal
+    if isinstance(stmt, ast.Return):
+        return stmt.value is None or isinstance(stmt.value, ast.Constant)
+    if isinstance(stmt, ast.Assign):
+        return isinstance(stmt.value, (ast.Constant, ast.Name))
+    return False
+
+
+def _event_base_names(fi: FuncInfo, call: ast.Call) -> list:
+    """Names an Event construction is bound to (local name or self attr)."""
+    out = []
+    for node in iter_scope(fi.node):
+        if isinstance(node, ast.Assign) and node.value is call:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.append(t.id)
+                else:
+                    attr = _self_attr_target(t)
+                    if attr:
+                        out.append(attr)
+    return out
+
+
+def _attr_calls_on(mod: ModuleModel, base_name: str, attrs: set) -> bool:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Attribute) and node.attr in attrs:
+            v = node.value
+            if isinstance(v, ast.Name) and v.id == base_name:
+                return True
+            if isinstance(v, ast.Attribute) and v.attr == base_name \
+                    and isinstance(v.value, ast.Name) \
+                    and v.value.id == "self":
+                return True
+    return False
+
+
+def _check_thread_lifecycle(project: Project, mod: ModuleModel
+                            ) -> Iterator[RawFinding]:
+    model = concurrency_model(project)
+    for spawn in model.spawns:
+        if spawn.fi.module is not mod:
+            continue
+        if not spawn.daemon and not spawn.joined:
+            what = f"thread {spawn.assigned!r}" if spawn.assigned \
+                else "anonymous thread"
+            yield RawFinding(
+                "STS104", spawn.node.lineno, spawn.node.col_offset,
+                spawn.fi.qualname,
+                f"non-daemon {what} is never joined: it outlives its "
+                f"owner and blocks interpreter shutdown — pass "
+                f"daemon=True (abandonable work) or join it on every "
+                f"exit path")
+        # a thread target that can raise past its outermost try kills
+        # the thread silently (the exception is printed, the work is
+        # lost, nothing upstream notices)
+        t = spawn.target
+        if t is not None and t.module is mod:
+            body = list(t.node.body)
+            risky = [s for s in body
+                     if not _broad_try(s) and not _is_trivial_stmt(s)]
+            if risky:
+                yield RawFinding(
+                    "STS104", spawn.node.lineno, spawn.node.col_offset,
+                    spawn.fi.qualname,
+                    f"thread target {t.qualname}() can raise past its "
+                    f"outermost try (line {risky[0].lineno} is not "
+                    f"exception-contained): an escaping exception kills "
+                    f"the thread silently — wrap the body in "
+                    f"try/except and surface the failure (flag, queue, "
+                    f"counter)")
+    for call, fi, _kind in model.event_objects:
+        if fi.module is not mod:
+            continue
+        for name in _event_base_names(fi, call):
+            if _attr_calls_on(mod, name, {"set"}) \
+                    and not _attr_calls_on(mod, name,
+                                           {"wait", "is_set"}):
+                yield RawFinding(
+                    "STS104", call.lineno, call.col_offset, fi.qualname,
+                    f"threading.Event {name!r} is set() but never "
+                    f"wait()ed on or polled in this module: either dead "
+                    f"signaling (delete it) or the waiter lives behind "
+                    f"an interface the model cannot see (suppress with "
+                    f"a justification)")
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -441,7 +742,24 @@ RULES: Dict[str, Rule] = {r.code: r for r in [
     Rule("STS006", "recompile-hazard",
          "jax.jit of a per-call closure (defeats the jit cache)",
          _check_recompile_hazard),
+    Rule("STS101", "unguarded-shared-write",
+         "Write to lock-guarded shared state (class attr / module "
+         "global) outside the owning lock", _check_shared_state),
+    Rule("STS102", "lock-order-cycle",
+         "Cycle in the whole-tree lock-acquisition-order graph "
+         "(potential ABBA deadlock)", _check_lock_order),
+    Rule("STS103", "blocking-under-lock",
+         "Blocking call (sleep/IO/device sync/user callback) while "
+         "holding a lock", _check_blocking_under_lock),
+    Rule("STS104", "thread-lifecycle",
+         "Thread-lifecycle hygiene: unjoined non-daemon threads, "
+         "waiterless Events, raise-through thread targets",
+         _check_thread_lifecycle),
 ]}
 
 TRACER_SAFETY_RULES = ("STS001", "STS002", "STS005", "STS006")
 DTYPE_RULES = ("STS003", "STS004")
+# the concurrency tier: like the tracer-safety rules these must never be
+# baselined — every real finding is fixed or suppressed in-source with a
+# written justification
+CONCURRENCY_RULES = ("STS101", "STS102", "STS103", "STS104")
