@@ -15,8 +15,8 @@ STS_COMPILE_CACHE ?=
 
 .PHONY: help verify compileall tier1 verify-faults verify-durability \
 	verify-perf verify-serving verify-long verify-telemetry verify-fleet \
-	verify-backtest verify-races gate trace lint lint-baseline contracts \
-	verify-static jax-audit warmup
+	verify-backtest verify-quality verify-races gate trace lint \
+	lint-baseline contracts verify-static jax-audit warmup
 
 help:
 	@echo "Targets:"
@@ -50,6 +50,9 @@ help:
 	@echo "                bitwise-pinned, SLO shedding + cached forecasts, drain/adopt kill -9)"
 	@echo "  verify-backtest rolling-origin backtest suite (pinned-gain replay vs sequential"
 	@echo "                oracle, NumPy metric oracles, champion determinism, kill -9 resume)"
+	@echo "  verify-quality live forecast-quality suite (anomaly-score oracle, online"
+	@echo "                sMAPE/MASE/coverage, Page-Hinkley drift + drifted-lane heal,"
+	@echo "                stationary zero-false-alarm pin), plain and under STS_FAULT_INJECT=1"
 	@echo "  verify-perf   perf gate: newest BENCH_r*.json vs trailing-median baseline"
 	@echo "  gate          same as verify-perf (tools/bench_gate.py; exit 1 on regression)"
 	@echo "  trace         run a small demo workload, write trace.json (open in ui.perfetto.dev)"
@@ -69,9 +72,10 @@ lint-baseline:
 	$(PY) -m tools.sts_lint spark_timeseries_tpu --write-baseline
 
 # Level 2: trace + lower every fit family — plus the serving update,
-# longseries combine, fleet coalesced pump, backtest metric kernel, and
-# pinned-state-path programs — from ShapeDtypeStructs and assert the
-# no-f64 / no-host-callback / stable-jaxpr contracts (45 checks).
+# quality-armed update, longseries combine, fleet coalesced pump,
+# backtest metric kernel, and pinned-state-path programs — from
+# ShapeDtypeStructs and assert the no-f64 / no-host-callback /
+# stable-jaxpr contracts (48 checks).
 contracts:
 	JAX_PLATFORMS=cpu $(PY) -m spark_timeseries_tpu.utils.contracts
 
@@ -127,7 +131,8 @@ tier1:
 # false-positive pin, which use the tick_corrupt_* / state_poison fault
 # modes) runs under the same env, so heal()'s batch refit exercises its
 # forced-retry path too.
-verify-faults: verify-durability verify-telemetry verify-fleet
+verify-faults: verify-durability verify-telemetry verify-fleet \
+		verify-quality
 	STS_FAULT_INJECT=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 		-m 'not slow' --continue-on-collection-errors \
 		-p no:cacheprovider -p no:xdist -p no:randomly
@@ -208,6 +213,24 @@ verify-backtest:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m backtest \
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly
+
+# forecast-quality gate (ISSUE 15): the `quality`-marked subset — the
+# anomaly-score NumPy oracle (NaN/predict-only ticks included), online
+# sMAPE/MASE/coverage vs offline recomputation, the seeded regime-shift
+# closed loop (drifted trips on exactly the shifted lanes ->
+# heal(drifted=True) -> accuracy recovers to a fresh fit's band), the
+# stationary zero-false-alarm pin, checkpoint round-trip with quality
+# armed, and the warmed-tick 0-recompile pin with quality + telemetry
+# both armed.  Two passes: plain, and under STS_FAULT_INJECT=1 reusing
+# the serving tier's tick-corruption fault modes (quality scoring must
+# degrade to unscored ticks, never alarm, when the wire corrupts).
+verify-quality:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m quality \
+		--continue-on-collection-errors -p no:cacheprovider \
+		-p no:xdist -p no:randomly
+	STS_FAULT_INJECT=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
+		-m quality --continue-on-collection-errors \
+		-p no:cacheprovider -p no:xdist -p no:randomly
 
 # perf regression gate over the recorded BENCH_r*.json trajectory: the
 # newest round is compared per headline metric (throughput, fit wall

@@ -792,6 +792,26 @@ def main():
                     heal_sess.update(live[:, 0])
                 heal_sess.update(live[:, 1])
                 heal_report = heal_sess.heal()
+            # quality demo (ISSUE 15): a SEPARATE quality-armed session
+            # on a private registry streams a stationary slice of the
+            # same panel — separate so the fused quality step's extra
+            # per-tick work never contaminates the gated
+            # serving_update_p50/p95 (this scope's serving.update spans
+            # land under bench.quality_demo with a smaller count, so
+            # the gate's busiest-leaf matcher keeps reading the main
+            # demo's numbers).  live_smape is gated lower-is-better and
+            # drift_alarms zero-baselined: the stream is stationary by
+            # construction, so any alarm is a false positive.
+            from spark_timeseries_tpu.statespace import QualityPolicy
+            q_sess = sstate.ServingSession.start(
+                model, hist, registry=metrics.MetricsRegistry(),
+                quality=QualityPolicy())
+            q_sess.warmup()
+            q_ticks = max(1, min(ticks - 1, 48))
+            with metrics.span("bench.quality_demo"):
+                for t in range(q_ticks):
+                    q_sess.update(live[:, t])
+            qsum = q_sess.quality_summary() or {}
             # the update span nests under this demo's scope
             # ("bench.serving_demo/serving.update") — resolve it with the
             # same leaf matcher the gate uses, so the reported and gated
@@ -818,6 +838,16 @@ def main():
                              metrics.snapshot()["spans"],
                              "serving.heal") or {}).get("p50_s", 0.0),
                              3)},
+                "quality": {
+                    "ticks": q_ticks,
+                    "horizon": qsum.get("horizon"),
+                    "live_smape": qsum.get("live_smape"),
+                    "live_mase": qsum.get("live_mase"),
+                    "live_coverage": qsum.get("live_coverage"),
+                    "anomaly_p95": qsum.get("anomaly_p95"),
+                    "drifted_lanes": qsum.get("drifted_lanes", 0),
+                    "drift_alarms": qsum.get("drift_alarms", 0),
+                },
             }
         except Exception as e:  # noqa: BLE001 — optional extra; its
             # failure must not void the already-measured curve
@@ -874,6 +904,40 @@ def main():
                     np.fromiter(sched.session(la)._tick_lat,
                                 dtype=np.float64)
                     for la in sched.tenants]) * 1e3
+            # fleet quality sub-block (ISSUE 15): a small SEPARATE
+            # quality-armed tenant group pumped through its own
+            # scheduler (private registry, after the timing) proves the
+            # coalesced dispatch path with the fused quality step armed
+            # and reports the aggregate online accuracy — without
+            # perturbing the gated fleet_ticks_per_s numbers above.
+            from spark_timeseries_tpu.statespace import QualityPolicy
+            q_n, q_rounds = min(4, n_sessions), min(12, rounds)
+            q_reg = metrics.MetricsRegistry()
+            q_sched = FleetScheduler(AdmissionPolicy(queue_depth=4),
+                                     registry=q_reg, auto_pump=False)
+            for i in range(q_n):
+                q_sched.attach(sstate.ServingSession.start(
+                    fl_model, fl_hist[i * per:(i + 1) * per, :64],
+                    label=f"bench-q{i}", registry=q_reg,
+                    quality=QualityPolicy()))
+            q_sched.warmup()
+            q_live = fl_hist[:, 64:64 + q_rounds]
+            for t in range(q_rounds):
+                for i in range(q_n):
+                    q_sched.submit(f"bench-q{i}",
+                                   q_live[i * per:(i + 1) * per, t])
+                q_sched.pump()
+            q_sums = [q_sched.session(la).quality_summary() or {}
+                      for la in q_sched.tenants]
+            q_smapes = [s.get("live_smape") for s in q_sums
+                        if isinstance(s.get("live_smape"), (int, float))]
+            fl_quality = {
+                "tenants": q_n, "ticks": q_rounds,
+                "live_smape": round(float(np.mean(q_smapes)), 4)
+                if q_smapes else None,
+                "drift_alarms": int(sum(s.get("drift_alarms", 0)
+                                        for s in q_sums)),
+            }
             fl_counters = fleet_reg.snapshot()["counters"]
             fleet_demo = {
                 "sessions": n_sessions,
@@ -889,6 +953,7 @@ def main():
                 "slo_burns": int(fl_counters.get("fleet.slo_burns", 0)),
                 "rejected": int(fl_counters.get("fleet.rejected", 0)),
                 "seconds": round(fleet_s, 3),
+                "quality": fl_quality,
             }
         except Exception as e:  # noqa: BLE001 — optional extra; its
             # failure must not void the already-measured curve

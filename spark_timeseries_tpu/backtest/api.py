@@ -65,6 +65,7 @@ class BacktestReport(NamedTuple):
     select_by: str
     tie_tol: float
     tie_z: float
+    mase_m: int               # MASE scaling period (1 = lag-1 naive)
     champion: np.ndarray          # (S,) int64, -1 = no finite candidate
     scores_smape: np.ndarray      # (S, C)
     scores_mase: np.ndarray       # (S, C)
@@ -131,6 +132,7 @@ class BacktestReport(NamedTuple):
             "n_origins": self.schedule.n_origins,
             "horizons": list(self.horizons),
             "select_by": self.select_by,
+            "mase_m": int(self.mase_m),
             "champion_counts": self.champion_counts(),
             "champion_smape": float(np.nanmean(cs))
             if np.isfinite(cs).any() else None,
@@ -146,7 +148,8 @@ class BacktestReport(NamedTuple):
         h.update(repr([c.label for c in self.candidates]).encode())
         h.update(repr(self.schedule.describe()).encode())
         h.update(repr((self.select_by, float(self.tie_tol),
-                       float(self.tie_z), self.horizons)).encode())
+                       float(self.tie_z), int(self.mase_m),
+                       self.horizons)).encode())
         for arr in (self.champion, self.scores_smape, self.scores_mase,
                     self.score_std, self.smape, self.mase, self.rmse,
                     self.coverage, self.sigma2):
@@ -316,7 +319,7 @@ def backtest_panel(values, grid: Optional[CandidateGrid] = None, *,
                    min_train: Optional[int] = None,
                    mode: str = "expanding", window: Optional[int] = None,
                    select_by: str = "mase", tie_tol: float = 1e-3,
-                   tie_z: float = 2.0,
+                   tie_z: float = 2.0, mase_m: int = 1,
                    coverage: float = 0.9, replay: str = "pinned",
                    engine=None, chunk_size: int = 131072,
                    journal: Optional[str] = None,
@@ -338,10 +341,13 @@ def backtest_panel(values, grid: Optional[CandidateGrid] = None, *,
     or "smape"); ``tie_z``/``tie_tol`` shape the statistical near-tie
     band the parsimony tie-break applies inside (``tie_z`` paired
     per-origin standard errors plus a ``tie_tol`` relative floor — see
-    docs/design.md §9 champion tie-breaking); ``coverage`` the nominal
-    interval level the coverage metric tests; ``replay`` ("pinned" |
-    "refilter" — the sequential oracle, O(origins) slower, for
-    verification).
+    docs/design.md §9 champion tie-breaking); ``mase_m`` the MASE
+    scaling period (1 = lag-1 naive; pass the seasonal period to scale
+    by the seasonal-naive in-sample MAE — Hyndman & Koehler's seasonal
+    MASE — so seasonal panels compete on a denominator their
+    seasonality doesn't inflate); ``coverage`` the nominal interval
+    level the coverage metric tests; ``replay`` ("pinned" | "refilter"
+    — the sequential oracle, O(origins) slower, for verification).
 
     Streaming knobs pass straight to ``engine.stream_fit`` per
     candidate: ``engine``/``chunk_size``/``deadline_s``/``retry``/
@@ -359,6 +365,10 @@ def backtest_panel(values, grid: Optional[CandidateGrid] = None, *,
     if tie_tol < 0 or tie_z < 0:
         raise ValueError(f"tie_tol/tie_z must be >= 0, got "
                          f"{tie_tol}/{tie_z}")
+    mase_m = int(mase_m)
+    if mase_m < 1:
+        # fail before the first candidate's full streamed fit
+        raise ValueError(f"mase_m must be a period >= 1, got {mase_m}")
     if replay not in ("pinned", "refilter"):
         # fail before the first candidate's full streamed fit, not after
         raise ValueError(f"unknown replay mode {replay!r}; expected "
@@ -428,7 +438,7 @@ def backtest_panel(values, grid: Optional[CandidateGrid] = None, *,
                              "error": f"{type(e).__name__}: {e}"}
             evals.append(evaluate_candidate(
                 host, model, schedule, grid.horizons, replay=replay,
-                coverage=coverage))
+                coverage=coverage, mase_m=mase_m))
             stream_stats.append(stats)
 
         scores_smape = np.stack([e.score_smape for e in evals], axis=1)
@@ -450,7 +460,7 @@ def backtest_panel(values, grid: Optional[CandidateGrid] = None, *,
         report = BacktestReport(
             candidates=cands, horizons=grid.horizons, schedule=schedule,
             select_by=select_by, tie_tol=float(tie_tol),
-            tie_z=float(tie_z),
+            tie_z=float(tie_z), mase_m=mase_m,
             champion=champion, scores_smape=scores_smape,
             scores_mase=scores_mase, score_std=score_std,
             smape=np.stack([e.smape for e in evals], axis=1),

@@ -45,7 +45,7 @@ from ..statespace.kalman import (filter_panel, pinned_state_path,
 from ..statespace.ssm import SSMeta, initial_state
 from ..utils import metrics as _metrics
 
-__all__ = ["CandidateEval", "evaluate_candidate"]
+__all__ = ["CandidateEval", "evaluate_candidate", "masked_pointwise"]
 
 # families the replay supports: every family whose state-space form has
 # no per-tick exogenous offsets (ARX/ARIMAX offsets would need a future-
@@ -187,14 +187,16 @@ def _masked_mean(pt, mask, axis):
     return jnp.where(cnt > 0, s / jnp.maximum(cnt, 1), jnp.nan)
 
 
-def _metric_tables_fn(fcst, actual, half, scale, hs):
-    """All four metric families in one NaN-masked pass.
+def masked_pointwise(fcst, actual):
+    """The NaN-masked pointwise error primitives every quality consumer
+    shares — the backtest metric tables here and the serving tier's
+    fused online-accuracy step (``statespace.quality.quality_step``),
+    so the two surfaces can never disagree on a definition.
 
-    ``fcst``/``actual (S, O, H)``, ``half (S, H)``, ``scale (S,)`` the
-    in-sample naive MAE (MASE denominator), ``hs`` the static 1-based
-    horizons the scores average.  A point contributes only when both
-    forecast and actual are finite; sMAPE's 0/0 (both sides zero —
-    a perfect forecast of a zero) contributes 0."""
+    A point contributes only when both forecast and actual are finite;
+    sMAPE's 0/0 (both sides zero — a perfect forecast of a zero)
+    contributes 0.  Returns ``(mask, abserr, smape_pt)`` with masked-out
+    points zeroed, at any broadcastable shape."""
     mask = jnp.isfinite(actual) & jnp.isfinite(fcst)
     a = jnp.where(mask, actual, 0.0)
     f = jnp.where(mask, fcst, 0.0)
@@ -203,6 +205,17 @@ def _metric_tables_fn(fcst, actual, half, scale, hs):
     smape_pt = jnp.where(denom > 0,
                          200.0 * abserr / jnp.where(denom > 0, denom, 1.0),
                          jnp.zeros_like(abserr))
+    return mask, abserr, smape_pt
+
+
+def _metric_tables_fn(fcst, actual, half, scale, hs):
+    """All four metric families in one NaN-masked pass.
+
+    ``fcst``/``actual (S, O, H)``, ``half (S, H)``, ``scale (S,)`` the
+    in-sample naive MAE (MASE denominator), ``hs`` the static 1-based
+    horizons the scores average.  Pointwise definitions live in
+    :func:`masked_pointwise` (shared with the serving quality plane)."""
+    mask, abserr, smape_pt = masked_pointwise(fcst, actual)
     ok_scale = jnp.isfinite(scale) & (scale > 0)
     mase_pt = abserr / jnp.where(ok_scale, scale, 1.0)[:, None, None]
     mase_mask = mask & ok_scale[:, None, None]
@@ -232,18 +245,22 @@ def _metric_tables_fn(fcst, actual, half, scale, hs):
 _metric_tables = jax.jit(_metric_tables_fn, static_argnums=(4,))
 
 
-def _naive_scale_fn(values, start, stop):
-    """In-sample one-step naive MAE over the fit window (the MASE
-    denominator; non-seasonal m=1 scaling), NaN pairs masked."""
+def _naive_scale_fn(values, start, stop, m_period):
+    """In-sample naive MAE over the fit window (the MASE denominator),
+    NaN pairs masked.  ``m_period = 1`` is the classic lag-1 scaling;
+    ``m_period = m`` scales by the *seasonal*-naive forecast
+    ``|y_t - y_{t-m}|`` (Hyndman & Koehler's seasonal MASE), so seasonal
+    panels aren't judged against a denominator their seasonality
+    inflates."""
     w = values[:, start:stop]
-    d1 = w[:, 1:] - w[:, :-1]
+    d1 = w[:, m_period:] - w[:, :-m_period]
     m = jnp.isfinite(d1)
     cnt = jnp.sum(m, axis=1)
     s = jnp.sum(jnp.where(m, jnp.abs(d1), 0.0), axis=1)
     return jnp.where(cnt > 0, s / jnp.maximum(cnt, 1), jnp.nan)
 
 
-_naive_scale = jax.jit(_naive_scale_fn, static_argnums=(1, 2))
+_naive_scale = jax.jit(_naive_scale_fn, static_argnums=(1, 2, 3))
 
 
 # ---------------------------------------------------------------------------
@@ -268,7 +285,8 @@ def _seeded_initial(ssm, meta0, family: str, diffed):
 
 def evaluate_candidate(values, model, schedule, horizons, *,
                        replay: str = "pinned",
-                       coverage: float = 0.9) -> CandidateEval:
+                       coverage: float = 0.9,
+                       mase_m: int = 1) -> CandidateEval:
     """Score one fitted candidate over a panel's rolling origins.
 
     ``values (S, n)`` the raw panel; ``model`` the candidate's batched
@@ -278,11 +296,16 @@ def evaluate_candidate(values, model, schedule, horizons, *,
     ``horizons`` the 1-based steps the scores average.  ``replay``:
     ``"pinned"`` (the O(log n) production path) or ``"refilter"`` (the
     sequential per-origin oracle).  ``coverage`` sets the nominal level
-    of the interval-coverage metric.
+    of the interval-coverage metric; ``mase_m`` the MASE scaling period
+    (1 = lag-1 naive, the default; a seasonal period scales by the
+    seasonal-naive in-sample MAE instead).
     """
     if replay not in ("pinned", "refilter"):
         raise ValueError(f"unknown replay mode {replay!r}; expected "
                          f"'pinned' or 'refilter'")
+    mase_m = int(mase_m)
+    if mase_m < 1:
+        raise ValueError(f"mase_m must be a period >= 1, got {mase_m}")
     vals = jnp.asarray(values)
     if vals.ndim != 2:
         raise ValueError(f"evaluate_candidate needs an (n_series, n_obs) "
@@ -352,7 +375,12 @@ def evaluate_candidate(values, model, schedule, horizons, *,
         idx = origins[:, None] + np.arange(H)[None, :]        # (O, H)
         actual = vals[:, jnp.asarray(idx)]                    # (S, O, H)
         fs, ft = schedule.fit_window()
-        scale = _naive_scale(vals, int(fs), int(ft))
+        if ft - fs <= mase_m:
+            raise ValueError(
+                f"mase_m={mase_m} leaves no seasonal-naive pair in the "
+                f"[{fs}, {ft}) fit window — shrink the period or widen "
+                f"the window")
+        scale = _naive_scale(vals, int(fs), int(ft), mase_m)
         tabs = _metric_tables(fcst, actual, half, scale, hs)
 
     (smape_tab, mase_tab, rmse_tab, cover_tab, score_smape, score_mase,

@@ -17,19 +17,26 @@ Layout: :mod:`ssm` (representation + filter-state pytrees), :mod:`kalman`
 (the step/scan/parallel-prefix filters and likelihood accumulation),
 :mod:`convert` (fitted model → state-space form + bootstrap calibration),
 :mod:`health` (per-lane in-graph divergence detection + quarantine),
+:mod:`quality` (the live forecast-quality plane: per-tick anomaly
+scores, rolling online accuracy off a device-resident forecast ring,
+Page-Hinkley drift alarms — fused into the same jitted tick),
 :mod:`serving` (warm sessions, tick ingest, lane healing,
 checkpoint/restore), :mod:`fleet` (the multi-tenant front-end:
 admission control, tick coalescing onto the shared executables,
 SLO-aware shedding, checkpoint-based lane migration).
 """
 
-from . import convert, fleet, health, kalman, serving, ssm  # noqa: F401
+from . import (convert, fleet, health, kalman, quality,  # noqa: F401
+               serving, ssm)
 from .fleet import (AdmissionPolicy, FleetRestoreMismatch,  # noqa: F401
                     FleetSaturated, FleetScheduler)
 from .convert import Bootstrapped, bootstrap, to_statespace  # noqa: F401
-from .health import (LANE_DIVERGED, LANE_OK, LANE_SUSPECT,  # noqa: F401
-                     HealthPolicy, LaneHealth, initial_health,
-                     monitor_panel, monitored_step, shed_priority)
+from .health import (LANE_DIVERGED, LANE_DRIFTED, LANE_OK,  # noqa: F401
+                     LANE_SUSPECT, HealthPolicy, LaneHealth,
+                     initial_health, monitor_panel, monitored_step,
+                     shed_priority)
+from .quality import (QualityPolicy, QualityState,  # noqa: F401
+                      initial_quality, quality_panel, quality_step)
 from .kalman import (FilterResult, concentrated_loglik,  # noqa: F401
                      filter_forecast_origin, filter_panel,
                      filter_panel_parallel, filter_step_panel,
@@ -40,7 +47,7 @@ from .ssm import (FilterState, SSMeta, StateSpace,  # noqa: F401
                   initial_state, state_nbytes)
 
 __all__ = [
-    "ssm", "kalman", "convert", "health", "serving", "fleet",
+    "ssm", "kalman", "convert", "health", "quality", "serving", "fleet",
     "StateSpace", "SSMeta", "FilterState", "initial_state", "state_nbytes",
     "filter_step_panel", "filter_panel", "filter_panel_parallel",
     "filter_forecast_origin", "forecast_mean",
@@ -49,7 +56,9 @@ __all__ = [
     "to_statespace", "bootstrap", "Bootstrapped",
     "HealthPolicy", "LaneHealth", "initial_health",
     "monitored_step", "monitor_panel",
-    "LANE_OK", "LANE_SUSPECT", "LANE_DIVERGED",
+    "LANE_OK", "LANE_SUSPECT", "LANE_DIVERGED", "LANE_DRIFTED",
+    "QualityPolicy", "QualityState", "initial_quality",
+    "quality_step", "quality_panel",
     "ServingSession", "TickResult", "start_session",
     "ServingRestoreMismatch", "shed_priority",
     "FleetScheduler", "AdmissionPolicy", "FleetSaturated",

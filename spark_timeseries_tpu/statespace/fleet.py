@@ -472,7 +472,7 @@ class FleetScheduler:
         import jax
         import jax.numpy as jnp
 
-        bucket, _dtype, meta, policy = key
+        bucket, _dtype, meta, policy, quality = key
         G = len(members)
         slots = _slots_for(G)
         prepped = []
@@ -503,6 +503,13 @@ class FleetScheduler:
             gather, *(p[0].session._state for p in prepped))
         health = jax.tree_util.tree_map(
             gather, *(p[0].session._health for p in prepped))
+        qstate = None
+        if quality is not None:
+            # the quality carry gathers/scatters lane-wise exactly like
+            # state/health (the QualityState leaves are all batched on
+            # the series axis by construction)
+            qstate = jax.tree_util.tree_map(
+                gather, *(p[0].session._qstate for p in prepped))
         y_all = np.full((slots * bucket,), np.nan,
                         prepped[0][0].session._dtype)
         off_all = np.zeros_like(y_all)
@@ -513,8 +520,9 @@ class FleetScheduler:
         fn = _jitted("update")
         t0 = time.perf_counter()
         with _metrics.span("fleet.coalesced_step"):
-            state2, health2, v, f, ll_inc = fn(meta, policy, ssm, state,
-                                               health, y_all, off_all)
+            state2, health2, qstate2, v, f, ll_inc, anom = fn(
+                meta, policy, quality, ssm, state, health, qstate,
+                y_all, off_all)
             outs = []
             for i, (m, host, _, _) in enumerate(prepped):
                 lo = i * bucket
@@ -525,7 +533,9 @@ class FleetScheduler:
                     np.asarray(v[lo:lo + n]),
                     np.asarray(f[lo:lo + n]),
                     np.asarray(ll_inc[lo:lo + n]),
-                    np.asarray(health2.status[lo:lo + n])))
+                    np.asarray(health2.status[lo:lo + n]),
+                    np.asarray(anom[lo:lo + n]),
+                    np.asarray(health2.ew[lo:lo + n])))
         dt = time.perf_counter() - t0
 
         def take(i):
@@ -535,8 +545,10 @@ class FleetScheduler:
         for i, (m, host, _, _) in enumerate(prepped):
             sub_state = jax.tree_util.tree_map(take(i), state2)
             sub_health = jax.tree_util.tree_map(take(i), health2)
+            sub_q = jax.tree_util.tree_map(take(i), qstate2) \
+                if quality is not None else None
             m.session._absorb_tick(host, sub_state, sub_health, outs[i],
-                                   dt)
+                                   dt, sub_q)
             m.ticks_dispatched += 1
         self._reg.inc("fleet.coalesced_dispatches")
         self._reg.inc("fleet.coalesced_ticks", G)
@@ -567,7 +579,7 @@ class FleetScheduler:
 
         fn = _jitted("update")
         for key, labels in self._groups.items():
-            bucket, _dtype, meta, policy = key
+            bucket, _dtype, meta, policy, quality = key
             members = [self._tenants[la] for la in labels]
             members[0].session.warmup()         # the replay-lane program
             sizes = {len(members)}
@@ -591,12 +603,17 @@ class FleetScheduler:
                     gather, *(m.session._state for m in srcs))
                 health = jax.tree_util.tree_map(
                     gather, *(m.session._health for m in srcs))
+                qstate = None
+                if quality is not None:
+                    qstate = jax.tree_util.tree_map(
+                        gather, *(m.session._qstate for m in srcs))
                 y = np.full((slots * bucket,), np.nan,
                             srcs[0].session._dtype)
                 off = np.zeros_like(y)
                 with _metrics.span("fleet.warmup"):
-                    state2, health2, v, f, ll = fn(meta, policy, ssm,
-                                                   state, health, y, off)
+                    state2, health2, q2, v, f, ll, anom = fn(
+                        meta, policy, quality, ssm, state, health,
+                        qstate, y, off)
                     for i, m in enumerate(srcs):
                         lo = i * bucket
                         n = m.n_series
@@ -604,6 +621,8 @@ class FleetScheduler:
                         np.asarray(f[lo:lo + n])
                         np.asarray(ll[lo:lo + n])
                         np.asarray(health2.status[lo:lo + n])
+                        np.asarray(anom[lo:lo + n])
+                        np.asarray(health2.ew[lo:lo + n])
                         # the scatter-back slice programs
                         jax.tree_util.tree_map(
                             lambda leaf, lo=lo: np.asarray(
@@ -611,6 +630,14 @@ class FleetScheduler:
                         jax.tree_util.tree_map(
                             lambda leaf, lo=lo: np.asarray(
                                 leaf[lo:lo + bucket]), health2)
+                        if quality is not None:
+                            np.asarray(q2.ew_smape[lo:lo + n])
+                            np.asarray(q2.ew_mase[lo:lo + n])
+                            np.asarray(q2.ew_cover[lo:lo + n])
+                            np.asarray(q2.n_scored[lo:lo + n])
+                            jax.tree_util.tree_map(
+                                lambda leaf, lo=lo: np.asarray(
+                                    leaf[lo:lo + bucket]), q2)
 
     # -- SLO shedding -------------------------------------------------------
 

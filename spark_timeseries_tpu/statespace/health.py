@@ -13,7 +13,10 @@ failure state machine ``utils.resilience`` built for batch
 instead of per fit.
 
 Three signals feed one per-lane status in the ``ok(0) < suspect(1) <
-diverged(2)`` lattice:
+diverged(2)`` lattice (the quality plane — ``statespace.quality`` —
+extends it with a fourth code, ``drifted(3)``: numerically out of band
+on *accuracy* but still finite, so the lane keeps serving while flagged
+for refit; see that module for the escalation semantics):
 
 - **standardized-innovation tracking**: for a well-specified lane the
   standardized innovation ``ν²/F`` is χ²₁ (mean 1, variance 2).  An
@@ -55,17 +58,24 @@ from jax import lax
 from .kalman import filter_step_panel
 from .ssm import FilterState, SSMeta, StateSpace
 
-__all__ = ["LANE_OK", "LANE_SUSPECT", "LANE_DIVERGED", "LANE_NAMES",
-           "HealthPolicy", "LaneHealth", "initial_health",
+__all__ = ["LANE_OK", "LANE_SUSPECT", "LANE_DIVERGED", "LANE_DRIFTED",
+           "LANE_NAMES", "HealthPolicy", "LaneHealth", "initial_health",
            "monitored_step", "monitor_panel", "shed_priority"]
 
 LANE_OK = 0        # EW standardized-innovation score inside the χ² band
 LANE_SUSPECT = 1   # score out of band but finite — advisory, self-clears
 LANE_DIVERGED = 2  # non-finite state/covariance or score far out of
 #                    band — sticky; quarantined (predict-only) until heal
+LANE_DRIFTED = 3   # the quality plane's drift detector alarmed: the lane
+#                    serves on (never quarantined) but its online error
+#                    has sustainedly left the fit-time baseline — sticky
+#                    until heal(drifted=True) refits it.  Severity sits
+#                    between suspect and diverged; the code is 3 (not
+#                    renumbering diverged) so pre-quality checkpoints
+#                    stay restorable.
 
 LANE_NAMES = {LANE_OK: "ok", LANE_SUSPECT: "suspect",
-              LANE_DIVERGED: "diverged"}
+              LANE_DIVERGED: "diverged", LANE_DRIFTED: "drifted"}
 
 
 class HealthPolicy(NamedTuple):
@@ -174,17 +184,22 @@ def monitored_step(ssm: StateSpace, state: FilterState,
     return state2, LaneHealth(ew, status, good_a, good_ring), (v, F)
 
 
-def shed_priority(status) -> Tuple[int, int]:
+def shed_priority(status) -> Tuple[int, int, int]:
     """The fleet shed ladder's per-tenant rank over a lane-status vector:
-    ``(n_diverged, n_suspect)``, compared lexicographically descending —
-    tenants whose lanes are already diverged (quarantined, serving NaN or
-    last-good anyway) shed first under SLO pressure, then suspect-laden
-    tenants, and fully healthy tenants only last.  Pure host math; the
-    scheduler sorts on this (label as the deterministic tie-break)."""
+    ``(n_diverged, n_drifted, n_suspect)``, compared lexicographically
+    descending — tenants whose lanes are already diverged (quarantined,
+    serving NaN or last-good anyway) shed first under SLO pressure, then
+    drift-flagged tenants (persistently inaccurate — a cached forecast
+    serves them no worse than their drifted model does), then
+    suspect-laden tenants, and fully healthy tenants only last — the
+    ``ok < suspect < drifted < diverged`` severity order, applied.  Pure
+    host math; the scheduler sorts on this (label as the deterministic
+    tie-break)."""
     import numpy as np
 
     s = np.asarray(status)
     return (int(np.sum(s == LANE_DIVERGED)),
+            int(np.sum(s == LANE_DRIFTED)),
             int(np.sum(s == LANE_SUSPECT)))
 
 

@@ -1,0 +1,347 @@
+"""Live forecast-quality plane: per-tick anomaly scores, rolling online
+accuracy, and drift alarms — fused into the serving tick.
+
+The serving tier (§7) made ingest O(1) and the health tier (§7/§9 —
+``statespace.health``) made *numerical* failure observable, but accuracy
+stayed an **offline** fact: the backtest tier (§9) scores a model before
+it serves, and nothing watches whether a serving model's forecasts are
+still any good once traffic flows.  ARIMA_PLUS (PAPERS.md, arXiv
+2510.24452) treats "forecast + explain + flag anomalies, automatically"
+as the product surface; this module is that surface for the serving
+tier, with **zero new per-tick device dispatches** — everything below is
+array math fused into the same single jitted update the session already
+runs (``serving._update_impl``), so the warmed-tick 0-recompile pin
+holds with quality armed (pinned by test).
+
+Three signals per lane, per tick:
+
+- **anomaly score** — the standardized innovation ``ν/√F`` (signed) and
+  its EW aggregate (``LaneHealth.ew``, the EW mean of ``ν²/F`` the χ²
+  health band already tracks).  Both are promoted onto
+  :class:`~spark_timeseries_tpu.statespace.serving.TickResult`
+  (``anomaly`` / ``anomaly_ew``) instead of staying an internal lattice
+  input: for a well-specified lane ``ν/√F ~ N(0, 1)``, so the score IS
+  a per-tick z-score users can threshold/alert on directly.  NaN on
+  missing and quarantined (predict-only) ticks.
+- **rolling online accuracy** — the session keeps a bounded
+  device-resident ring of its own ``horizon``-step-ahead forecasts
+  (:class:`QualityState.fc_ring`, O(horizon) floats per lane).  Each
+  tick the forecast made ``horizon`` ticks ago is scored against the
+  arriving actual with the backtest tier's NaN-masked pointwise
+  definitions (``backtest.evaluate.masked_pointwise`` — sMAPE with
+  0/0 → 0, MASE against the fit-time naive-MAE scale, interval coverage
+  against the model's own ψ-weight half-widths), folded into
+  exponentially-weighted means (``ew_alpha``).  A tick only scores when
+  both the forecast and the actual are finite and the ring is warm.
+- **drift alarm** — a Page-Hinkley detector (one-sided CUSUM) on the
+  standardized-innovation score against its fit-time baseline: for a
+  well-specified lane ``E[ν²/F] = 1``, so ``cusum' = max(0, cusum +
+  ν²/F − 1 − ph_delta)`` drifts down under the null and climbs linearly
+  under a sustained mean/level shift; ``cusum > ph_lambda`` trips a
+  **sticky** ``drifted`` status (``health.LANE_DRIFTED``).  Calibration:
+  χ²₁ steps have variance 2, so the default ``ph_delta = 0.5`` /
+  ``ph_lambda = 50`` put the per-lane false-alarm odds around
+  ``exp(−2·δ·λ/σ²) = e^{−25}`` (Wald's approximation) — a stationary
+  5000-tick 64-lane stream alarms nothing (pinned by test) — while a
+  regime shift of ``k`` innovation standard deviations (score mean
+  ``1 + k²``) alarms after ≈ ``λ/(k² − δ)`` ticks (~30 ticks at
+  k = 1.3).  Drift deliberately catches what the χ² EW band cannot: a
+  shift big enough to matter but too small to ever cross
+  ``diverged_hi`` accumulates here instead of self-clearing as
+  ``suspect``.
+
+The lattice becomes ``ok < suspect < drifted < diverged``: ``drifted``
+lanes keep serving (never quarantined — their forecasts are degraded,
+not garbage) until ``ServingSession.heal(drifted=True)`` refits them
+from the history ring — whose bounded window is by then dominated by
+the post-shift regime — through the batch resilient path with the
+auto-order mini candidate search, splices the recovered lanes back, and
+resets their quality state (fresh MASE scale and coverage half-widths
+from the refit bootstrap).  Post-heal accuracy recovers to a fresh
+fit's (the regime-shift acceptance pin).
+
+ARX caveat: online scoring adds the tick's own exogenous offset to the
+stored forecast, which is exact at ``horizon=1`` (the offset enters the
+observation additively); at ``horizon>1`` intermediate future offsets
+are unknown at forecast time and assumed zero.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from .health import LANE_DIVERGED, LANE_DRIFTED, LaneHealth
+from .kalman import forecast_mean
+from .ssm import FilterState, SSMeta, StateSpace
+
+__all__ = ["QualityPolicy", "QualityState", "initial_quality",
+           "quality_step", "quality_panel", "forecast_half_widths",
+           "naive_scale"]
+
+
+class QualityPolicy(NamedTuple):
+    """Static (hashable) quality knobs — part of the serving update's jit
+    key alongside ``SSMeta``/``HealthPolicy`` (arming quality changes the
+    traced program, so two sessions coalesce only when their quality
+    policies agree).
+
+    ``horizon`` is the online-accuracy lead time (the forecast ring's
+    depth: each tick scores the ``horizon``-step-ahead forecast made
+    ``horizon`` ticks ago); ``ew_alpha`` the EW weight of the online
+    sMAPE/MASE/coverage means; ``ph_delta``/``ph_lambda`` the
+    Page-Hinkley drift allowance and alarm threshold on the
+    standardized-innovation score (see the module docstring for the
+    false-alarm calibration); ``coverage`` the nominal level of the
+    online interval-coverage metric."""
+    horizon: int = 1
+    ew_alpha: float = 0.05
+    ph_delta: float = 0.5
+    ph_lambda: float = 50.0
+    coverage: float = 0.9
+
+    def validate(self) -> "QualityPolicy":
+        if int(self.horizon) < 1:
+            raise ValueError(f"horizon must be >= 1, got {self.horizon}")
+        if not isinstance(self.horizon, int):
+            # the horizon is a static trace parameter (ring width, scan
+            # length) — normalize to a plain int so equal policies hash
+            # equal and the jit key never splits on 2 vs 2.0
+            return self._replace(horizon=int(self.horizon)).validate()
+        if not 0.0 < self.ew_alpha <= 1.0:
+            raise ValueError(f"ew_alpha must be in (0, 1], "
+                             f"got {self.ew_alpha}")
+        if self.ph_delta <= 0 or self.ph_lambda <= 0:
+            raise ValueError(
+                f"ph_delta/ph_lambda must be > 0, got "
+                f"{self.ph_delta}/{self.ph_lambda}")
+        if not 0.0 < self.coverage < 1.0:
+            raise ValueError(f"coverage must be in (0, 1), "
+                             f"got {self.coverage}")
+        return self
+
+
+class QualityState(NamedTuple):
+    """Per-lane quality carry, riding next to ``FilterState`` /
+    ``LaneHealth`` in the session's device buffers — O(horizon) floats
+    per lane, every leaf batched on the series axis so the fleet tier's
+    lane-wise gather/scatter coalescing works unchanged.
+
+    ``fc_ring[:, pos]`` holds the raw-scale ``horizon``-step forecast
+    made for the *current* tick (written ``horizon`` ticks ago); ``pos``
+    cycles 0..horizon−1 and ``warm`` saturates at ``horizon`` (a slot is
+    scoreable only once the ring has wrapped).  ``scale`` is the
+    fit-time lag-1 naive MAE (the MASE denominator — the same definition
+    the backtest tier uses) and ``half`` the model's own ψ-weight
+    ``horizon``-step interval half-width; both are per-lane constants
+    refreshed on heal.  ``ew_*``/``n_scored`` are the EW online metrics;
+    ``ph`` the Page-Hinkley CUSUM and ``drifted`` the sticky alarm
+    flag."""
+    fc_ring: jnp.ndarray    # (S, horizon)
+    pos: jnp.ndarray        # (S,) int32
+    warm: jnp.ndarray       # (S,) int32, saturates at horizon
+    scale: jnp.ndarray      # (S,)
+    half: jnp.ndarray       # (S,)
+    ew_smape: jnp.ndarray   # (S,)
+    ew_mase: jnp.ndarray    # (S,)
+    ew_cover: jnp.ndarray   # (S,)
+    n_scored: jnp.ndarray   # (S,) int32
+    ph: jnp.ndarray         # (S,)
+    drifted: jnp.ndarray    # (S,) bool
+
+
+def initial_quality(n_series: int, policy: QualityPolicy, dtype,
+                    scale, half) -> QualityState:
+    """A cold quality state: empty forecast ring, zeroed EW metrics and
+    drift statistic.  ``scale``/``half`` are the per-lane fit-time
+    baselines (:func:`naive_scale` / :func:`forecast_half_widths`)."""
+    S = int(n_series)
+    zeros = jnp.zeros((S,), dtype)
+    zi = jnp.zeros((S,), jnp.int32)
+    return QualityState(
+        fc_ring=jnp.full((S, int(policy.horizon)), jnp.nan, dtype),
+        pos=zi, warm=zi,
+        scale=jnp.asarray(scale, dtype), half=jnp.asarray(half, dtype),
+        ew_smape=zeros, ew_mase=zeros, ew_cover=zeros,
+        n_scored=zi, ph=zeros, drifted=jnp.zeros((S,), jnp.bool_))
+
+
+def naive_scale(history) -> "jnp.ndarray":
+    """Per-lane lag-1 naive MAE of a raw history window (NaN pairs
+    masked) — the fit-time MASE denominator, matching the backtest
+    tier's default (non-seasonal m=1) scaling.  Host-side NumPy (called
+    once per session start / heal, never per tick); lanes with no
+    finite pair come back NaN and their online MASE never scores."""
+    import numpy as np
+
+    h = np.asarray(history, np.float64)
+    if h.ndim == 1:
+        h = h[None]
+    if h.shape[1] < 2:
+        return np.full((h.shape[0],), np.nan)
+    d1 = h[:, 1:] - h[:, :-1]
+    m = np.isfinite(d1)
+    cnt = m.sum(axis=1)
+    s = np.where(m, np.abs(d1), 0.0).sum(axis=1)
+    return np.where(cnt > 0, s / np.maximum(cnt, 1), np.nan)
+
+
+def forecast_half_widths(ssm: StateSpace, meta: SSMeta, horizon: int,
+                         conf: float) -> jnp.ndarray:
+    """Symmetric ``conf``-level forecast-band half-widths at lead time
+    ``horizon``, per lane, off a **serving-calibrated** state-space form
+    (``convert.bootstrap`` already folded σ² into Q/H — unlike the
+    backtest tier's unit-scale ``_half_widths``, no external σ² rides
+    in).  Same ψ-weight construction as ``backtest.evaluate``: exact
+    mode reads the noise loading off ``Q``'s first column (Harvey form:
+    ``Q = σ²RRᵀ`` with ``R₀ = 1``, so ``σ² = Q[0,0]`` and
+    ``σR = Q[:, 0]/σ``); innovations mode is the single-source-of-error
+    expansion ``ψ₀ = σ, ψ_k = σ·Z T^{k-1} gain``; ``d_order``
+    integrations are cumulative sums of the ψ sequence.  Eager host-side
+    math (once per session start / heal)."""
+    from ..models.base import normal_quantile
+
+    dtype = ssm.T.dtype
+    tiny = jnp.asarray(1e-30, dtype)
+    psis = []
+    if meta.mode == "exact":
+        s2 = jnp.maximum(ssm.Q[:, 0, 0], tiny)
+        x = ssm.Q[:, :, 0] / jnp.sqrt(s2)[:, None]
+        for _ in range(int(horizon)):
+            psis.append(jnp.einsum("sm,sm->s", ssm.Z, x))
+            x = jnp.einsum("smk,sk->sm", ssm.T, x)
+    else:
+        s = jnp.sqrt(jnp.maximum(ssm.H, tiny))
+        x = ssm.gain * s[:, None]
+        psis.append(s)
+        for _ in range(int(horizon) - 1):
+            psis.append(jnp.einsum("sm,sm->s", ssm.Z, x))
+            x = jnp.einsum("smk,sk->sm", ssm.T, x)
+    psi = jnp.stack(psis, axis=-1)                           # (S, H)
+    for _ in range(meta.d_order):
+        psi = jnp.cumsum(psi, axis=-1)
+    var = jnp.cumsum(psi * psi, axis=-1)[:, int(horizon) - 1]
+    return normal_quantile(float(conf), dtype) * jnp.sqrt(var)
+
+
+def quality_step(policy: QualityPolicy, meta: SSMeta, ssm: StateSpace,
+                 state2: FilterState, health2: LaneHealth,
+                 qstate: QualityState, y: jnp.ndarray,
+                 offset: jnp.ndarray, v: jnp.ndarray, f: jnp.ndarray
+                 ) -> Tuple[LaneHealth, QualityState]:
+    """One quality tick across the panel, fused into the serving update
+    (``policy``/``meta`` static; called from ``serving._update_impl``
+    right after ``health.monitored_step``).
+
+    ``state2``/``health2`` are the post-filter carries, ``v``/``f`` the
+    tick's innovations and variances.  Scores the ring's due forecast
+    against ``y``, folds the EW online metrics, advances the
+    Page-Hinkley statistic, overlays the sticky ``drifted`` status onto
+    the lane lattice (never demoting ``diverged``), and writes the next
+    ``horizon``-step forecast into the freed ring slot.  Returns
+    ``(health', qstate')``.
+    """
+    from ..backtest.evaluate import masked_pointwise
+
+    dtype = y.dtype
+    H = policy.horizon          # static (validated int ≥ 1)
+    S = y.shape[0]
+    rows = jnp.arange(S)
+
+    # v is NaN exactly on missing and quarantined (predict-only) ticks
+    observed = jnp.isfinite(v) & jnp.isfinite(f) & (f > 0)
+    score = jnp.where(observed, v * v / f, jnp.zeros((), dtype))
+
+    # -- score the forecast made `horizon` ticks ago against this tick.
+    # The stored forecast omitted exogenous offsets (unknown at forecast
+    # time); the arriving tick's own offset enters the observation
+    # additively, so adding it back is exact at horizon 1.
+    fc_due = qstate.fc_ring[rows, qstate.pos] + offset
+    ring_warm = qstate.warm >= H
+    mask, abserr, smape_pt = masked_pointwise(
+        jnp.where(ring_warm, fc_due, jnp.asarray(jnp.nan, dtype)),
+        jnp.where(observed, y, jnp.asarray(jnp.nan, dtype)))
+    ok_scale = jnp.isfinite(qstate.scale) & (qstate.scale > 0)
+    mase_pt = abserr / jnp.where(ok_scale, qstate.scale,
+                                 jnp.ones((), dtype))
+    cover_pt = (abserr <= qstate.half).astype(dtype)
+
+    beta = jnp.asarray(policy.ew_alpha, dtype)
+
+    def ew_fold(ew, pt, m):
+        # seed on each metric's OWN first valid point (a NaN-scale lane
+        # must never seed its MASE with an unscaled error)
+        first = m & (qstate.n_scored == 0)
+        upd = (1.0 - beta) * ew + beta * pt
+        return jnp.where(first, pt, jnp.where(m, upd, ew))
+
+    ew_smape = ew_fold(qstate.ew_smape, smape_pt, mask)
+    ew_mase = ew_fold(qstate.ew_mase, mase_pt, mask & ok_scale)
+    ew_cover = ew_fold(qstate.ew_cover, cover_pt, mask)
+    n_scored = qstate.n_scored + mask.astype(jnp.int32)
+
+    # -- Page-Hinkley drift statistic on the standardized-innovation
+    # score vs its fit-time baseline E[ν²/F] = 1 (holds on unscored
+    # ticks; sticky alarm — only heal resets it)
+    delta = jnp.asarray(policy.ph_delta, dtype)
+    ph = jnp.where(observed,
+                   jnp.maximum(jnp.zeros((), dtype),
+                               qstate.ph + score - 1.0 - delta),
+                   qstate.ph)
+    drifted = qstate.drifted | (ph > jnp.asarray(policy.ph_lambda, dtype))
+
+    status = health2.status
+    status = jnp.where((status != LANE_DIVERGED) & drifted,
+                       LANE_DRIFTED, status).astype(jnp.int32)
+
+    # -- write the next horizon-step forecast into the slot just scored
+    # (raw scale, integrated through the post-update difference ring)
+    fc_new = forecast_mean(meta, H, ssm, state2.a, state2.ring,
+                           jnp.zeros((S, H), dtype))[:, H - 1]
+    qstate2 = QualityState(
+        fc_ring=qstate.fc_ring.at[rows, qstate.pos].set(fc_new),
+        pos=(qstate.pos + 1) % H,
+        warm=jnp.minimum(qstate.warm + 1, H),
+        scale=qstate.scale, half=qstate.half,
+        ew_smape=ew_smape, ew_mase=ew_mase, ew_cover=ew_cover,
+        n_scored=n_scored, ph=ph, drifted=drifted)
+    return health2._replace(status=status), qstate2
+
+
+def quality_panel(ssm: StateSpace, state: FilterState,
+                  health: LaneHealth, qstate: QualityState,
+                  ys: jnp.ndarray, meta: SSMeta, policy, quality,
+                  offsets: Optional[jnp.ndarray] = None
+                  ) -> Tuple[FilterState, LaneHealth, QualityState]:
+    """Stream a whole ``(S, n)`` tick panel through the fused
+    monitored + quality step as one ``lax.scan`` — the bulk driver for
+    drift-calibration / false-alarm testing (5000 stationary ticks in
+    one dispatch instead of 5000 host round-trips), with semantics
+    identical to per-tick ``ServingSession.update`` calls."""
+    from .health import monitored_step
+
+    ys = jnp.asarray(ys)
+    rows = int(state.a.shape[0])
+    if ys.ndim != 2 or int(ys.shape[0]) != rows:
+        raise ValueError(
+            f"quality_panel expects a (S, n) tick panel with S == the "
+            f"filter state's {rows} bucketed lanes, got shape "
+            f"{tuple(ys.shape)}; pad the panel to the session bucket "
+            f"(or transpose a time-major stream) first")
+    offs = jnp.zeros_like(ys) if offsets is None \
+        else jnp.asarray(offsets, ys.dtype)
+
+    def step(carry, inp):
+        st, h, q = carry
+        y, off = inp
+        st2, h2, (v, f) = monitored_step(ssm, st, h, y, off, meta,
+                                         policy)
+        h3, q2 = quality_step(quality, meta, ssm, st2, h2, q, y, off,
+                              v, f)
+        return (st2, h3, q2), None
+
+    (fs, fh, fq), _ = lax.scan(step, (state, health, qstate),
+                               (ys.T, offs.T))
+    return fs, fh, fq

@@ -25,12 +25,23 @@ device buffers) and makes ingest a single cached-executable Kalman step:
   their forecasts read NaN (or last-good, per policy) — so one poisoned
   lane can never leak garbage into the panel's accumulators or its own
   downstream consumers.
-- :meth:`heal` — refit quarantined lanes from the session's bounded
-  per-lane history ring through the batch resilient path
-  (``engine.fit_resilient``, auto-order fallback included) and splice
-  the recovered state-space lanes back in; the session keeps serving
-  throughout.  Counters: ``serving.diverged`` / ``serving.quarantined``
-  / ``serving.healed``.
+- **forecast quality** (``statespace.quality``, fused into the same
+  jitted step when ``quality=QualityPolicy()`` arms it): the per-tick
+  anomaly score ``ν/√F`` and its EW aggregate ride on every
+  :class:`TickResult`; a bounded device-resident ring of the session's
+  own h-step forecasts scores arriving actuals with the backtest tier's
+  NaN-masked sMAPE/MASE/coverage definitions into EW online-accuracy
+  means; and a Page-Hinkley drift detector on the
+  standardized-innovation score extends the lane lattice with a sticky
+  ``drifted`` status — accuracy decay that never trips the χ² band
+  still pages, and ``heal(drifted=True)`` closes the loop.
+- :meth:`heal` — refit quarantined (and, with ``drifted=True``,
+  drift-flagged) lanes from the session's bounded per-lane history ring
+  through the batch resilient path (``engine.fit_resilient``,
+  auto-order fallback included) and splice the recovered state-space
+  lanes back in; the session keeps serving throughout.  Counters:
+  ``serving.diverged`` / ``serving.quarantined`` / ``serving.healed``
+  / ``serving.drift_alarms``.
 - :meth:`forecast` — h-step point forecasts straight off the filtered
   state (mean propagation + d-order integration through the raw
   difference ring), one cached executable per horizon.
@@ -65,8 +76,11 @@ from ..utils import metrics as _metrics
 from ..utils import resilience as _resilience
 from ..utils import telemetry as _telemetry
 from .convert import Bootstrapped, bootstrap
-from .health import (LANE_DIVERGED, LANE_NAMES, LANE_OK, HealthPolicy,
-                     LaneHealth, initial_health, monitored_step)
+from .health import (LANE_DIVERGED, LANE_DRIFTED, LANE_NAMES, LANE_OK,
+                     HealthPolicy, LaneHealth, initial_health,
+                     monitored_step)
+from .quality import (QualityPolicy, QualityState, forecast_half_widths,
+                      initial_quality, naive_scale, quality_step)
 from .ssm import FilterState, SSMeta, StateSpace, state_nbytes
 
 __all__ = ["ServingSession", "TickResult", "start_session",
@@ -133,12 +147,20 @@ class TickResult(NamedTuple):
     """One :meth:`ServingSession.update`'s per-series outcome (real lanes
     only): the innovations ``v`` (NaN where the tick was missing or the
     lane is quarantined), their predictive variances ``F``, the
-    per-series log-likelihood increment of the tick, and the per-lane
-    health ``status`` (``health.LANE_OK/SUSPECT/DIVERGED``)."""
+    per-series log-likelihood increment of the tick, the per-lane
+    health ``status`` (``health.LANE_OK/SUSPECT/DIVERGED/DRIFTED``),
+    and the user-facing anomaly surface: ``anomaly`` is the signed
+    standardized innovation ``ν/√F`` (≈ N(0, 1) on a well-specified
+    lane — a per-tick z-score; NaN on missing/quarantined ticks) and
+    ``anomaly_ew`` its EW aggregate (the χ² health band's own EW mean
+    of ``ν²/F``, χ²₁-mean-1 at stationarity), both computed in-graph
+    inside the same fused update."""
     innovations: np.ndarray
     variances: np.ndarray
     loglik_inc: np.ndarray
     status: np.ndarray
+    anomaly: np.ndarray
+    anomaly_ew: np.ndarray
 
 
 # ---------------------------------------------------------------------------
@@ -146,16 +168,29 @@ class TickResult(NamedTuple):
 # every session shares jax's jit cache — the STS006 discipline)
 # ---------------------------------------------------------------------------
 
-def _update_impl(meta: SSMeta, policy: HealthPolicy, ssm: StateSpace,
-                 state: FilterState, health: LaneHealth, y, offset):
+def _update_impl(meta: SSMeta, policy: HealthPolicy,
+                 quality: Optional[QualityPolicy], ssm: StateSpace,
+                 state: FilterState, health: LaneHealth,
+                 qstate: Optional[QualityState], y, offset):
     """The whole per-tick program: one health-monitored Kalman step
     (``health.monitored_step`` — filter + χ²-band tracking + non-finite
-    detection + in-graph quarantine of diverged lanes), single-jitted
-    with ``meta``/``policy`` static."""
+    detection + in-graph quarantine of diverged lanes), the per-tick
+    anomaly score, and — when ``quality`` arms it — the fused
+    forecast-quality step (``quality.quality_step``: online-accuracy
+    scoring off the forecast ring, Page-Hinkley drift, the ``drifted``
+    status overlay), single-jitted with ``meta``/``policy``/``quality``
+    static.  ``qstate`` is None exactly when ``quality`` is (the static
+    policy selects the traced structure)."""
+    import jax.numpy as jnp
+
     state2, health2, (v, f) = monitored_step(ssm, state, health, y,
                                              offset, meta, policy)
     ll_inc = state2.loglik - state.loglik
-    return state2, health2, v, f, ll_inc
+    anom = v / jnp.sqrt(f)
+    if quality is not None:
+        health2, qstate = quality_step(quality, meta, ssm, state2,
+                                       health2, qstate, y, offset, v, f)
+    return state2, health2, qstate, v, f, ll_inc, anom
 
 
 def _forecast_impl(meta: SSMeta, horizon: int, policy: HealthPolicy,
@@ -199,7 +234,7 @@ def _jitted(kind: str):
             from ..engine import configure_compile_cache
             configure_compile_cache()
             if kind == "update":
-                fn = jax.jit(_update_impl, static_argnums=(0, 1))
+                fn = jax.jit(_update_impl, static_argnums=(0, 1, 2))
             else:
                 fn = jax.jit(_forecast_impl, static_argnums=(0, 1, 2))
             _jit_cache[kind] = fn
@@ -262,6 +297,8 @@ class ServingSession:
                  heal_spec: Optional[Dict[str, Any]] = None,
                  history_ring: int = DEFAULT_HISTORY_RING,
                  history_tail=None, _hist_state=None,
+                 quality: Optional[QualityPolicy] = None,
+                 _qstate: Optional[QualityState] = None,
                  label: Optional[str] = None):
         from ..engine import series_bucket
 
@@ -315,11 +352,47 @@ class ServingSession:
         self._tick_lat: deque = deque(maxlen=TICK_LATENCY_WINDOW)
         self._slo_ms = _serving_slo_ms()
         self._slo_burns = 0
+        # forecast-quality plane (docs/design.md §7d): arming it fuses
+        # the online-accuracy + drift step into the SAME jitted update
+        # (the quality policy joins the executable's static key); the
+        # MASE scale comes from the seeded history ring's tail and the
+        # coverage half-width from the calibrated ssm's own ψ weights
+        self._quality = quality.validate() if quality is not None \
+            else None
+        self._drift_alarms = 0
+        self._q_host: Optional[Dict[str, np.ndarray]] = None
+        if _qstate is not None:
+            self._qstate: Optional[QualityState] = _qstate
+        elif self._quality is not None:
+            self._qstate = self._initial_qstate()
+        else:
+            self._qstate = None
         _telemetry.register_session(self)
         _telemetry.ensure_started_from_env()
         self._reg.inc("serving.sessions")
-        self._reg.set_gauge("serving.state_bytes",
-                            state_nbytes((self._state, self._health)))
+        self._reg.set_gauge(
+            "serving.state_bytes",
+            state_nbytes((self._state, self._health, self._qstate)))
+
+    def _initial_qstate(self) -> QualityState:
+        """A cold bucket-width quality state: MASE scale from the seeded
+        history ring (NaN — never scoring — when the session started
+        without history), coverage half-widths from the calibrated
+        ssm's ψ weights.  Pad lanes replicate lane 0 (harmless: their
+        ticks are always NaN, so they never score or drift)."""
+        q = self._quality
+        hist = self._ring_history()
+        if hist.shape[1] >= 2:
+            scale = naive_scale(hist)
+        else:
+            scale = np.full((self.n_series,), np.nan)
+        half = np.asarray(forecast_half_widths(
+            self._ssm, self.meta, q.horizon, q.coverage))  # bucket-wide
+        scale_b = np.full((self._bucket,), np.nan, np.float64)
+        scale_b[:self.n_series] = scale
+        scale_b[self.n_series:] = scale[0] if scale.size else np.nan
+        return initial_quality(self._bucket, q, self._dtype, scale_b,
+                               half)
 
     # -- construction -------------------------------------------------------
 
@@ -327,6 +400,7 @@ class ServingSession:
     def start(cls, model, history, *, offsets=None, registry=None,
               policy: Optional[HealthPolicy] = None,
               history_ring: int = DEFAULT_HISTORY_RING,
+              quality: Optional[QualityPolicy] = None,
               label: Optional[str] = None) -> "ServingSession":
         """Open a session from a fitted model pytree and the history it
         was fitted on: converts to state-space form
@@ -337,7 +411,9 @@ class ServingSession:
         ``policy`` tunes the health monitor (χ² band, Joseph form,
         quarantined-forecast policy); ``history_ring`` bounds the
         per-lane raw-tick ring :meth:`heal` refits from (seeded with the
-        history's tail).
+        history's tail); ``quality=QualityPolicy()`` arms the fused
+        forecast-quality plane (online accuracy, anomaly gauges, drift
+        alarms — docs/design.md §7d).
         """
         import jax.numpy as jnp
 
@@ -348,7 +424,7 @@ class ServingSession:
         return cls(boot.ssm, boot.meta, boot.state, history.shape[0],
                    ticks_seen=int(history.shape[1]), registry=registry,
                    policy=policy, heal_spec=_heal_spec_for(model),
-                   history_ring=history_ring,
+                   history_ring=history_ring, quality=quality,
                    history_tail=np.asarray(history), label=label)
 
     # -- serving ------------------------------------------------------------
@@ -356,14 +432,17 @@ class ServingSession:
     @property
     def update_key(self):
         """The hashable key of this session's per-tick update executable:
-        ``(bucket, dtype, SSMeta, HealthPolicy)`` (the state dim rides
-        inside ``meta.m``; the dtype rides the buffers, and mixing it
-        would silently promote a coalesced batch).  Sessions with equal
-        keys share ONE compiled program through the module-level jit
-        cache — the fact the fleet tier's tick coalescing exploits
+        ``(bucket, dtype, SSMeta, HealthPolicy, QualityPolicy-or-None)``
+        (the state dim rides inside ``meta.m``; the dtype rides the
+        buffers, and mixing it would silently promote a coalesced batch;
+        arming quality changes the traced program, so quality-on and
+        quality-off sessions never share an executable).  Sessions with
+        equal keys share ONE compiled program through the module-level
+        jit cache — the fact the fleet tier's tick coalescing exploits
         (``statespace.fleet``): same-key ticks can gather into one wider
         device call of the very same traced function."""
-        return (self._bucket, str(self._dtype), self.meta, self.policy)
+        return (self._bucket, str(self._dtype), self.meta, self.policy,
+                self._quality)
 
     def _prepare_tick(self, ticks, offset=None):
         """Validate + pad one tick into the bucket-shaped host buffers
@@ -390,19 +469,23 @@ class ServingSession:
         return host, y, off
 
     def _absorb_tick(self, host, state2, health2, out: TickResult,
-                     dt_s: float) -> TickResult:
-        """Commit one tick's outputs into the session: state/health swap,
-        transition + latency accounting, history-ring push.  ``state2``/
-        ``health2`` are the bucket-width device pytrees (or, from the
-        fleet's coalesced call, that call's per-session slices); ``out``
-        carries the already-materialized real-lane results.  The other
-        half of :meth:`_prepare_tick`; the fleet scheduler calls the
-        pair around its shared device call so coalesced ticks are
-        bitwise the per-session ticks."""
+                     dt_s: float, qstate2=None) -> TickResult:
+        """Commit one tick's outputs into the session: state/health/
+        quality swap, transition + latency accounting, history-ring
+        push.  ``state2``/``health2``/``qstate2`` are the bucket-width
+        device pytrees (or, from the fleet's coalesced call, that call's
+        per-session slices); ``out`` carries the already-materialized
+        real-lane results.  The other half of :meth:`_prepare_tick`; the
+        fleet scheduler calls the pair around its shared device call so
+        coalesced ticks are bitwise the per-session ticks."""
         self._state = state2
         self._health = health2
+        if self._quality is not None and qstate2 is not None:
+            self._qstate = qstate2
         self._note_transitions(out.status)
         self._note_tick_latency(dt_s)
+        if self._quality is not None:
+            self._note_quality(out)
         # the ring normalizes non-finite arrivals to NaN (the filter
         # already degrades inf to a missed tick; a verbatim inf would
         # needlessly poison heal()'s refit window for ring-length ticks)
@@ -434,19 +517,22 @@ class ServingSession:
         fn = _jitted("update")
         t0 = time.perf_counter()
         with _metrics.span("serving.update"):
-            state2, health2, v, f, ll_inc = fn(
-                self.meta, self.policy, self._ssm, self._state,
-                self._health, y, off)
+            state2, health2, qstate2, v, f, ll_inc, anom = fn(
+                self.meta, self.policy, self._quality, self._ssm,
+                self._state, self._health, self._qstate, y, off)
             # materialize inside the span: the p50/p95 the bench gate
             # SLOs must cover the real per-tick latency, not the async
             # dispatch alone
+            n = self.n_series
             out = TickResult(
-                np.asarray(v[:self.n_series]),
-                np.asarray(f[:self.n_series]),
-                np.asarray(ll_inc[:self.n_series]),
-                np.asarray(health2.status[:self.n_series]))
+                np.asarray(v[:n]),
+                np.asarray(f[:n]),
+                np.asarray(ll_inc[:n]),
+                np.asarray(health2.status[:n]),
+                np.asarray(anom[:n]),
+                np.asarray(health2.ew[:n]))
         return self._absorb_tick(host, state2, health2, out,
-                                 time.perf_counter() - t0)
+                                 time.perf_counter() - t0, qstate2)
 
     def update_batch(self, ticks, offsets=None) -> TickResult:
         """Bulk catch-up ingest: ``ticks (n_series, k)`` chronological
@@ -532,6 +618,17 @@ class ServingSession:
             self._reg.set_gauge(
                 "serving.quarantined_lanes",
                 int(np.sum(status == LANE_DIVERGED)))
+        newly_dr = (status == LANE_DRIFTED) \
+            & (self._status_host != LANE_DRIFTED)
+        n_dr = int(newly_dr.sum())
+        if n_dr:
+            # drift alarms: the lane keeps serving, but its accuracy
+            # left the fit-time baseline — pageable, heal-able
+            self._drift_alarms += n_dr
+            self._reg.inc("serving.drift_alarms", n_dr)
+            _metrics.trace_instant(
+                "serving.lane_drifted",
+                {"lanes": n_dr, "tick": int(self.ticks_seen)})
         self._status_host = status.copy()
 
     def _note_tick_latency(self, dt_s: float) -> None:
@@ -563,6 +660,97 @@ class ServingSession:
             f"{pre}.quarantined_lanes",
             int(np.sum(self._status_host == LANE_DIVERGED)))
 
+    def _note_quality(self, out: TickResult) -> None:
+        """Publish the per-tick quality surface: the
+        ``serving.session.<label>.live_smape`` / ``.anomaly_p95`` /
+        ``.drift_alarms`` gauges and the host-side snapshot
+        :meth:`quality_summary` and ``/snapshot.json`` read.  Host-side
+        accounting only — a few tiny device→host slices per tick, all
+        warmed by :meth:`warmup` so the 0-recompile pin holds."""
+        q = self._qstate
+        n = self.n_series
+        self._q_host = {
+            "ew_smape": np.asarray(q.ew_smape[:n]),
+            "ew_mase": np.asarray(q.ew_mase[:n]),
+            "ew_cover": np.asarray(q.ew_cover[:n]),
+            "n_scored": np.asarray(q.n_scored[:n]),
+            "anomaly_ew": out.anomaly_ew,
+        }
+        pre = f"serving.session.{self.label}"
+        # quarantined lanes are excluded from the aggregate: their EW
+        # metrics froze at the (often astronomical) pre-divergence
+        # error, which would let one dead lane mask the live panel's
+        # real accuracy
+        scored = (self._q_host["n_scored"] > 0) \
+            & (out.status != LANE_DIVERGED)
+        if scored.any():
+            self._reg.set_gauge(
+                f"{pre}.live_smape",
+                float(self._q_host["ew_smape"][scored].mean()))
+        fin = np.isfinite(out.anomaly_ew) \
+            & (out.status != LANE_DIVERGED)
+        if fin.any():
+            # the p95 across live lanes of the EW anomaly aggregate
+            # (χ²₁ mean 1 on a healthy panel — a stable paging signal,
+            # unlike the raw per-tick score).  Quarantined lanes are
+            # excluded here exactly as in quality_summary — their EW
+            # froze at the pre-divergence blowup, and the gauge and the
+            # snapshot panel must never disagree about the same metric.
+            self._reg.set_gauge(
+                f"{pre}.anomaly_p95",
+                float(np.percentile(out.anomaly_ew[fin], 95)))
+        self._reg.set_gauge(f"{pre}.drift_alarms", self._drift_alarms)
+        self._reg.set_gauge(f"{pre}.drifted_lanes",
+                            int(np.sum(out.status == LANE_DRIFTED)))
+
+    def quality_summary(self) -> Optional[Dict[str, Any]]:
+        """The forecast-quality panel for this session (None when
+        quality tracking is off): EW online accuracy over the scored
+        lanes, the lane-anomaly p95, and the drift state — exactly what
+        the ``QUALITY`` section of ``/snapshot.json`` / ``sts_top``
+        renders."""
+        if self._quality is None:
+            return None
+        qh = self._q_host
+        if qh is None:          # no tick yet: materialize on demand
+            q = self._qstate
+            n = self.n_series
+            qh = {"ew_smape": np.asarray(q.ew_smape[:n]),
+                  "ew_mase": np.asarray(q.ew_mase[:n]),
+                  "ew_cover": np.asarray(q.ew_cover[:n]),
+                  "n_scored": np.asarray(q.n_scored[:n]),
+                  "anomaly_ew": np.asarray(self._health.ew[:n])}
+        # live lanes only: a quarantined lane's EW metrics froze at its
+        # pre-divergence error (see _note_quality)
+        scored = (qh["n_scored"] > 0) \
+            & (self._status_host != LANE_DIVERGED)
+        ew = qh["anomaly_ew"]
+        fin = np.isfinite(ew) & (self._status_host != LANE_DIVERGED)
+        # lanes with no valid MASE scale (constant or NaN history) score
+        # sMAPE/coverage but their ew_mase never folds — averaging their
+        # 0.0 initialization in would dilute live_mase toward perfect
+        scale = np.asarray(self._qstate.scale[:self.n_series])
+        mase_ok = scored & np.isfinite(scale) & (scale > 0)
+
+        def _mean(key, m=None):
+            m = scored if m is None else m
+            return round(float(qh[key][m].mean()), 4) \
+                if m.any() else None
+
+        return {
+            "horizon": int(self._quality.horizon),
+            "scored_lanes": int(scored.sum()),
+            "scored_ticks": int(qh["n_scored"].sum()),
+            "live_smape": _mean("ew_smape"),
+            "live_mase": _mean("ew_mase", mase_ok),
+            "live_coverage": _mean("ew_cover"),
+            "anomaly_p95": round(float(np.percentile(ew[fin], 95)), 4)
+            if fin.any() else None,
+            "drifted_lanes":
+                int(np.sum(self._status_host == LANE_DRIFTED)),
+            "drift_alarms": int(self._drift_alarms),
+        }
+
     def tick_latency_stats(self) -> Dict[str, Any]:
         """The rolling window's latency summary (ms) — what the labeled
         gauges and ``/snapshot.json`` report."""
@@ -580,8 +768,11 @@ class ServingSession:
 
     def telemetry_summary(self) -> Dict[str, Any]:
         """One scrape-ready dict for the telemetry plane's
-        ``/snapshot.json`` (``utils.telemetry.session_summaries``)."""
-        return {
+        ``/snapshot.json`` (``utils.telemetry.session_summaries``).
+        The ``quality`` sub-dict appears only when quality tracking is
+        armed — consumers (``sts_top``) must render its absence, not
+        KeyError on it."""
+        doc = {
             "label": self.label,
             **self.describe(),
             "health": self.health_counts(),
@@ -589,6 +780,9 @@ class ServingSession:
                 int(np.sum(self._status_host == LANE_DIVERGED)),
             **self.tick_latency_stats(),
         }
+        if self._quality is not None:
+            doc["quality"] = self.quality_summary()
+        return doc
 
     def forecast(self, horizon: int, offsets=None) -> np.ndarray:
         """``(n_series, horizon)`` point forecasts from the current
@@ -623,15 +817,25 @@ class ServingSession:
         off = np.zeros((self._bucket,), self._dtype)
         fn = _jitted("update")
         with _metrics.span("serving.warmup"):
-            _, health2, v, f, ll = fn(self.meta, self.policy, self._ssm,
-                                      self._state, self._health, y, off)
+            _, health2, q2, v, f, ll, anom = fn(
+                self.meta, self.policy, self._quality, self._ssm,
+                self._state, self._health, self._qstate, y, off)
             # also warm the real-lane result slices update materializes
             # (tiny per-(bucket, n_series) device programs of their own —
             # without this the first tick would compile them)
-            np.asarray(v[:self.n_series])
-            np.asarray(f[:self.n_series])
-            np.asarray(ll[:self.n_series])
-            np.asarray(health2.status[:self.n_series])
+            n = self.n_series
+            np.asarray(v[:n])
+            np.asarray(f[:n])
+            np.asarray(ll[:n])
+            np.asarray(health2.status[:n])
+            np.asarray(anom[:n])
+            np.asarray(health2.ew[:n])
+            if self._quality is not None:
+                # the per-tick quality-gauge slices too
+                np.asarray(q2.ew_smape[:n])
+                np.asarray(q2.ew_mase[:n])
+                np.asarray(q2.ew_cover[:n])
+                np.asarray(q2.n_scored[:n])
 
     # -- health + healing ---------------------------------------------------
 
@@ -676,11 +880,17 @@ class ServingSession:
                 & (cols[None, :] <= last_bad[:, None])] = np.nan
         return out
 
-    def heal(self, *, auto_order: bool = True,
-             engine=None) -> Dict[str, Any]:
+    def heal(self, *, auto_order: bool = True, engine=None,
+             drifted: bool = False) -> Dict[str, Any]:
         """Refit every quarantined lane from the bounded history ring
         through the batch resilient path and splice the recovered lanes
-        back into the live session.
+        back into the live session.  ``drifted=True`` additionally
+        refits the quality plane's drift-flagged lanes — by alarm time
+        the bounded ring is dominated by the post-shift regime, so the
+        refit (auto-order mini candidate search included) re-centers the
+        lane on the stream it actually serves, and its quality state
+        (MASE scale, coverage half-width, EW metrics, drift statistic)
+        resets to the new baseline.
 
         The refit is the full §3b machinery — health masking, multi-start
         retry, fallback chains, and (``auto_order=True``, arima) the
@@ -702,9 +912,15 @@ class ServingSession:
         import jax.numpy as jnp
 
         status = self.lane_status
-        rows = np.flatnonzero(status == LANE_DIVERGED)
-        report: Dict[str, Any] = {"quarantined": int(rows.size),
-                                  "healed": 0, "dead": int(rows.size)}
+        mask = status == LANE_DIVERGED
+        n_quarantined = int(mask.sum())
+        report: Dict[str, Any] = {"quarantined": n_quarantined,
+                                  "healed": 0, "dead": 0}
+        if drifted:
+            mask = mask | (status == LANE_DRIFTED)
+            report["drifted"] = int(np.sum(status == LANE_DRIFTED))
+        rows = np.flatnonzero(mask)
+        report["dead"] = int(rows.size)
         if rows.size == 0:
             return report
         if self._heal_spec is None:
@@ -760,6 +976,9 @@ class ServingSession:
                         f"serves {self.meta} — the heal route drifted "
                         f"from the session's family/order")
                 self._splice(healed_rows, boot)
+                if self._quality is not None:
+                    self._reset_quality_lanes(healed_rows, boot,
+                                              sub[ok])
             n_healed = int(healed_rows.size)
             n_dead = int(rows.size - n_healed)
             self._reg.inc("serving.healed", n_healed)
@@ -829,8 +1048,46 @@ class ServingSession:
             good_ring=scatter(h.good_ring, boot.state.ring)
             if self.meta.d_order else h.good_ring)
         self._status_host[rows] = LANE_OK
-        self._reg.set_gauge("serving.state_bytes",
-                            state_nbytes((self._state, self._health)))
+        self._reg.set_gauge(
+            "serving.state_bytes",
+            state_nbytes((self._state, self._health, self._qstate)))
+
+    def _reset_quality_lanes(self, rows: np.ndarray, boot: Bootstrapped,
+                             hist_rows: np.ndarray) -> None:
+        """Re-baseline the quality state of freshly healed lanes: the
+        forecast ring empties (forecasts from the old model must not
+        score the new one), the EW metrics and the drift statistic
+        restart, and the MASE scale / coverage half-width recompute
+        from the refit's own ring history and calibrated ssm — a healed
+        lane is judged against the regime it now serves, not the one it
+        drifted away from.  Off the tick path, like :meth:`_splice`."""
+        import jax.numpy as jnp
+
+        q = self._qstate
+        pol = self._quality
+        idx = jnp.asarray(rows)
+        k = rows.size
+        scale_new = jnp.asarray(naive_scale(hist_rows), q.scale.dtype)
+        half_new = jnp.asarray(
+            forecast_half_widths(boot.ssm, self.meta, pol.horizon,
+                                 pol.coverage), q.half.dtype)
+        fzero = jnp.zeros((k,), q.ew_smape.dtype)
+        izero = jnp.zeros((k,), jnp.int32)
+        self._qstate = QualityState(
+            fc_ring=q.fc_ring.at[idx].set(
+                jnp.asarray(jnp.nan, q.fc_ring.dtype)),
+            pos=q.pos.at[idx].set(izero),
+            warm=q.warm.at[idx].set(izero),
+            scale=q.scale.at[idx].set(scale_new),
+            half=q.half.at[idx].set(half_new),
+            ew_smape=q.ew_smape.at[idx].set(fzero),
+            ew_mase=q.ew_mase.at[idx].set(fzero),
+            ew_cover=q.ew_cover.at[idx].set(fzero),
+            n_scored=q.n_scored.at[idx].set(izero),
+            ph=q.ph.at[idx].set(fzero),
+            drifted=q.drifted.at[idx].set(
+                jnp.zeros((k,), jnp.bool_)))
+        self._q_host = None
 
     # -- introspection ------------------------------------------------------
 
@@ -841,7 +1098,7 @@ class ServingSession:
 
     @property
     def state_bytes(self) -> int:
-        return state_nbytes((self._state, self._health))
+        return state_nbytes((self._state, self._health, self._qstate))
 
     def describe(self) -> dict:
         return {"family": self.meta.family, "mode": self.meta.mode,
@@ -850,6 +1107,8 @@ class ServingSession:
                 "ticks_seen": self.ticks_seen,
                 "state_bytes": self.state_bytes,
                 "history_ring": self._hist_len,
+                "quality_horizon": int(self._quality.horizon)
+                if self._quality is not None else None,
                 "dtype": str(self._dtype)}
 
     # -- persistence --------------------------------------------------------
@@ -875,6 +1134,11 @@ class ServingSession:
             "hist": self._hist,
             "hist_pos": self._hist_pos,
             "hist_fill": self._hist_fill,
+            # quality plane (None when off).  Optional keys, not a
+            # format bump: pre-quality format-2 checkpoints restore as
+            # quality-off sessions — no old checkpoint is orphaned.
+            "quality_policy": self._quality,
+            "qstate": self._qstate,
         }
 
     def checkpoint(self, path: str) -> None:
@@ -954,6 +1218,22 @@ class ServingSession:
         if meta.mode not in ("exact", "innovations"):
             diffs.append(f"  meta.mode: checkpoint={meta.mode!r} vs "
                          f"restoring-process=('exact', 'innovations')")
+        quality = blob.get("quality_policy")
+        qstate = blob.get("qstate")
+        if quality is not None and qstate is not None:
+            qstate = QualityState(*(jnp.asarray(leaf) for leaf in qstate))
+            if int(qstate.fc_ring.shape[0]) != saved_bucket:
+                diffs.append(
+                    f"  qstate.rows: checkpoint="
+                    f"{int(qstate.fc_ring.shape[0])} vs "
+                    f"restoring-process={saved_bucket}")
+            if int(qstate.fc_ring.shape[1]) != int(quality.horizon):
+                diffs.append(
+                    f"  qstate.ring(horizon): checkpoint="
+                    f"{int(qstate.fc_ring.shape[1])} vs "
+                    f"restoring-process={int(quality.horizon)}")
+        else:
+            quality, qstate = None, None
         if diffs:
             raise ServingRestoreMismatch(
                 f"serving checkpoint at {source!r} disagrees with the "
@@ -963,6 +1243,7 @@ class ServingSession:
                    ticks_seen=int(blob["ticks_seen"]), registry=registry,
                    policy=blob["policy"], health=health,
                    heal_spec=blob.get("heal_spec"),
+                   quality=quality, _qstate=qstate,
                    _hist_state=(hist, int(blob["hist_pos"]),
                                 int(blob["hist_fill"])), label=label)
 
@@ -991,7 +1272,8 @@ def _warmup_meta(family: str, p: int, d: int, q: int,
 def warmup_update(family: str = "arima", n_series: int = 1024, *,
                   dtype=None, p: int = 2, d: int = 1, q: int = 2,
                   period: int = 12,
-                  policy: Optional[HealthPolicy] = None) -> dict:
+                  policy: Optional[HealthPolicy] = None,
+                  quality: Optional[QualityPolicy] = None) -> dict:
     """Compile the per-tick update executable for a family/shape ahead of
     any session existing — no fitted model, no data.
 
@@ -1028,10 +1310,17 @@ def warmup_update(family: str = "arima", n_series: int = 1024, *,
                         loglik=zeros, ssq=zeros, sumlogf=zeros,
                         n_obs=jnp.zeros((bucket,), jnp.int32))
     health = initial_health(state)
+    qual = quality.validate() if quality is not None else None
+    qstate = None
+    if qual is not None:
+        qstate = initial_quality(bucket, qual, dtype,
+                                 jnp.ones((bucket,), dtype),
+                                 jnp.ones((bucket,), dtype))
     y = jnp.full((bucket,), jnp.nan, dtype)
     fn = _jitted("update")
     with _metrics.span("serving.warmup"):
-        fn(meta, pol, ssm, state, health, y, zeros)
+        fn(meta, pol, qual, ssm, state, health, qstate, y, zeros)
     return {"family": family, "bucket": bucket, "state_dim": m,
             "mode": meta.mode, "d_order": meta.d_order,
+            "quality": qual is not None,
             "dtype": str(np.dtype(dtype))}

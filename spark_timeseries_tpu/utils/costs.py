@@ -48,8 +48,8 @@ N_XREG = 2
 
 COST_FAMILIES = ("arima", "arimax", "ar", "arx", "ewma", "garch",
                  "argarch", "egarch", "holt_winters", "regression_arima",
-                 "serving_update", "long_combine", "fleet_pump",
-                 "backtest_metrics", "pinned_state_path")
+                 "serving_update", "quality_update", "long_combine",
+                 "fleet_pump", "backtest_metrics", "pinned_state_path")
 
 # the long_combine representative's statics: ARIMA(2,?,2) segment
 # estimates mapped into a 12-term AR truncation — the fit_long defaults
@@ -128,8 +128,60 @@ def _serving_update_representative(n_series: int,
         ssm = StateSpace(*leaves[:7])
         state = FilterState(*leaves[7:14])
         health = LaneHealth(*leaves[14:18])
-        return _update_impl(meta, policy, ssm, state, health,
-                            leaves[18], leaves[19])
+        return _update_impl(meta, policy, None, ssm, state, health,
+                            None, leaves[18], leaves[19])
+
+    return update, args
+
+
+def _quality_update_representative(n_series: int,
+                                   dtype) -> Tuple[Callable, Tuple]:
+    """The serving tier's per-tick program with the forecast-quality
+    plane ARMED (ISSUE 15): the same health-monitored Kalman update as
+    ``serving_update`` plus the fused quality step — forecast-ring
+    scoring, EW online sMAPE/MASE/coverage, Page-Hinkley drift, the
+    ``drifted`` status overlay, and the next-horizon forecast write —
+    exactly what a ``ServingSession(..., quality=QualityPolicy())``
+    jits.  Contract-checking it proves the fused program (not just the
+    quality-off path) stays f64-free, callback-free, and
+    trace-stable."""
+    import jax
+
+    from ..statespace.health import HealthPolicy, LaneHealth
+    from ..statespace.quality import QualityPolicy, QualityState
+    from ..statespace.serving import _update_impl
+    from ..statespace.ssm import FilterState, SSMeta, StateSpace
+
+    md = 3                               # max(p, q+1) for ARIMA(2,1,2)
+    meta = SSMeta("arima", "exact", 1, md)
+    policy = HealthPolicy()
+    quality = QualityPolicy()
+    H = quality.horizon
+    s = n_series
+
+    def sd(*shape, dt=dtype):
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    import jax.numpy as jnp
+    args = (sd(s, md, md), sd(s, md), sd(s, md), sd(s), sd(s),
+            sd(s, md, md), sd(s, md),                       # StateSpace
+            sd(s, md), sd(s, md, md), sd(s, meta.d_order), sd(s), sd(s),
+            sd(s), sd(s, dt=jnp.int32),                     # FilterState
+            sd(s), sd(s, dt=jnp.int32), sd(s, md),
+            sd(s, meta.d_order),                            # LaneHealth
+            sd(s, H), sd(s, dt=jnp.int32), sd(s, dt=jnp.int32),
+            sd(s), sd(s), sd(s), sd(s), sd(s),
+            sd(s, dt=jnp.int32), sd(s),
+            sd(s, dt=jnp.bool_),                            # QualityState
+            sd(s), sd(s))                                   # y, offset
+
+    def update(*leaves):
+        ssm = StateSpace(*leaves[:7])
+        state = FilterState(*leaves[7:14])
+        health = LaneHealth(*leaves[14:18])
+        qstate = QualityState(*leaves[18:29])
+        return _update_impl(meta, policy, quality, ssm, state, health,
+                            qstate, leaves[29], leaves[30])
 
     return update, args
 
@@ -255,6 +307,8 @@ def representative_fit(family: str, n_series: int, n_obs: int,
     program_tier = {
         "serving_update":
             lambda: _serving_update_representative(n_series, dtype),
+        "quality_update":
+            lambda: _quality_update_representative(n_series, dtype),
         "long_combine":
             lambda: _long_combine_representative(n_series, n_obs, dtype),
         "fleet_pump":

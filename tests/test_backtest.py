@@ -550,3 +550,73 @@ def test_gate_extracts_backtest_accuracy_metrics():
     assert verdict["status"] == "regressed"
     hist_ok = [rnd(i, 20.0) for i in range(4)]
     assert evaluate(hist_ok)["status"] == "pass"
+
+
+# ---------------------------------------------------------------------------
+# seasonal-naive MASE scaling (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+
+def test_seasonal_mase_scaling_matches_numpy_oracle():
+    """``mase_m=m`` scales by the in-sample seasonal-naive MAE
+    ``mean |y_t - y_{t-m}|`` instead of the lag-1 default.  MASE scales
+    linearly in 1/scale with everything else fixed, so the seasonal
+    tables must equal the lag-1 tables times scale_1/scale_m per lane
+    (the oracle recomputes both scales in NumPy, NaN pairs masked)."""
+    rng = np.random.default_rng(19)
+    S, n, m = 3, 96, 4
+    t = np.arange(n)
+    y = (5.0 + 3.0 * np.sin(2 * np.pi * t / m)[None, :]
+         + 0.3 * rng.standard_normal((S, n)))
+    y[1, 40] = np.nan                     # a masked pair in the window
+    c, phi = 1.0, 0.6
+    model = ARModel(c=jnp.full((S,), c),
+                    coefficients=jnp.full((S, 1), phi))
+    sched = plan_origins(n, 4, n_origins=3, stride=8, min_train=60)
+    ev1 = evaluate_candidate(y, model, sched, (1, 4))
+    evm = evaluate_candidate(y, model, sched, (1, 4), mase_m=m)
+
+    fs, ft = sched.fit_window()
+    w = y[:, fs:ft]
+
+    def np_scale(lag):
+        d = w[:, lag:] - w[:, :-lag]
+        msk = np.isfinite(d)
+        return np.where(msk, np.abs(d), 0.0).sum(1) / np.maximum(
+            msk.sum(1), 1)
+
+    s1, sm = np_scale(1), np_scale(m)
+    # everything except MASE is untouched by the scaling period
+    np.testing.assert_array_equal(evm.forecasts, ev1.forecasts)
+    np.testing.assert_array_equal(evm.smape, ev1.smape)
+    np.testing.assert_array_equal(evm.rmse, ev1.rmse)
+    ratio = (s1 / sm)[:, None]
+    np.testing.assert_allclose(evm.mase, ev1.mase * ratio, rtol=1e-5)
+    np.testing.assert_allclose(evm.score_mase,
+                               ev1.score_mase * ratio[:, 0], rtol=1e-5)
+    # direction pin: on a strongly seasonal panel the seasonal-naive
+    # forecast is MORE accurate than lag-1 (smaller denominator), so
+    # seasonal MASE judges the same errors more harshly
+    assert (sm < s1).all()
+    assert (evm.score_mase > ev1.score_mase).all()
+
+
+def test_backtest_panel_threads_mase_m_and_validates():
+    pan = _arma_panel(4, 256, (0.6,), (), seed=23)
+    with pytest.raises(ValueError, match="mase_m"):
+        backtest_panel(pan, CandidateGrid({"ar": [1]}, horizons=(1,)),
+                       n_origins=2, min_train=128, mase_m=0)
+    with pytest.raises(ValueError, match="mase_m"):
+        evaluate_candidate(
+            pan, ARModel(c=jnp.zeros((4,)),
+                         coefficients=jnp.full((4, 1), 0.5)),
+            plan_origins(256, 4, n_origins=2, min_train=128), (1,),
+            mase_m=500)                   # wider than the fit window
+    rep = backtest_panel(pan, CandidateGrid({"ar": [1]}, horizons=(1,)),
+                         n_origins=2, min_train=128, mase_m=7)
+    assert rep.mase_m == 7
+    assert rep.summary()["mase_m"] == 7
+    rep1 = backtest_panel(pan, CandidateGrid({"ar": [1]}, horizons=(1,)),
+                          n_origins=2, min_train=128)
+    assert rep1.mase_m == 1
+    # the scaling period is selection-relevant: it must move the digest
+    assert rep.digest() != rep1.digest()

@@ -25,9 +25,11 @@ SLOW_FAMILIES = ("garch", "argarch", "egarch")
 # the compiled-program tier (ISSUE 14 widened the sweep to the whole
 # compiled surface): serving update + longseries combine landed earlier;
 # fleet coalesced pump, backtest metric kernel, and pinned_state_path
-# are the post-PR-8 programs
-PROGRAM_FAMILIES = ("serving_update", "long_combine", "fleet_pump",
-                    "backtest_metrics", "pinned_state_path")
+# are the post-PR-8 programs; quality_update is the ISSUE-15 fused
+# quality-armed serving tick
+PROGRAM_FAMILIES = ("serving_update", "quality_update", "long_combine",
+                    "fleet_pump", "backtest_metrics",
+                    "pinned_state_path")
 
 
 def _assert_all_ok(results):

@@ -101,11 +101,16 @@ def test_slots_are_powers_of_two():
         == [1, 2, 4, 4, 8, 8, 16]
 
 
-def test_shed_priority_ranks_diverged_then_suspect():
-    assert shed_priority(np.array([2, 2, 0, 1])) == (2, 1)
-    assert shed_priority(np.array([0, 0])) == (0, 0)
-    # lexicographic: one diverged lane outranks any number of suspects
+def test_shed_priority_ranks_diverged_then_drifted_then_suspect():
+    assert shed_priority(np.array([2, 2, 0, 1])) == (2, 0, 1)
+    assert shed_priority(np.array([0, 0])) == (0, 0, 0)
+    # the quality tier's drifted code ranks between diverged and suspect
+    assert shed_priority(np.array([3, 3, 1])) == (0, 2, 1)
+    # lexicographic: one diverged lane outranks any number of drifted/
+    # suspect lanes, one drifted outranks any number of suspects
     assert shed_priority(np.array([2])) > shed_priority(
+        np.array([3, 3, 3, 3]))
+    assert shed_priority(np.array([3])) > shed_priority(
         np.array([1, 1, 1, 1]))
 
 

@@ -1,0 +1,642 @@
+"""Live forecast-quality plane (ISSUE 15): per-tick anomaly scores,
+rolling online accuracy, Page-Hinkley drift alarms, drift-driven
+auto-refit.
+
+The acceptance scenario lives here: a seeded regime-shift stream trips
+``drifted`` on exactly the shifted lanes (and nothing else),
+``heal(drifted=True)`` refits them from the history ring, and post-heal
+online sMAPE recovers to within a pinned band of a fresh fit on the
+same window — with the warmed tick path at zero recompiles while
+quality tracking AND the telemetry exporter are both armed.  The
+false-positive half: a stationary 5000-tick stream must alarm nothing
+(the same calibration bench's ``drift_false_alarms`` zero-baseline
+gate enforces round over round).
+
+Oracle strategy mirrors ``test_statespace.py``: the in-graph anomaly
+score is pinned against a scalar loop-based NumPy prediction-form
+filter written from the textbook recursion (no code shared with the
+JAX kernels), and the EW online metrics against an offline NumPy
+recomputation from the session's own one-step forecasts.
+
+Everything here runs in tier-1 and under ``make verify-quality``
+(plain + ``STS_FAULT_INJECT=1``, the ``quality`` marker).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_timeseries_tpu import statespace as ss
+from spark_timeseries_tpu.models import arima
+from spark_timeseries_tpu.statespace.health import (
+    LANE_DIVERGED, LANE_DRIFTED, LANE_OK)
+from spark_timeseries_tpu.statespace.quality import (
+    QualityPolicy, forecast_half_widths, initial_quality, naive_scale,
+    quality_panel)
+from spark_timeseries_tpu.utils import metrics, resilience
+
+pytestmark = pytest.mark.quality
+
+
+def _ar2_panel(S, n, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=(S, n + 16)).astype(dtype)
+    y = np.zeros((S, n + 16), dtype)
+    for t in range(2, n + 16):
+        y[:, t] = 0.3 + 0.5 * y[:, t - 1] - 0.2 * y[:, t - 2] + e[:, t]
+    return y[:, 16:]
+
+
+def _quality_session(S=6, n_hist=300, n_live=80, seed=3, **kwargs):
+    panel = _ar2_panel(S, n_hist + n_live, seed=seed)
+    hist, live = panel[:, :n_hist], panel[:, n_hist:]
+    model = arima.fit(2, 0, 0, jnp.asarray(hist), warn=False)
+    sess = ss.ServingSession.start(
+        model, hist, quality=kwargs.pop("quality", QualityPolicy()),
+        **kwargs)
+    return sess, hist, live
+
+
+# ---------------------------------------------------------------------------
+# policy validation + key separation
+# ---------------------------------------------------------------------------
+
+def test_quality_policy_validation_rejects_nonsense():
+    with pytest.raises(ValueError, match="horizon"):
+        QualityPolicy(horizon=0).validate()
+    with pytest.raises(ValueError, match="ew_alpha"):
+        QualityPolicy(ew_alpha=0.0).validate()
+    with pytest.raises(ValueError, match="ph_delta"):
+        QualityPolicy(ph_delta=-1.0).validate()
+    with pytest.raises(ValueError, match="coverage"):
+        QualityPolicy(coverage=1.5).validate()
+
+
+def test_update_key_separates_quality_from_plain_sessions():
+    """Arming quality changes the traced program, so it must change the
+    executable key — a quality-on and a quality-off session (or two
+    different quality policies) may never coalesce into one fleet
+    group."""
+    sess_q, hist, _ = _quality_session(S=3, n_live=4)
+    model = arima.fit(2, 0, 0, jnp.asarray(hist), warn=False)
+    sess_plain = ss.ServingSession.start(model, hist)
+    assert sess_q.update_key != sess_plain.update_key
+    sess_q2 = ss.ServingSession.start(
+        model, hist, quality=QualityPolicy(ph_lambda=99.0))
+    assert sess_q2.update_key != sess_q.update_key
+    assert sess_q2.update_key[:4] == sess_q.update_key[:4]
+
+
+# ---------------------------------------------------------------------------
+# anomaly-score oracle (the satellite's pinned-equality test)
+# ---------------------------------------------------------------------------
+
+def _np_anomaly_path(ssm, a0, P0, ys):
+    """Scalar loop-based textbook prediction-form filter: per-tick
+    standardized innovations v/sqrt(F) in float64, NaN ticks
+    predict-only — written from the recursion, no JAX code shared."""
+    S, n = ys.shape
+    out = np.full((S, n), np.nan)
+    for i in range(S):
+        T = np.asarray(ssm.T[i], np.float64)
+        Z = np.asarray(ssm.Z[i], np.float64)
+        c = np.asarray(ssm.c[i], np.float64)
+        d = float(ssm.d[i])
+        H = float(ssm.H[i])
+        Q = np.asarray(ssm.Q[i], np.float64)
+        a = np.asarray(a0[i], np.float64).copy()
+        P = np.asarray(P0[i], np.float64).copy()
+        for t in range(n):
+            y = ys[i, t]
+            v = y - d - Z @ a
+            F = Z @ P @ Z + H
+            out[i, t] = v / np.sqrt(F)
+            if np.isfinite(y):
+                K = (T @ P @ Z) / F
+                a = T @ a + c + K * v
+                P = T @ P @ T.T + Q - F * np.outer(K, K)
+            else:
+                a = T @ a + c
+                P = T @ P @ T.T + Q
+    return out
+
+
+def test_anomaly_score_matches_numpy_oracle():
+    """Pinned equality of the in-graph per-tick score against an
+    offline NumPy standardized-innovation computation on a seeded
+    stream, including NaN (missing) and predict-only (quarantined)
+    ticks."""
+    sess, hist, live = _quality_session(S=4, n_live=24, seed=7)
+    k = 16
+    ticks = live[:, :k].copy()
+    ticks[1, 5] = np.nan                   # a missing tick mid-stream
+    a0 = np.asarray(sess._state.a[:4])
+    P0 = np.asarray(sess._state.P[:4])
+    want = _np_anomaly_path(sess._ssm, a0, P0, ticks.astype(np.float64))
+    got = np.stack([sess.update(ticks[:, t]).anomaly for t in range(k)],
+                   axis=1)
+    # the missing tick reports NaN, everything else matches the oracle
+    assert np.isnan(got[1, 5]) and np.isnan(want[1, 5])
+    m = np.isfinite(want)
+    np.testing.assert_allclose(got[m], want[m], atol=5e-3)
+    # and the score is definitionally v/sqrt(F) of the same TickResult
+    out = sess.update(live[:, k])
+    np.testing.assert_allclose(
+        out.anomaly, out.innovations / np.sqrt(out.variances), rtol=1e-6)
+    np.testing.assert_allclose(
+        out.anomaly_ew, np.asarray(sess._health.ew[:4]), rtol=0, atol=0)
+    # quarantined lanes are predict-only: NaN anomaly from the next tick
+    with resilience.fault_injection("state_poison", lane_stride=2):
+        sess.update(live[:, k + 1])
+    out = sess.update(live[:, k + 2])
+    assert np.isnan(out.anomaly[::2]).all()
+    assert np.isfinite(out.anomaly[1::2]).all()
+
+
+def test_anomaly_rides_tickresult_without_quality_armed():
+    """The anomaly surface is unconditional — a plain (quality-off)
+    session reports it too, straight off the health machinery."""
+    panel = _ar2_panel(3, 320, seed=11)
+    model = arima.fit(2, 0, 0, jnp.asarray(panel[:, :300]), warn=False)
+    sess = ss.ServingSession.start(model, panel[:, :300])
+    out = sess.update(panel[:, 300])
+    np.testing.assert_allclose(
+        out.anomaly, out.innovations / np.sqrt(out.variances), rtol=1e-6)
+    assert out.anomaly_ew.shape == (3,)
+    assert sess.quality_summary() is None
+    assert "quality" not in sess.telemetry_summary()
+
+
+# ---------------------------------------------------------------------------
+# online accuracy: the EW metrics match an offline recomputation
+# ---------------------------------------------------------------------------
+
+def test_online_accuracy_matches_offline_recomputation():
+    """h=1: the ring's due forecast is exactly ``forecast(1)`` off the
+    pre-tick state, so recomputing the EW sMAPE/MASE/coverage from the
+    session's own forecasts must land on the in-graph EW means."""
+    pol = QualityPolicy(ew_alpha=0.1)
+    sess, hist, live = _quality_session(S=5, n_live=40, seed=13,
+                                        quality=pol)
+    sess.warmup()
+    k = 32
+    scale = np.asarray(sess._qstate.scale[:5], np.float64)
+    half = np.asarray(sess._qstate.half[:5], np.float64)
+    fcs, ys = [], []
+    for t in range(k):
+        fcs.append(sess.forecast(1)[:, 0])     # prediction for tick t
+        sess.update(live[:, t])
+        ys.append(live[:, t])
+    fcs = np.asarray(fcs, np.float64)          # (k, S)
+    ys = np.asarray(ys, np.float64)
+
+    # offline EW fold with the same definitions (tick 0 is ring warmup)
+    ew_s = np.zeros(5)
+    ew_m = np.zeros(5)
+    ew_c = np.zeros(5)
+    seen = np.zeros(5, bool)
+    beta = pol.ew_alpha
+    for t in range(1, k):
+        ae = np.abs(fcs[t] - ys[t])
+        denom = np.abs(fcs[t]) + np.abs(ys[t])
+        sm = np.where(denom > 0, 200.0 * ae / np.where(denom > 0,
+                                                       denom, 1.0), 0.0)
+        ms = ae / scale
+        cv = (ae <= half).astype(float)
+        for ew, pt in ((ew_s, sm), (ew_m, ms), (ew_c, cv)):
+            ew[:] = np.where(seen, (1 - beta) * ew + beta * pt, pt)
+        seen[:] = True
+    np.testing.assert_allclose(np.asarray(sess._qstate.ew_smape[:5]),
+                               ew_s, rtol=2e-3)
+    np.testing.assert_allclose(np.asarray(sess._qstate.ew_mase[:5]),
+                               ew_m, rtol=2e-3)
+    np.testing.assert_allclose(np.asarray(sess._qstate.ew_cover[:5]),
+                               ew_c, rtol=2e-3)
+    assert (np.asarray(sess._qstate.n_scored[:5]) == k - 1).all()
+    summ = sess.quality_summary()
+    assert summ["scored_lanes"] == 5
+    np.testing.assert_allclose(summ["live_smape"], ew_s.mean(),
+                               rtol=5e-3)
+
+
+def test_constant_history_lane_never_dilutes_live_mase():
+    """Review-finding pin: a lane whose history is constant has no valid
+    MASE scale (naive_scale = 0) — it scores sMAPE/coverage but must be
+    EXCLUDED from the live_mase aggregate, not averaged in as a perfect
+    0.0."""
+    from spark_timeseries_tpu.models import ewma
+
+    S, n_hist = 4, 300
+    panel = _ar2_panel(S, n_hist + 20, seed=97)
+    panel[0, :] = 5.0                 # constant lane (history + live)
+    model = ewma.fit(jnp.asarray(panel[:, :n_hist]))
+    sess = ss.ServingSession.start(model, panel[:, :n_hist],
+                                   quality=QualityPolicy())
+    assert float(sess._qstate.scale[0]) == 0.0
+    for t in range(10):
+        sess.update(panel[:, n_hist + t])
+    qs = np.asarray(sess._qstate.n_scored[:S])
+    assert (qs > 0).all()                    # everyone scores sMAPE
+    summ = sess.quality_summary()
+    want = np.asarray(sess._qstate.ew_mase[1:S]).mean()
+    np.testing.assert_allclose(summ["live_mase"], want, rtol=5e-3)
+
+
+def test_fit_time_baselines_scale_and_half():
+    """The MASE scale is the ring history's lag-1 naive MAE and the
+    coverage half-width the ψ-weight construction off the calibrated
+    ssm — both per-lane, both finite on a healthy fit."""
+    sess, hist, _ = _quality_session(S=4, n_live=4, seed=17)
+    scale = np.asarray(sess._qstate.scale[:4])
+    ring = sess._ring_history()
+    want = naive_scale(ring)
+    np.testing.assert_allclose(scale, want, rtol=1e-5)
+    half = np.asarray(sess._qstate.half[:4])
+    assert (half > 0).all() and np.isfinite(half).all()
+    # h=1 exact-mode half-width is z * sigma (psi_0 = sigma)
+    want_half = np.asarray(forecast_half_widths(
+        sess._ssm, sess.meta, 1, 0.9))[:4]
+    np.testing.assert_allclose(half, want_half, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# drift: the closed-loop acceptance scenario + false-alarm calibration
+# ---------------------------------------------------------------------------
+
+def test_drift_closed_loop_regime_shift_heal_recovers():
+    """Acceptance pin: a seeded regime shift trips ``drifted`` on
+    exactly the shifted lanes, ``heal(drifted=True)`` refits them from
+    the (post-shift-dominated) history ring, and post-heal online sMAPE
+    recovers to within a pinned band of a fresh fit on the same window
+    — all with zero recompiles on the warmed tick path."""
+    S, n_hist = 8, 300
+    n_live = 400
+    panel = _ar2_panel(S, n_hist + n_live, seed=29)
+    hist, live = panel[:, :n_hist], panel[:, n_hist:]
+    model = arima.fit(2, 0, 0, jnp.asarray(hist), warn=False)
+    metrics.install_jax_hooks()
+    reg = metrics.MetricsRegistry()
+    sess = ss.ServingSession.start(model, hist, registry=reg,
+                                   quality=QualityPolicy(),
+                                   history_ring=128)
+    sess.warmup()
+
+    # stationary prefix: nothing drifts
+    for t in range(40):
+        out = sess.update(live[:, t])
+    assert (out.status == LANE_OK).all()
+    assert sess._drift_alarms == 0
+
+    # regime shift on lanes ::3: a level shift sized to degrade
+    # accuracy persistently but stay far inside the χ² diverged band
+    shifted = np.arange(S)[::3]
+    shift = np.zeros(S, np.float32)
+    shift[shifted] = 1.5
+    before = metrics.jax_stats()["jit_compiles"]
+    for t in range(40, 190):
+        out = sess.update(live[:, t] + shift)
+    assert metrics.jax_stats()["jit_compiles"] - before == 0
+    drifted = np.flatnonzero(out.status == LANE_DRIFTED)
+    np.testing.assert_array_equal(drifted, shifted)
+    others = np.setdiff1d(np.arange(S), shifted)
+    assert (out.status[others] == LANE_OK).all()
+    assert not (out.status == LANE_DIVERGED).any()
+    assert sess._drift_alarms == shifted.size
+    assert reg.snapshot()["counters"]["serving.drift_alarms"] \
+        == shifted.size
+    pre_smape = np.asarray(sess._qstate.ew_smape[:S])[shifted].mean()
+
+    # drifted lanes keep serving (finite forecasts — not quarantined)
+    assert np.isfinite(sess.forecast(4)).all()
+
+    # close the loop: refit the drifted lanes from the ring (by now
+    # the 128-tick ring is pure post-shift regime)
+    report = sess.heal(drifted=True)
+    assert report["drifted"] == shifted.size
+    assert report["healed"] == shifted.size
+    assert report["dead"] == 0
+    assert (sess.lane_status == LANE_OK).all()
+    # quality re-baselined on healed lanes
+    assert (np.asarray(sess._qstate.n_scored[:S])[shifted] == 0).all()
+    assert not np.asarray(sess._qstate.drifted[:S]).any()
+
+    # post-heal: same warmed executable, zero new tick-path compiles
+    before2 = metrics.jax_stats()["jit_compiles"]
+    for t in range(190, 320):
+        out = sess.update(live[:, t] + shift)
+    assert metrics.jax_stats()["jit_compiles"] - before2 == 0
+    assert (out.status == LANE_OK).all()     # no re-alarm post-refit
+    post_smape = np.asarray(sess._qstate.ew_smape[:S])[shifted].mean()
+
+    # fresh-fit baseline: fit on exactly the shifted-regime window the
+    # heal refit saw, stream the same post-heal ticks, compare sMAPE
+    ring_window = np.concatenate(
+        [hist] + [(live[:, t] + shift)[:, None] for t in range(190)],
+        axis=1)[:, -128:]
+    fresh_model = arima.fit(2, 0, 0, jnp.asarray(ring_window[shifted]),
+                            warn=False)
+    fresh = ss.ServingSession.start(fresh_model, ring_window[shifted],
+                                    registry=reg,
+                                    quality=QualityPolicy())
+    for t in range(190, 320):
+        fresh.update((live[:, t] + shift)[shifted])
+    fresh_smape = np.asarray(
+        fresh._qstate.ew_smape[:shifted.size]).mean()
+    # the pinned recovery band: healed accuracy ~ fresh-fit accuracy,
+    # and clearly better than the drifted pre-heal accuracy
+    assert abs(post_smape - fresh_smape) <= 0.25 * fresh_smape + 2.0, \
+        (post_smape, fresh_smape)
+    assert post_smape < pre_smape, (post_smape, pre_smape)
+
+
+def test_stationary_5000_ticks_zero_drift_false_alarms():
+    """False-positive half of the drift story: 5000 well-specified
+    ticks across 32 lanes through the fused quality step (the scan
+    driver) must alarm nothing and leave every lane OK — the same
+    calibration bench's ``drift_false_alarms`` zero-baseline gate
+    enforces."""
+    S, n_hist, n_live = 32, 400, 5000
+    panel = _ar2_panel(S, n_hist + n_live, seed=41)
+    hist, live = panel[:, :n_hist], panel[:, n_hist:]
+    model = arima.fit(2, 0, 0, jnp.asarray(hist), warn=False)
+    sess = ss.ServingSession.start(model, hist, quality=QualityPolicy())
+    padded = np.pad(live, ((0, sess._bucket - S), (0, 0)),
+                    constant_values=np.nan)
+    state, health, qstate = quality_panel(
+        sess._ssm, sess._state, sess._health, sess._qstate,
+        jnp.asarray(padded), sess.meta, sess.policy, sess._quality)
+    status = np.asarray(health.status[:S])
+    assert (status == LANE_OK).all(), status
+    assert not np.asarray(qstate.drifted[:S]).any()
+    # the CUSUM stays far from the alarm threshold on a healthy stream
+    ph = np.asarray(qstate.ph[:S])
+    assert float(ph.max()) < sess._quality.ph_lambda / 2, ph.max()
+    # and the online metrics are sane: MASE ~ O(1), coverage ~ nominal
+    ms = np.asarray(qstate.ew_mase[:S])
+    cv = np.asarray(qstate.ew_cover[:S])
+    assert 0.3 < float(ms.mean()) < 2.0
+    assert 0.75 < float(cv.mean()) <= 1.0
+
+
+def test_tick_corruption_degrades_to_unscored_never_alarms():
+    """Satellite: the serving tier's tick-corruption fault modes with
+    quality armed — corrupt wire data must neither score nor advance
+    the drift statistic (an unscored tick, not a poisoned metric)."""
+    sess, hist, live = _quality_session(S=6, n_live=30, seed=47)
+    for t in range(4):
+        sess.update(live[:, t])
+    ph0 = np.asarray(sess._qstate.ph).copy()
+    n0 = np.asarray(sess._qstate.n_scored).copy()
+    for mode in ("tick_corrupt_nan", "tick_corrupt_inf"):
+        with resilience.fault_injection(mode, lane_stride=1):
+            out = sess.update(live[:, 10])
+        assert (out.status == LANE_OK).all(), (mode, out.status)
+    np.testing.assert_array_equal(np.asarray(sess._qstate.ph), ph0)
+    np.testing.assert_array_equal(np.asarray(sess._qstate.n_scored), n0)
+    assert sess._drift_alarms == 0
+    # clean ticks resume scoring immediately (real lanes; pad lanes of
+    # the bucket never score)
+    sess.update(live[:, 11])
+    assert (np.asarray(sess._qstate.n_scored[:6]) > n0[:6]).all()
+
+
+# ---------------------------------------------------------------------------
+# 0-recompile pin with quality + telemetry armed; snapshot surface
+# ---------------------------------------------------------------------------
+
+def test_warmed_update_zero_compiles_with_quality_and_telemetry():
+    """Acceptance pin: quality tracking AND the telemetry exporter both
+    armed, N warmed updates + a pre-compiled-horizon forecast trigger
+    exactly zero XLA compiles — and the scrape surface carries the
+    QUALITY panel while traffic flows."""
+    import json
+    import urllib.request
+
+    from spark_timeseries_tpu.utils import telemetry
+
+    metrics.install_jax_hooks()
+    sess, hist, live = _quality_session(S=4, n_live=20, seed=53,
+                                        label="qpin")
+    srv = telemetry.start(port=0)
+    try:
+        sess.warmup()
+        sess.forecast(6)
+        before = metrics.jax_stats()["jit_compiles"]
+        for t in range(6):
+            sess.update(live[:, t])
+        sess.forecast(6)
+        assert metrics.jax_stats()["jit_compiles"] - before == 0, \
+            "compiles leaked into the quality-armed warmed tick path"
+        with urllib.request.urlopen(srv.url + "/snapshot.json",
+                                    timeout=5) as resp:
+            snap = json.load(resp)
+        mine = [s for s in snap["serving_sessions"]
+                if s.get("label") == "qpin"]
+        assert mine and isinstance(mine[0].get("quality"), dict)
+        q = mine[0]["quality"]
+        assert q["scored_lanes"] == 4
+        assert q["drift_alarms"] == 0
+        assert isinstance(q["live_smape"], (int, float))
+    finally:
+        telemetry.stop()
+    # the labeled gauges landed too
+    gauges = metrics.snapshot()["gauges"]
+    assert "serving.session.qpin.live_smape" in gauges
+    assert "serving.session.qpin.anomaly_p95" in gauges
+    assert gauges["serving.session.qpin.drift_alarms"] == 0
+
+
+# ---------------------------------------------------------------------------
+# checkpoint round-trip + pre-quality compatibility
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_roundtrip_with_quality(tmp_path):
+    sess, hist, live = _quality_session(S=5, n_live=30, seed=59)
+    for t in range(12):
+        sess.update(live[:, t])
+    path = str(tmp_path / "quality.ckpt")
+    sess.checkpoint(path)
+    back = ss.ServingSession.restore(path)
+    assert back.describe() == sess.describe()
+    assert back._quality == sess._quality
+    for a, b in zip(sess._qstate, back._qstate):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    ta = sess.update(live[:, 12])
+    tb = back.update(live[:, 12])
+    np.testing.assert_array_equal(ta.anomaly, tb.anomaly)
+    np.testing.assert_array_equal(ta.anomaly_ew, tb.anomaly_ew)
+    for a, b in zip(sess._qstate, back._qstate):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pre_quality_checkpoint_restores_quality_off(tmp_path):
+    """A format-2 checkpoint written before the quality tier (no
+    quality keys) restores as a quality-off session — old checkpoints
+    are not orphaned by the new optional state."""
+    from spark_timeseries_tpu.utils import checkpoint as ckpt
+
+    panel = _ar2_panel(3, 320, seed=61)
+    model = arima.fit(2, 0, 0, jnp.asarray(panel[:, :300]), warn=False)
+    sess = ss.ServingSession.start(model, panel[:, :300])
+    path = str(tmp_path / "prequality.ckpt")
+    sess.checkpoint(path)
+    blob = ckpt.load_pytree(path)
+    blob.pop("quality_policy", None)
+    blob.pop("qstate", None)
+    old = str(tmp_path / "stripped.ckpt")
+    ckpt.save_pytree_atomic(old, blob)
+    back = ss.ServingSession.restore(old)
+    assert back._quality is None and back._qstate is None
+    out = back.update(panel[:, 300])
+    assert np.isfinite(out.anomaly).all()
+
+
+# ---------------------------------------------------------------------------
+# fleet: coalesced quality ticks are bitwise the per-session ticks
+# ---------------------------------------------------------------------------
+
+def test_fleet_coalesced_quality_matches_solo_sessions():
+    """Two quality-armed tenants share one coalescing group (quality
+    rides the update key) and their coalesced quality state is bitwise
+    the solo sessions' — the fleet pin extended to the quality carry."""
+    S, n_hist = 8, 300
+    panels = [_ar2_panel(S, n_hist + 8, seed=70 + i) for i in range(2)]
+    models = [arima.fit(2, 0, 0, jnp.asarray(p[:, :n_hist]), warn=False)
+              for p in panels]
+    ref = [ss.ServingSession.start(m, p[:, :n_hist],
+                                   quality=QualityPolicy(),
+                                   registry=metrics.MetricsRegistry())
+           for m, p in zip(models, panels)]
+    reg = metrics.MetricsRegistry()
+    sched = ss.FleetScheduler(ss.AdmissionPolicy(queue_depth=4),
+                              registry=reg, auto_pump=False)
+    for i, (m, p) in enumerate(zip(models, panels)):
+        sched.attach(ss.ServingSession.start(
+            m, p[:, :n_hist], quality=QualityPolicy(),
+            label=f"q{i}", registry=reg))
+    assert len(sched._groups) == 1           # one coalescing group
+    sched.warmup()
+    for t in range(6):
+        for i in range(2):
+            sched.submit(f"q{i}", panels[i][:, n_hist + t])
+        reports = sched.pump()
+        assert len(reports) == 1
+        for i in range(2):
+            ref[i].update(panels[i][:, n_hist + t])
+    for i in range(2):
+        sess = sched.session(f"q{i}")
+        for a, b in zip(sess._qstate, ref[i]._qstate):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(sess.lane_status,
+                                      ref[i].lane_status)
+        assert sess.quality_summary() == ref[i].quality_summary()
+
+
+# ---------------------------------------------------------------------------
+# gate + costs/contracts + console wiring
+# ---------------------------------------------------------------------------
+
+def test_bench_gate_extracts_quality_metrics():
+    from tools.bench_gate import METRICS, extract_metrics
+
+    names = [m[0] for m in METRICS]
+    assert "serving_live_smape" in names
+    assert "drift_false_alarms" in names
+
+    h = {"value": 1.0, "serving_demo": {"quality": {
+        "live_smape": 4.25, "drift_alarms": 2}}}
+    got = extract_metrics(h)
+    assert got["serving_live_smape"] == 4.25
+    assert got["drift_false_alarms"] == 2.0
+    # quality block present, alarms absent = a measured 0 (zero-baseline)
+    got = extract_metrics({"value": 1.0, "serving_demo": {
+        "quality": {"live_smape": 4.0}}})
+    assert got["drift_false_alarms"] == 0.0
+    # pre-quality rounds: no fabricated values
+    got = extract_metrics({"value": 1.0, "serving_demo": {"panel": 8}})
+    assert "serving_live_smape" not in got
+    assert "drift_false_alarms" not in got
+    # an errored demo contributes nothing
+    got = extract_metrics({"value": 1.0,
+                           "serving_demo": {"error": "boom"}})
+    assert "drift_false_alarms" not in got
+
+
+def test_bench_gate_flags_first_alarming_round():
+    from tools.bench_gate import evaluate
+
+    def mk(r, alarms=None):
+        q = {"live_smape": 4.0}
+        if alarms is not None:
+            q["drift_alarms"] = alarms
+        return {"round": r, "rc": 0, "path": f"r{r}", "headline": {
+            "metric": "t", "value": 100.0, "platform": "cpu",
+            "serving_demo": {"quality": q}}}
+
+    clean = [mk(r) for r in range(1, 4)]
+    verdict = evaluate(clean + [mk(4, alarms=3)])
+    row = next(r for r in verdict["rows"]
+               if r["metric"] == "drift_false_alarms")
+    assert row["status"] == "REGRESSED"
+    assert verdict["status"] == "regressed"
+    verdict = evaluate(clean + [mk(4)])
+    assert verdict["status"] == "pass"
+
+
+def test_quality_update_contract_family():
+    """The fused quality-armed program is a first-class contract family:
+    no-f64, no-host-callback, stable-jaxpr."""
+    from spark_timeseries_tpu.utils.contracts import (CONTRACT_FAMILIES,
+                                                      check_family)
+
+    assert "quality_update" in CONTRACT_FAMILIES
+    results = check_family("quality_update", 8, 64)
+    assert all(r.ok for r in results), \
+        [(r.contract, r.detail) for r in results if not r.ok]
+
+
+def test_warmup_update_compiles_quality_program():
+    from spark_timeseries_tpu.statespace.serving import warmup_update
+
+    rep = warmup_update("arima", 8, quality=QualityPolicy())
+    assert rep["quality"] is True and rep["bucket"] == 8
+    rep = warmup_update("ewma", 8)
+    assert rep["quality"] is False
+
+
+def test_sts_top_quality_panel_renders_and_degrades():
+    """The QUALITY panel renders quality-armed sessions, renders its
+    absence for quality-off sessions/old exporters, and junk snapshot
+    entries never KeyError the frame (the defensive-rendering
+    satellite)."""
+    from tools.sts_top import render_snapshot
+
+    snap = {"pid": 1, "serving_sessions": [
+        {"label": "t0", "family": "arima", "n_series": 8,
+         "quality": {"horizon": 1, "scored_lanes": 8,
+                     "live_smape": 4.21, "live_mase": 0.93,
+                     "live_coverage": 0.91, "anomaly_p95": 1.18,
+                     "drifted_lanes": 2, "drift_alarms": 3}},
+        {"label": "t1", "family": "ewma", "n_series": 4},   # quality off
+        None, "junk",                                        # defensive
+    ]}
+    frame = render_snapshot(snap)
+    assert "QUALITY (1 tracked sessions)" in frame
+    assert "4.21" in frame and "t0" in frame
+    # an old exporter's snapshot (no quality, no fleets) still renders
+    old = {"pid": 2, "serving_sessions": [{"label": "s", "family": "ar"}],
+           "jobs": [None], "incidents": ["x"], "fleets": "nope"}
+    frame = render_snapshot(old)
+    assert "(no quality-tracked sessions)" in frame
+    assert "SERVING (1 sessions)" in frame
+
+
+def test_sts_top_rejects_bad_interval(capsys):
+    from tools import sts_top
+
+    for bad in ("0", "-3", "nan"):
+        with pytest.raises(SystemExit):
+            sts_top.main(["http://127.0.0.1:1", "--once",
+                          "--interval", bad])
+        assert "--interval" in capsys.readouterr().err

@@ -37,6 +37,8 @@ automated check (``make gate``):
   fleet_shed_lanes              headline ``fleet_demo.shed_lanes``            higher
   backtest_champion_smape       headline ``backtest_demo.champion_smape``     higher
   backtest_champion_mase        headline ``backtest_demo.champion_mase``      higher
+  serving_live_smape            headline ``serving_demo.quality.live_smape``  higher
+  drift_false_alarms            headline ``serving_demo.quality.drift_alarms`` higher
   ============================  ============================================  ======
 
   (``engine_cache_misses`` is the streaming engine's executable-cache
@@ -111,6 +113,19 @@ automated check (``make gate``):
   both thresholds trip on real modeling changes rather than noise;
   tolerated-absent in rounds that predate the tier.
 
+  ``serving_live_smape`` / ``drift_false_alarms`` are the live
+  forecast-quality plane's gates (ISSUE 15): bench's quality demo
+  streams a quality-armed ``ServingSession`` over a stationary slice of
+  the seeded panel and reports the EW online sMAPE
+  (higher-is-regression: the ONLINE accuracy surface now fails the gate
+  if the fused tick-path scoring — or the serving math underneath it —
+  degrades) and the drift-alarm count, zero-baselined in the house
+  style: the demo stream is stationary by construction, so ANY alarm is
+  a false positive and the first alarming round is flagged against an
+  all-zero history (the Page-Hinkley calibration regression the
+  quality tier exists to prevent).  Both tolerated-absent in rounds
+  that predate the quality tier.
+
 - prints a pass/fail table with signed percentage deltas (``--json``
   emits the same verdict as machine-readable JSON for CI, exit codes
   unchanged) and exits 1 on any regression, 0 otherwise.  A newest round that crashed (``rc != 0``)
@@ -160,6 +175,8 @@ METRICS = [
     ("fleet_shed_lanes", "lower_better", 50.0),
     ("backtest_champion_smape", "lower_better", 25.0),
     ("backtest_champion_mase", "lower_better", 25.0),
+    ("serving_live_smape", "lower_better", 25.0),
+    ("drift_false_alarms", "lower_better", 50.0),
     ("lint_findings", "lower_better", 50.0),
     ("contracts_failed", "lower_better", 50.0),
 ]
@@ -271,6 +288,22 @@ def extract_metrics(headline: Optional[dict]) -> Dict[str, float]:
             v = bt.get(key)
             if isinstance(v, (int, float)):
                 out[name] = float(v)
+    # forecast-quality plane (ISSUE 15): the ONLINE accuracy gate
+    # (EW sMAPE of the quality demo's stationary stream, higher-is-
+    # regression) and the drift false-alarm counter — a quality block
+    # present with drift_alarms absent is a measured 0 (the zero-
+    # baseline rule: a stationary stream must never alarm); both
+    # tolerated-absent in rounds that predate the quality tier
+    sd = headline.get("serving_demo")
+    if isinstance(sd, dict) and "error" not in sd:
+        q = sd.get("quality")
+        if isinstance(q, dict) and "error" not in q:
+            v = q.get("live_smape")
+            if isinstance(v, (int, float)):
+                out["serving_live_smape"] = float(v)
+            v = q.get("drift_alarms", 0)
+            if isinstance(v, (int, float)):
+                out["drift_false_alarms"] = float(v)
     m = headline.get("metrics")
     if isinstance(m, dict):
         spans = m.get("spans")

@@ -12,6 +12,9 @@ exporter's ``/snapshot.json`` (``utils.telemetry``; armed with
 - **serving**: per-session lane health and the rolling tick-latency
   window — p50/p95 ms, SLO burns against ``STS_SERVING_SLO_MS``,
   quarantined lanes;
+- **quality**: the live forecast-quality plane per quality-armed
+  session/tenant — EW online sMAPE/MASE/coverage, the lane-anomaly
+  p95, drifted lanes and drift alarms (``statespace.quality``);
 - **fleet**: per-scheduler admission/coalescing/shed state — tenants
   (live vs shed, queue depth, admitted/rejected/dropped, cache
   serves) under the aggregate p95 and SLO burn count;
@@ -19,15 +22,21 @@ exporter's ``/snapshot.json`` (``utils.telemetry``; armed with
   size) so a crash's forensics are one glance away.
 
 ``--once`` prints a single frame and exits (scripts/CI); the default
-loop redraws every ``--interval`` seconds until Ctrl-C.  Rendering is
-pure (``render_snapshot(dict) -> str``), so tests drive it without a
-server.
+loop redraws every ``--interval`` seconds (default 2.0; junk or a
+non-positive value is rejected up front) until Ctrl-C.  Rendering is
+pure (``render_snapshot(dict) -> str``) and **version-tolerant**: a
+snapshot from an older exporter (no ``fleets`` section, no per-session
+``quality`` block) or with junk entries renders with the missing panels
+marked absent instead of KeyError-ing the dashboard — the scraper must
+never be newer-or-older than the process it watches.  Tests drive it
+without a server.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 import time
 import urllib.error
@@ -98,6 +107,38 @@ def _job_rows(jobs: List[Dict[str, Any]]) -> List[List[str]]:
             _fmt_age(j.get("heartbeat_age_s")),
             f"{j.get('heartbeat_stage', '-')}",
             status,
+        ])
+    return rows
+
+
+def _dicts(seq: Any) -> List[Dict[str, Any]]:
+    """Only the dict entries of a snapshot list — a junk or None entry
+    (truncated scrape, older exporter) must not KeyError the frame."""
+    return [x for x in (seq or []) if isinstance(x, dict)]
+
+
+def _fmt_num(v: Any, fmt: str = "{:.2f}") -> str:
+    return fmt.format(v) if isinstance(v, (int, float)) else "-"
+
+
+def _quality_rows(sessions: List[Dict[str, Any]]) -> List[List[str]]:
+    """One row per quality-armed session (sessions without a ``quality``
+    block — quality off, or an older exporter — simply don't appear)."""
+    rows = []
+    for s in sessions:
+        q = s.get("quality")
+        if not isinstance(q, dict):
+            continue
+        rows.append([
+            str(s.get("label", "?")),
+            str(q.get("horizon", "?")),
+            str(q.get("scored_lanes", "-")),
+            _fmt_num(q.get("live_smape")),
+            _fmt_num(q.get("live_mase"), "{:.3f}"),
+            _fmt_num(q.get("live_coverage"), "{:.3f}"),
+            _fmt_num(q.get("anomaly_p95"), "{:.3f}"),
+            str(q.get("drifted_lanes", 0)),
+            str(q.get("drift_alarms", 0)),
         ])
     return rows
 
@@ -182,8 +223,8 @@ def render_snapshot(snap: Dict[str, Any]) -> str:
         f"incidents {counters.get('incidents.written', 0)}",
         "",
     ]
-    jobs = list(snap.get("jobs") or [])
-    recent = [j for j in (snap.get("recent_jobs") or [])
+    jobs = _dicts(snap.get("jobs"))
+    recent = [j for j in _dicts(snap.get("recent_jobs"))
               if j.get("status") != "done" or j.get("chunks_failed")]
     lines.append(f"JOBS ({len(jobs)} active)")
     all_jobs = jobs + recent[-4:]
@@ -196,7 +237,7 @@ def render_snapshot(snap: Dict[str, Any]) -> str:
         lines.append("  (no active streaming jobs)")
     lines.append("")
 
-    sessions = list(snap.get("serving_sessions") or [])
+    sessions = _dicts(snap.get("serving_sessions"))
     lines.append(f"SERVING ({len(sessions)} sessions)")
     if sessions:
         lines += _table(
@@ -207,7 +248,17 @@ def render_snapshot(snap: Dict[str, Any]) -> str:
         lines.append("  (no live serving sessions)")
     lines.append("")
 
-    fleets = list(snap.get("fleets") or [])
+    qrows = _quality_rows(sessions)
+    lines.append(f"QUALITY ({len(qrows)} tracked sessions)")
+    if qrows:
+        lines += _table(
+            ["SESSION", "H", "SCORED", "SMAPE", "MASE", "COVER",
+             "ANOM-P95", "DRIFTED", "ALARMS"], qrows)
+    else:
+        lines.append("  (no quality-tracked sessions)")
+    lines.append("")
+
+    fleets = _dicts(snap.get("fleets"))
     lines.append(f"FLEET ({len(fleets)} schedulers)")
     if fleets:
         for fl in fleets:
@@ -225,7 +276,7 @@ def render_snapshot(snap: Dict[str, Any]) -> str:
                 f"shed {fl.get('shed_tenants', 0)}  p95 {p95s}  "
                 f"slo_burns {fl.get('slo_burns', 0)}  "
                 f"slo_ms {fl.get('slo_ms') or '-'}")
-            rows = list(fl.get("tenant_rows") or [])
+            rows = _dicts(fl.get("tenant_rows"))
             if rows:
                 lines += ["    " + ln for ln in _table(
                     ["TENANT", "MODE", "SERIES", "QUEUED", "ADM",
@@ -235,7 +286,7 @@ def render_snapshot(snap: Dict[str, Any]) -> str:
         lines.append("  (no live fleet schedulers)")
     lines.append("")
 
-    incidents = list(snap.get("incidents") or [])
+    incidents = _dicts(snap.get("incidents"))
     dirname = snap.get("incident_dir")
     lines.append(f"INCIDENTS"
                  + (f" ({dirname})" if dirname else " (recorder off)"))
@@ -257,12 +308,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                                "http://127.0.0.1:8321 (the value of "
                                "telemetry.start().url)")
     ap.add_argument("--interval", type=float, default=2.0,
-                    help="refresh period in seconds (default 2)")
+                    help="refresh period in seconds (default 2.0; must "
+                         "be a positive number)")
     ap.add_argument("--once", action="store_true",
                     help="print one frame and exit (scripts/CI)")
     ap.add_argument("--no-clear", action="store_true",
                     help="append frames instead of clearing the screen")
     args = ap.parse_args(argv)
+    if not math.isfinite(args.interval) or args.interval <= 0:
+        # a zero/negative/NaN interval would spin the scrape loop flat
+        # out against the exporter — reject it up front, named
+        ap.error(f"--interval must be a positive number of seconds, "
+                 f"got {args.interval!r}")
 
     while True:
         try:
