@@ -15,8 +15,9 @@ STS_COMPILE_CACHE ?=
 
 .PHONY: help verify compileall tier1 verify-faults verify-durability \
 	verify-perf verify-serving verify-long verify-telemetry verify-fleet \
-	verify-backtest verify-quality verify-races gate trace lint \
-	lint-baseline contracts verify-static jax-audit warmup
+	verify-backtest verify-quality verify-races verify-attribution gate \
+	bench-diff trace lint lint-baseline contracts verify-static \
+	jax-audit warmup
 
 help:
 	@echo "Targets:"
@@ -53,8 +54,13 @@ help:
 	@echo "  verify-quality live forecast-quality suite (anomaly-score oracle, online"
 	@echo "                sMAPE/MASE/coverage, Page-Hinkley drift + drifted-lane heal,"
 	@echo "                stationary zero-false-alarm pin), plain and under STS_FAULT_INJECT=1"
-	@echo "  verify-perf   perf gate: newest BENCH_r*.json vs trailing-median baseline"
-	@echo "  gate          same as verify-perf (tools/bench_gate.py; exit 1 on regression)"
+	@echo "  verify-perf   attribution suite + perf gate: newest BENCH_r*.json vs"
+	@echo "                trailing-median baseline"
+	@echo "  verify-attribution attribution-plane suite (span self-time oracle, stream_fit"
+	@echo "                phase accounting, bench-diff golden, 0-recompile pin armed)"
+	@echo "  gate          perf gate alone (tools/bench_gate.py; exit 1 on regression)"
+	@echo "  bench-diff    regression forensics: attribute the headline delta between two"
+	@echo "                bench rounds to the spans/counters that moved (default: newest two)"
 	@echo "  trace         run a small demo workload, write trace.json (open in ui.perfetto.dev)"
 
 # byte-compile the whole package (catches syntax errors in files the test
@@ -232,15 +238,33 @@ verify-quality:
 		-m quality --continue-on-collection-errors \
 		-p no:cacheprovider -p no:xdist -p no:randomly
 
+# attribution-plane suite (ISSUE 16): span self-time vs a hand-computed
+# oracle, stream_fit per-chunk phase accounting (phases sum to the chunk
+# wall, host_overhead_frac bounded), the bench-diff golden over the real
+# in-repo r04 -> r07 history, and the warmed-tick 0-recompile pin with
+# attribution + telemetry both armed.
+verify-attribution:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m attribution \
+		--continue-on-collection-errors -p no:cacheprovider \
+		-p no:xdist -p no:randomly
+
 # perf regression gate over the recorded BENCH_r*.json trajectory: the
 # newest round is compared per headline metric (throughput, fit wall
-# time, compile seconds, recompiles) against the trailing median of
-# comparable prior rounds; exits nonzero past the thresholds (see
-# tools/bench_gate.py --help; BENCH_GATE_THRESHOLD overrides).
-verify-perf: gate
+# time, compile seconds, recompiles, engine host-overhead fraction)
+# against the trailing median of comparable prior rounds; exits nonzero
+# past the thresholds (see tools/bench_gate.py --help;
+# BENCH_GATE_THRESHOLD overrides).
+verify-perf: verify-attribution gate
 
 gate:
 	$(PY) tools/bench_gate.py
+
+# where did the milliseconds go: diff two bench rounds (newest two
+# comparable by default; BENCH_DIFF_ARGS="r04 r07" or "--json" to
+# override) and attribute the headline delta to the spans/counters
+# that moved.  Forensics, not a gate — exits 0 on regressions too.
+bench-diff:
+	$(PY) tools/bench_diff.py $(BENCH_DIFF_ARGS)
 
 # demo timeline: a small panel fit with STS_TRACE armed — writes
 # ./trace.json (Chrome trace-event format; load in https://ui.perfetto.dev

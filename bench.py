@@ -52,26 +52,30 @@ def _probe_backend():
     from both.  Returns the platform string ("axon"/"tpu"/...) on
     success or None when the accelerator is unreachable, in which case
     the caller runs a labeled degraded CPU bench instead of dying with
-    rc=1.
+    rc=1; a timeout additionally sets ``_PROBE_STATE["timed_out"]`` so
+    every record of the fallback run carries a ``probe_timed_out``
+    marker (the hang is then data, not folklore).
 
-    The probe LOOP is window-budgeted, not try-budgeted (r4 verdict:
-    three rounds of official records fell back to CPU because a ~20-min
-    try budget gave up inside tunnel wedges that the out-of-band watcher
-    script simply waited out): probes repeat every
-    ``BENCH_PROBE_BACKOFF`` seconds (default 120) with a
-    ``BENCH_PROBE_TIMEOUT``-second cap each (default 240) until one
-    succeeds or ``BENCH_PROBE_WINDOW`` minutes elapse (default 30 — the
-    window plus the degraded CPU fallback must stay inside the driver's
-    observed per-command tolerance, r4's ~20 min probing + CPU run; 0
-    restores the single-pass behavior of ``BENCH_PROBE_TRIES``
-    attempts).  Every failed probe emits a JSON line to stdout — the
-    driver's record then contains the proof of how long the chip was
-    actually down, not just the fallback's ``degraded`` marker.
+    Each probe attempt gets a hard ``BENCH_PROBE_TIMEOUT_S``-second cap
+    (default 30; the legacy ``BENCH_PROBE_TIMEOUT`` spelling is honored
+    when the new one is unset).  The default is a SINGLE pass — in this
+    container TPU probes hang rather than fail fast (ROADMAP), and the
+    previous window-budgeted default (30 min of 240 s probes, kept for
+    r4-era tunnel wedges that eventually cleared) wedged entire rounds.
+    The patient behavior is still available, opt-in:
+    ``BENCH_PROBE_WINDOW`` minutes of probing every
+    ``BENCH_PROBE_BACKOFF`` seconds (default 120), or with the window
+    at 0, ``BENCH_PROBE_TRIES`` attempts (default 1).  Every failed
+    probe emits a JSON line to stdout — the driver's record then
+    contains the proof of how long the chip was actually down, not just
+    the fallback's ``degraded`` marker.
     """
-    tries = int(os.environ.get("BENCH_PROBE_TRIES", "3"))
-    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+    tries = int(os.environ.get("BENCH_PROBE_TRIES", "1"))
+    probe_timeout = float(
+        os.environ.get("BENCH_PROBE_TIMEOUT_S",
+                       os.environ.get("BENCH_PROBE_TIMEOUT", "30")))
     backoff = float(os.environ.get("BENCH_PROBE_BACKOFF", "120"))
-    window_s = 60.0 * float(os.environ.get("BENCH_PROBE_WINDOW", "30"))
+    window_s = 60.0 * float(os.environ.get("BENCH_PROBE_WINDOW", "0"))
     code = ("import jax, jax.numpy as jnp\n"
             "d = jax.devices()[0]\n"
             "x = jnp.ones((8, 8))\n"
@@ -91,6 +95,7 @@ def _probe_backend():
             reason = (out.stderr.strip().splitlines() or ["no output"])[-1]
         except subprocess.TimeoutExpired:
             reason = f"probe hung > {probe_timeout:.0f}s"
+            _PROBE_STATE["timed_out"] = True
         elapsed = time.monotonic() - start
         _emit({"probe_attempt": attempt, "elapsed_s": round(elapsed, 1),
                "window_s": window_s, "reason": reason[-200:]})
@@ -104,6 +109,22 @@ def _probe_backend():
 
 
 DEGRADED_NOTE = "TPU unreachable after backend probes; CPU fallback"
+
+# set by _probe_backend when any probe attempt hit its hard timeout —
+# module-level (not a third return value) so benchmarks/* callers of
+# _resolve_platform keep their 2-tuple contract
+_PROBE_STATE = {"timed_out": False}
+
+
+def _mark_degraded(obj: dict, degraded) -> None:
+    """Stamp a record of a CPU-fallback run: the degraded note, plus
+    ``probe_timed_out`` when the fallback was forced by a hung probe
+    rather than a clean probe failure — the bench history must show
+    WHY the platform changed."""
+    if degraded:
+        obj.setdefault("degraded", DEGRADED_NOTE)
+        if _PROBE_STATE["timed_out"]:
+            obj.setdefault("probe_timed_out", True)
 
 
 def _resolve_platform():
@@ -411,6 +432,10 @@ def main():
         block = dict(metrics.jax_stats(snap=snap))
         block["spans"] = snap["spans"]
         block["slowest_spans"] = tracing.slowest_spans(8)
+        # exclusive self-time attribution (docs/design.md §6g): which
+        # scope ITSELF ate the time, rolled up per subsystem — the block
+        # tools/bench_diff.py diffs across rounds
+        block["self_times"] = tracing.self_time_report(10)
         fit_counters = {k: v for k, v in snap["counters"].items()
                         if k.startswith(("fit.", "optimize.",
                                          "resilience."))}
@@ -429,6 +454,11 @@ def main():
         # gates engine.cache_misses against the trailing median)
         eng_counters = {k: v for k, v in snap["counters"].items()
                         if k.startswith("engine.")}
+        # the attribution gauges ride along (engine.host_overhead_frac /
+        # engine.bubble_ms_total — last stream wins, like engine.job.*)
+        eng_counters.update(
+            {k: v for k, v in snap["gauges"].items()
+             if k.startswith("engine.") and not k.startswith("engine.job.")})
         if eng_counters:
             block["engine"] = eng_counters
         # the serving tier's accounting: sessions opened, ticks ingested,
@@ -461,8 +491,7 @@ def main():
         # degraded message keep theirs).  Every record also carries the
         # metrics block current at emit time, so a partial record still
         # explains its own recompiles/spans.
-        if degraded:
-            obj.setdefault("degraded", DEGRADED_NOTE)
+        _mark_degraded(obj, degraded)
         obj.setdefault("metrics", _metrics_block())
         _emit(obj)
 
@@ -548,6 +577,7 @@ def main():
     curve = {}
     curve_h2d = {}
     h2d_by_chunk = {}
+    eng_by_n = {}
     converged_target = 0
     error = None
     try:
@@ -601,6 +631,7 @@ def main():
             # failed chunk's lanes must not inflate the numerator
             n_failed = sum(f["n_series"] for f in chunk_failures)
             curve[str(n)] = round(max(n - n_failed, 0) / dt, 1)
+            eng_by_n[n] = eng_stats
             converged_target = conv
             point = {
                 "metric": "ARIMA(2,1,2) series fitted/sec/chip "
@@ -1216,6 +1247,14 @@ def main():
             "per_series_sec_max": round(max(cpu_times), 3),
         },
     }
+    # headline attribution (docs/design.md §6g): the headline point's own
+    # stream phase accounting — per-chunk host/device phase records, the
+    # device-idle bubble, and the host-overhead fraction that
+    # tools/bench_gate.py gates (lower-better, tolerated-absent in
+    # pre-attribution rounds)
+    att = (eng_by_n.get(best_n) or {}).get("phases")
+    if isinstance(att, dict):
+        headline["engine_attribution"] = att
     if degraded:
         headline["degraded"] = DEGRADED_NOTE + " at reduced scale"
     if error is not None:

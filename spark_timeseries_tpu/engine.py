@@ -110,6 +110,11 @@ __all__ = [
 SERIES_BUCKET_FLOOR = 8
 OBS_BUCKET_MULTIPLE = 32
 
+# per-chunk phase records kept in StreamResult.stats["phases"]; totals
+# keep accumulating past the cap (records_dropped says how many rows
+# were elided) — a 1M-series stream must not grow an unbounded stats list
+_PHASE_RECORD_CAP = 64
+
 
 def series_bucket(n_series: int) -> int:
     """Series-axis bucket: next power of two, floor 8."""
@@ -1048,6 +1053,49 @@ class FitEngine:
                  "quarantined": 0, "retry_attempts": 0, "recovered": 0,
                  "dead_chunks": 0, "abandoned_workers": 0}
 
+        # performance attribution (docs/design.md §6g): per-chunk phase
+        # timers around every host crossing of the pipeline — slice/scan
+        # (prep), padding copy (pad), device_put + async enqueue
+        # (dispatch), blocking on device outputs (device_wait), skeleton
+        # reattach (reattach), journal commit (commit) — plus the
+        # device-idle "bubble": the host-side gap between consecutive
+        # device-wait windows net of the dispatch time that kept the
+        # device fed in between.  Strictly host-side perf_counter reads;
+        # nothing here is traced, so the instrumentation can never leak
+        # a recompile into the warmed path.
+        phase_totals = {"prep_s": 0.0, "pad_s": 0.0, "dispatch_s": 0.0,
+                        "device_wait_s": 0.0, "reattach_s": 0.0,
+                        "commit_s": 0.0}
+        chunk_phases: List[Dict[str, Any]] = []
+        phase_state = {"stage_wall_s": 0.0, "dropped": 0,
+                       "last_wait_end": None, "feed_s": 0.0,
+                       "bubble_s": 0.0}
+
+        def _finish_rec(rec: Optional[Dict[str, Any]]) -> None:
+            """Fold one chunk's phase record into the stream totals and
+            the bounded per-chunk list (the bench `engine` block embeds
+            it; _PHASE_RECORD_CAP keeps a 1M-series stream's stats from
+            ballooning)."""
+            if rec is None:
+                return
+            for key in phase_totals:
+                phase_totals[key] += rec.get(key, 0.0)
+            wall = rec.get("dispatch_call_s", 0.0) \
+                + rec.get("materialize_call_s", 0.0)
+            phase_state["stage_wall_s"] += wall
+            if len(chunk_phases) < _PHASE_RECORD_CAP:
+                row = {"chunk": rec["chunk"], "start": rec["start"],
+                       "stop": rec["stop"],
+                       "wall_ms": round(wall * 1e3, 3)}
+                for key in ("prep_s", "pad_s", "dispatch_s",
+                            "device_wait_s", "reattach_s", "commit_s",
+                            "bubble_s"):
+                    row[key[:-2] + "_ms"] = round(
+                        rec.get(key, 0.0) * 1e3, 3)
+                chunk_phases.append(row)
+            else:
+                phase_state["dropped"] += 1
+
         def _with_deadline(fn: Callable[[], Any], stage: str,
                            start: int, stop: int):
             """Run one blocking chunk stage under the watchdog: the work
@@ -1100,10 +1148,12 @@ class FitEngine:
                 raise box["error"]
             return box["value"]
 
-        def _prep(start: int, stop: int):
+        def _prep(start: int, stop: int,
+                  rec: Optional[Dict[str, Any]] = None):
             """Slice + pad one row range to its series bucket.  Raises
             :class:`_ChunkDataError` on deterministic data-contract
             violations (terminal — a retry cannot change the data)."""
+            t0 = time.perf_counter()
             part = host[start:stop]
             n_real = stop - start
             bs = chunk if n_real == chunk \
@@ -1121,20 +1171,29 @@ class FitEngine:
                     raise _ChunkDataError(
                         f"{gaps} lane(s) have NaN strictly inside their "
                         f"observed window; impute interior gaps first")
+            if rec is not None:
+                rec["prep_s"] = time.perf_counter() - t0
             if n_real != bs:          # ragged tail: its own bucket
+                t0 = time.perf_counter()
                 fill = np.nan if variant == "ragged" else 0.0
                 padded = np.full((bs, n_obs), fill, part.dtype)
                 padded[:n_real] = part
                 part = padded
+                if rec is not None:
+                    rec["pad_s"] = time.perf_counter() - t0
                 self._reg.inc("engine.pad_lanes", bs - n_real)
             return part, bs, variant, n_real
 
         def _dispatch(idx: int, start: int, stop: int):
             """Prep + executable lookup + async dispatch under the
             deadline (compiles can hang too).  Returns
-            ``(out, entry, n_real)``."""
+            ``(out, entry, n_real, rec)`` where ``rec`` is the chunk's
+            phase record (threaded through materialize/publish)."""
+            rec: Dict[str, Any] = {"chunk": int(idx), "start": int(start),
+                                   "stop": int(stop)}
+            t_call = time.perf_counter()
             progress.heartbeat("dispatch", chunk=(start, stop))
-            part, bs, variant, n_real = _prep(start, stop)
+            part, bs, variant, n_real = _prep(start, stop, rec)
             oom = _resilience.chunk_fault("oom_chunk", idx)
             if oom is not None and (start, stop) == partition[idx]:
                 # fires at the full chunk size only, so the degraded
@@ -1148,44 +1207,77 @@ class FitEngine:
                     time.sleep(hang.hang_s)
                 entry = self._entry(family, statics, (bs, n_obs),
                                     part.dtype, variant, don)
+                t0 = time.perf_counter()
                 with _metrics.span("engine.dispatch"):
                     dev = jax.device_put(part)
                     out = entry.compiled(dev, np.int32(n_real))
+                d = time.perf_counter() - t0
+                rec["dispatch_s"] = rec.get("dispatch_s", 0.0) + d
+                # dispatch enqueues device work: credit it against the
+                # next inter-wait gap so a host that keeps the device
+                # fed doesn't book a phantom bubble
+                phase_state["feed_s"] += d
                 return entry, out
 
             entry, out = _with_deadline(work, "dispatch", start, stop)
             self._reg.inc("engine.bytes_h2d", int(part.nbytes))
             if don:
                 self._reg.inc("engine.bytes_donated", int(part.nbytes))
-            return out, entry, n_real
+            rec["dispatch_call_s"] = time.perf_counter() - t_call
+            return out, entry, n_real, rec
 
         def _materialize(out, entry: _Entry, idx: int, start: int,
-                         stop: int, n_real: int) -> None:
+                         stop: int, n_real: int,
+                         rec: Optional[Dict[str, Any]] = None) -> None:
             """Block on the chunk's outputs under the deadline, then
             publish (and journal-commit) the result."""
             progress.heartbeat("materialize", chunk=(start, stop))
+            t_call = time.perf_counter()
+            last_end = phase_state["last_wait_end"]
+            if last_end is not None:
+                # device-idle bubble: the stretch between consecutive
+                # device-wait windows the host spent NOT feeding the
+                # device (gap net of dispatch time in the gap)
+                gap = max(0.0, t_call - last_end - phase_state["feed_s"])
+                phase_state["bubble_s"] += gap
+                if rec is not None:
+                    rec["bubble_s"] = gap
+            phase_state["feed_s"] = 0.0
 
             def work():
                 with _metrics.span("engine.collect"):
                     return [np.asarray(a) for a in out[0]], int(out[1])
 
+            t0 = time.perf_counter()
             arrays, c = _with_deadline(work, "materialize", start, stop)
-            _publish(entry, arrays, c, idx, start, stop, n_real)
+            now = time.perf_counter()
+            phase_state["last_wait_end"] = now
+            if rec is not None:
+                rec["device_wait_s"] = now - t0
+                rec["materialize_t_call"] = t_call
+            _publish(entry, arrays, c, idx, start, stop, n_real, rec)
 
         def _publish(entry: _Entry, arrays, c: int, idx: int, start: int,
-                     stop: int, n_real: int) -> None:
+                     stop: int, n_real: int,
+                     rec: Optional[Dict[str, Any]] = None) -> None:
             nonlocal conv
             conv += c
             self._reg.inc("engine.chunks")
             model = None
             if keep_models:
+                t0 = time.perf_counter()
                 model = self._rebuild(entry.skeleton, arrays, n_real,
                                       n_obs, entry.bucket)
+                if rec is not None:
+                    rec["reattach_s"] = time.perf_counter() - t0
             if jr is not None:
+                t0 = time.perf_counter()
                 jr.commit(start, stop, model,
                           {"n_real": int(n_real), "n_conv": int(c),
                            "bucket": list(entry.bucket),
                            "variant": entry.variant})
+                if rec is not None:
+                    rec["commit_s"] = time.perf_counter() - t0
                 durex["journal_commits"] += 1
                 self._reg.inc("engine.journal_commits")
                 progress.note(journal_commits=1)
@@ -1203,6 +1295,12 @@ class FitEngine:
                 progress.note_chunk_done()
             else:
                 progress.note(subchunks_done=1)
+            if rec is not None:
+                t_call = rec.pop("materialize_t_call", None)
+                if t_call is not None:
+                    rec["materialize_call_s"] = time.perf_counter() \
+                        - t_call
+                _finish_rec(rec)
             _publish_progress()
 
         def _pre_kill_incident(idx: int, start: int, stop: int) -> None:
@@ -1388,8 +1486,9 @@ class FitEngine:
                 if resilient:
                     _run_chunk_resilient(idx, start, stop)
                 else:
-                    out, entry, n_real = _dispatch(idx, start, stop)
-                    _materialize(out, entry, idx, start, stop, n_real)
+                    out, entry, n_real, rec = _dispatch(idx, start, stop)
+                    _materialize(out, entry, idx, start, stop, n_real,
+                                 rec)
             except Exception as e:  # noqa: BLE001 — classified below
                 if _durability.is_oom(e) and degrade \
                         and (stop - start) > floor:
@@ -1451,9 +1550,10 @@ class FitEngine:
             return True
 
         def _pull(out, entry: _Entry, idx: int, start: int, stop: int,
-                  n_real: int) -> None:
+                  n_real: int, rec: Optional[Dict[str, Any]] = None
+                  ) -> None:
             try:
-                _materialize(out, entry, idx, start, stop, n_real)
+                _materialize(out, entry, idx, start, stop, n_real, rec)
             except Exception as e:  # noqa: BLE001 — deferred device
                 # errors surface at materialization; isolate the chunk
                 _route_failure(idx, start, stop, e)
@@ -1471,11 +1571,13 @@ class FitEngine:
                             _route_failure(idx, start, stop, e)
                         continue
                     try:
-                        out, entry, n_real = _dispatch(idx, start, stop)
+                        out, entry, n_real, rec = _dispatch(idx, start,
+                                                            stop)
                     except Exception as e:  # noqa: BLE001 — isolation
                         _route_failure(idx, start, stop, e)
                         continue
-                    pending.append((out, entry, idx, start, stop, n_real))
+                    pending.append((out, entry, idx, start, stop, n_real,
+                                    rec))
                     while len(pending) >= depth + 1:
                         _pull(*pending.popleft())
                 while pending:
@@ -1546,6 +1648,31 @@ class FitEngine:
         _telemetry.finish_job(progress, "done", registry=self._reg)
 
         after = self.cache_stats()
+        # attribution rollup (docs/design.md §6g): host-side phase
+        # seconds (everything but the device wait) over the stream's
+        # wall, plus the accumulated device-idle bubble.  Last-write-wins
+        # gauges, like the engine.job.* family — the per-stream truth
+        # rides StreamResult.stats["phases"].
+        host_s = (phase_totals["prep_s"] + phase_totals["pad_s"]
+                  + phase_totals["dispatch_s"]
+                  + phase_totals["reattach_s"]
+                  + phase_totals["commit_s"])
+        host_frac = min(1.0, host_s / wall) if wall > 0 else 0.0
+        bubble_ms = phase_state["bubble_s"] * 1e3
+        self._reg.set_gauge("engine.host_overhead_frac",
+                            round(host_frac, 6))
+        self._reg.set_gauge("engine.bubble_ms_total", round(bubble_ms, 3))
+        phases_block = {
+            "per_chunk": chunk_phases,
+            "records_dropped": phase_state["dropped"],
+            "totals_ms": {k[:-2] + "_ms": round(v * 1e3, 3)
+                          for k, v in phase_totals.items()},
+            "host_ms": round(host_s * 1e3, 3),
+            "bubble_ms_total": round(bubble_ms, 3),
+            "stage_wall_ms": round(phase_state["stage_wall_s"] * 1e3, 3),
+            "wall_ms": round(wall * 1e3, 3),
+            "host_overhead_frac": round(host_frac, 4),
+        }
         stats = {
             "cache_hits": after["cache_hits"] - before["cache_hits"],
             "cache_misses": after["cache_misses"] - before["cache_misses"],
@@ -1556,6 +1683,7 @@ class FitEngine:
             "deadline_s": deadline,
             "retries": policy.max_retries,
             "job_id": progress.job_id,
+            "phases": phases_block,
             **durex,
         }
         if resilient:

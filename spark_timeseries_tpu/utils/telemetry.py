@@ -455,6 +455,22 @@ def snapshot_doc(registry: Optional[Any] = None) -> Dict[str, Any]:
     jx = sys.modules.get("jax")
     if jx is not None:
         doc["jax"]["version"] = getattr(jx, "__version__", None)
+    # performance attribution (docs/design.md §6g): exclusive span
+    # self-times with per-subsystem rollups from the process trace ring,
+    # plus the streaming engine's host-overhead / bubble gauges — the
+    # ATTRIBUTION panel sts_top renders
+    try:
+        from . import tracing as _tracing
+        gauges = snap.get("gauges", {})
+        doc["attribution"] = {
+            "self_times": _tracing.self_time_report(8),
+            "engine": {k: gauges[k]
+                       for k in ("engine.host_overhead_frac",
+                                 "engine.bubble_ms_total")
+                       if k in gauges},
+        }
+    except Exception as e:  # noqa: BLE001 — scrape isolation
+        doc["attribution"] = {"error": f"{type(e).__name__}: {e}"}
     try:
         from . import flightrec as _flightrec
         doc["incident_dir"] = _flightrec.incident_dir()

@@ -25,6 +25,17 @@ Two entry points:
   :func:`span_events` / :func:`slowest_spans` for embedding the top-N
   slowest scopes into bench artifacts (``bench.py`` does, per round).
 
+Self-time attribution (docs/design.md §6g): inclusive span durations
+answer "how long did this scope take" but not "which scope *itself* ate
+the time" — a parent that merely wraps a slow child ranks above the
+child.  :func:`self_times` computes each buffered span's **exclusive**
+self-time (inclusive duration minus the durations of its enclosed
+children, per thread, from the ring's begin+duration intervals), and
+:func:`self_time_report` aggregates it by span name with per-subsystem
+rollups (``engine`` / ``statespace`` / ``backtest`` / ``models`` /
+``utils``) — the block ``bench.py`` embeds per round and
+``tools/bench_diff.py`` diffs across rounds.
+
 Timestamps ride the ``perf_counter`` clock (µs in the export, as the
 format requires); the absolute wall-clock anchor of the trace is carried
 in ``otherData.trace_start_walltime`` so a timeline can be correlated
@@ -40,9 +51,53 @@ from typing import Any, Dict, List, Optional
 from . import metrics as _metrics
 
 __all__ = ["to_chrome_trace", "write_trace", "span_events",
-           "slowest_spans"]
+           "slowest_spans", "self_times", "self_time_report",
+           "span_subsystem"]
 
 _S_TO_US = 1e6
+
+# containment slack for self-time interval nesting: a child's exit
+# timestamp is read by a separate perf_counter call than its parent's,
+# so a nominally-enclosed child can arithmetically overhang by clock
+# quantization — never by more than microseconds
+_NEST_EPS = 1e-6
+
+# leaf-prefix → subsystem rollup (the five attribution buckets).  The
+# leaf segment of a nested path owns the time ("bench.fit/engine.stream"
+# is engine time); prefixes not listed (bench.*, telemetry.*, io.*, ...)
+# roll into "utils" — driver/observability glue, not model math.
+SUBSYSTEMS = ("engine", "statespace", "backtest", "models", "utils")
+_SUBSYSTEM_BY_PREFIX = {
+    "engine": "engine",
+    "serving": "statespace",
+    "kalman": "statespace",
+    "statespace": "statespace",
+    "fleet": "statespace",
+    "quality": "statespace",
+    "backtest": "backtest",
+    "arima": "models",
+    "garch": "models",
+    "hw": "models",
+    "holtwinters": "models",
+    "ar": "models",
+    "ma": "models",
+    "arma": "models",
+    "ewma": "models",
+    "rw": "models",
+    "fit": "models",
+    "optimize": "models",
+    "resilience": "models",
+    "longseries": "models",
+}
+
+
+def span_subsystem(path: str) -> str:
+    """The attribution bucket owning a span path: decided by the *leaf*
+    segment's dotted prefix (``"bench.fit_panel/arima.fit"`` → the
+    ``arima`` leaf → ``"models"``); unknown prefixes are ``"utils"``."""
+    leaf = path.rsplit("/", 1)[-1]
+    head = leaf.split(".", 1)[0]
+    return _SUBSYSTEM_BY_PREFIX.get(head, "utils")
 
 
 def span_events(events: Optional[List[Dict[str, Any]]] = None
@@ -59,16 +114,100 @@ def span_events(events: Optional[List[Dict[str, Any]]] = None
     return spans
 
 
+def self_times(events: Optional[List[Dict[str, Any]]] = None
+               ) -> List[Dict[str, Any]]:
+    """Every buffered span with its **exclusive** self-time: inclusive
+    duration minus the durations of its strictly-enclosed children,
+    computed per thread from the ring's begin+duration intervals.
+
+    ``span()`` scopes are well-nested per thread (a child records at
+    exit, strictly inside its parent's window), so a single stack pass
+    over begin-ordered events suffices: an event starting after the
+    stack top's end closes that scope; an event whose window sits inside
+    the top's subtracts from the top's self-time (immediate parent only
+    — a grandchild already subtracted from its own parent).  A window
+    that *partially* overlaps the top (impossible from ``span()``, but
+    representable in a hand-built event list) is treated as a sibling:
+    nothing is subtracted, so inclusive totals are never over-attributed.
+    Self-times are clamped at 0 against clock quantization."""
+    spans = span_events(events)
+    rows: List[Dict[str, Any]] = []
+    by_tid: Dict[Any, List[Dict[str, Any]]] = {}
+    for e in spans:
+        by_tid.setdefault(e.get("tid", 0), []).append(e)
+    for evs in by_tid.values():
+        # same begin → the longer window is the parent
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        # stack entries: [event, self_dur, end]
+        stack: List[list] = []
+        done: List[list] = []
+        for e in evs:
+            end = e["ts"] + e["dur"]
+            while stack and stack[-1][2] <= e["ts"] + _NEST_EPS:
+                done.append(stack.pop())
+            if stack and end <= stack[-1][2] + _NEST_EPS:
+                stack[-1][1] -= e["dur"]
+            stack.append([e, e["dur"], end])
+        done.extend(stack)
+        for e, self_dur, _end in done:
+            rows.append({"name": e["name"], "ts": e["ts"],
+                         "dur": e["dur"], "self": max(0.0, self_dur),
+                         "tid": e.get("tid", 0),
+                         "tname": e.get("tname", "")})
+    rows.sort(key=lambda r: r["ts"])
+    return rows
+
+
 def slowest_spans(n: int = 10,
                   events: Optional[List[Dict[str, Any]]] = None
                   ) -> List[Dict[str, Any]]:
     """Top-``n`` slowest span scopes still in the buffer, as compact
     JSON-able rows — the per-round "where did this round's time go"
-    block ``bench.py`` embeds next to the aggregate span histograms."""
-    spans = span_events(events)
-    spans.sort(key=lambda e: e["dur"], reverse=True)
-    return [{"name": e["name"], "dur_s": round(e["dur"], 6),
-             "thread": e.get("tname", "")} for e in spans[:n]]
+    block ``bench.py`` embeds next to the aggregate span histograms.
+    Each row carries both the inclusive duration and the exclusive
+    self-time; ties on duration order by name so equal-duration spans
+    don't reorder between runs."""
+    rows = self_times(events)
+    rows.sort(key=lambda r: (-r["dur"], r["name"]))
+    return [{"name": r["name"], "dur_s": round(r["dur"], 6),
+             "self_s": round(r["self"], 6),
+             "thread": r.get("tname", "")} for r in rows[:n]]
+
+
+def self_time_report(n: int = 10,
+                     events: Optional[List[Dict[str, Any]]] = None
+                     ) -> Dict[str, Any]:
+    """The per-round self-time attribution block: spans aggregated by
+    name (summed across occurrences and threads), top-``n`` by total
+    self-time (name-stable on ties), plus the per-subsystem rollup over
+    *all* buffered spans.  Every subsystem bucket is always present — a
+    0 is a measured "this tier spent nothing", which is what
+    ``tools/bench_diff.py`` needs to diff rounds that exercised
+    different tiers."""
+    agg: Dict[str, Dict[str, Any]] = {}
+    for r in self_times(events):
+        a = agg.setdefault(r["name"], {"name": r["name"], "count": 0,
+                                       "dur_s": 0.0, "self_s": 0.0})
+        a["count"] += 1
+        a["dur_s"] += r["dur"]
+        a["self_s"] += r["self"]
+    subsystems = {sub: {"self_s": 0.0, "spans": 0} for sub in SUBSYSTEMS}
+    total = 0.0
+    for a in agg.values():
+        sub = subsystems[span_subsystem(a["name"])]
+        sub["self_s"] += a["self_s"]
+        sub["spans"] += 1
+        total += a["self_s"]
+    top = sorted(agg.values(), key=lambda a: (-a["self_s"], a["name"]))
+    return {
+        "spans": [{"name": a["name"], "count": a["count"],
+                   "dur_s": round(a["dur_s"], 6),
+                   "self_s": round(a["self_s"], 6)} for a in top[:n]],
+        "subsystems": {k: {"self_s": round(v["self_s"], 6),
+                           "spans": v["spans"]}
+                       for k, v in subsystems.items()},
+        "total_self_s": round(total, 6),
+    }
 
 
 def to_chrome_trace(events: Optional[List[Dict[str, Any]]] = None,

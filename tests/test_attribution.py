@@ -1,0 +1,536 @@
+"""Performance attribution plane (ISSUE 16): span self-time vs a
+hand-computed oracle, stream_fit per-chunk phase accounting +
+dispatch-bubble gaps, the bench-diff regression forensics tool (golden
+over the real in-repo r04 -> r07 history), the host-overhead bench
+gate seeding, the probe-timeout fallback, sts_top's --sort/ATTRIBUTION
+surfaces, and the warmed-tick 0-recompile pin with the whole plane
+armed."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu import engine as E
+from spark_timeseries_tpu.utils import metrics, telemetry, tracing
+
+pytestmark = pytest.mark.attribution
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace():
+    metrics.clear_trace()
+    yield
+    metrics.clear_trace()
+
+
+def _span(name, ts, dur, tid=1, tname="t"):
+    metrics.trace_buffer().append(
+        {"kind": "span", "name": name, "ts": ts, "dur": dur,
+         "tid": tid, "tname": tname})
+
+
+def _selves():
+    return {r["name"]: r["self"] for r in tracing.self_times()}
+
+
+# ---------------------------------------------------------------------------
+# self-time vs the hand-computed oracle
+# ---------------------------------------------------------------------------
+
+def test_self_time_nested_oracle():
+    # parent [0, 1.0] with children [0.1, 0.2] and [0.5, 0.3], the
+    # latter holding grandchild [0.55, 0.1]:
+    #   parent self = 1.0 - 0.2 - 0.3 = 0.5  (grandchild charged to its
+    #   immediate parent only, never double-subtracted from the root)
+    _span("p", 0.0, 1.0)
+    _span("p/c1", 0.1, 0.2)
+    _span("p/c2", 0.5, 0.3)
+    _span("p/c2/g", 0.55, 0.1)
+    s = _selves()
+    assert s["p"] == pytest.approx(0.5)
+    assert s["p/c1"] == pytest.approx(0.2)
+    assert s["p/c2"] == pytest.approx(0.2)
+    assert s["p/c2/g"] == pytest.approx(0.1)
+    # the ring records at scope EXIT (child precedes parent) — the
+    # append order above is ts order, which is the opposite; re-check
+    # with exit order to prove the sort makes order irrelevant
+    metrics.clear_trace()
+    _span("p/c2/g", 0.55, 0.1)
+    _span("p/c1", 0.1, 0.2)
+    _span("p/c2", 0.5, 0.3)
+    _span("p", 0.0, 1.0)
+    assert _selves() == s
+
+
+def test_self_time_same_timestamp_longer_span_is_parent():
+    # equal ts: the longer span encloses the shorter one
+    _span("outer", 5.0, 0.4)
+    _span("inner", 5.0, 0.1)
+    s = _selves()
+    assert s["outer"] == pytest.approx(0.3)
+    assert s["inner"] == pytest.approx(0.1)
+
+
+def test_self_time_partial_overlap_is_siblings():
+    # b starts inside a but ends after it: not contained, so nothing is
+    # subtracted from either (overlapping phases, not nesting)
+    _span("a", 0.0, 0.5)
+    _span("b", 0.3, 0.5)
+    s = _selves()
+    assert s["a"] == pytest.approx(0.5)
+    assert s["b"] == pytest.approx(0.5)
+
+
+def test_self_time_instant_child_and_clamp():
+    _span("p", 0.0, 1.0)
+    _span("p/zero", 0.5, 0.0)       # zero-duration child subtracts 0
+    s = _selves()
+    assert s["p"] == pytest.approx(1.0)
+    assert s["p/zero"] == 0.0
+    # a child reported (by clock quantization) longer than its parent
+    # clamps the parent at 0, never negative
+    metrics.clear_trace()
+    _span("q", 2.0, 0.1)
+    _span("q/big", 2.0, 0.1 + 5e-7)
+    rows = {r["name"]: r["self"] for r in tracing.self_times()}
+    assert rows["q"] >= 0.0
+
+
+def test_self_time_threads_are_independent():
+    # identical windows on two threads: neither subtracts from the other
+    _span("w", 0.0, 1.0, tid=1)
+    _span("w2", 0.2, 0.5, tid=2)
+    s = _selves()
+    assert s["w"] == pytest.approx(1.0)
+    assert s["w2"] == pytest.approx(0.5)
+
+
+def test_self_time_real_nested_spans():
+    import time
+    with metrics.span("att_outer"):
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 0.03:
+            pass
+        with metrics.span("att_inner"):
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < 0.03:
+                pass
+    rows = {r["name"]: r for r in tracing.self_times()}
+    outer, inner = rows["att_outer"], rows["att_outer/att_inner"]
+    assert inner["self"] == pytest.approx(inner["dur"])
+    assert outer["self"] == pytest.approx(outer["dur"] - inner["dur"],
+                                          abs=5e-3)
+    assert outer["self"] >= 0.025    # the busy-wait outside the child
+
+
+# ---------------------------------------------------------------------------
+# subsystem mapping + report rollup
+# ---------------------------------------------------------------------------
+
+def test_span_subsystem_mapping():
+    cases = {
+        "engine.stream": "engine",
+        "bench.fit_panel/engine.stream": "engine",   # leaf decides
+        "serving.heal": "statespace",
+        "kalman.filter": "statespace",
+        "statespace.build": "statespace",
+        "fleet.pump": "statespace",
+        "quality.score": "statespace",
+        "backtest.sweep": "backtest",
+        "arima.fit": "models",
+        "optimize.lm": "models",
+        "resilience.fit.arima": "models",
+        "longseries.combine": "models",
+        "bench.device_resident": "utils",
+        "telemetry.scrape": "utils",
+        "no_dot_at_all": "utils",
+    }
+    for path, want in cases.items():
+        assert tracing.span_subsystem(path) == want, path
+
+
+def test_self_time_report_rollup_and_fixed_keys():
+    _span("engine.stream", 0.0, 1.0)
+    _span("engine.stream/engine.dispatch", 0.1, 0.3)
+    _span("arima.fit", 2.0, 0.5)
+    _span("serving.update", 3.0, 0.25)
+    rep = tracing.self_time_report(10)
+    assert set(rep["subsystems"]) == set(tracing.SUBSYSTEMS)
+    subs = rep["subsystems"]
+    # engine.stream self 0.7 + engine.dispatch 0.3
+    assert subs["engine"]["self_s"] == pytest.approx(1.0)
+    assert subs["engine"]["spans"] == 2
+    assert subs["models"]["self_s"] == pytest.approx(0.5)
+    assert subs["statespace"]["self_s"] == pytest.approx(0.25)
+    # unexercised subsystems are measured zeros, not absences
+    assert subs["backtest"] == {"self_s": 0.0, "spans": 0}
+    assert rep["total_self_s"] == pytest.approx(1.75)
+    by_name = {r["name"]: r for r in rep["spans"]}
+    assert by_name["engine.stream"]["self_s"] == pytest.approx(0.7)
+    assert by_name["engine.stream"]["dur_s"] == pytest.approx(1.0)
+    # aggregation: two instances of one name fold into one row
+    metrics.clear_trace()
+    _span("x.a", 0.0, 0.2)
+    _span("x.a", 1.0, 0.3)
+    row = tracing.self_time_report(5)["spans"][0]
+    assert row["count"] == 2 and row["dur_s"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# stream_fit phase accounting + bubbles
+# ---------------------------------------------------------------------------
+
+def _panel(S, T, seed=0):
+    rng = np.random.default_rng(seed)
+    return np.cumsum(rng.normal(size=(S, T)), axis=1).astype(np.float32)
+
+
+PHASE_MS = ("prep_ms", "pad_ms", "dispatch_ms", "device_wait_ms",
+            "reattach_ms", "commit_ms")
+
+
+def test_stream_fit_phase_accounting_sums_to_chunk_wall():
+    eng = E.FitEngine()
+    res = eng.stream_fit(_panel(24, 64), "ar", chunk_size=8, max_lag=2)
+    ph = res.stats["phases"]
+    assert len(ph["per_chunk"]) == 3 and ph["records_dropped"] == 0
+    for row in ph["per_chunk"]:
+        assert set(PHASE_MS + ("bubble_ms", "wall_ms", "chunk",
+                               "start", "stop")) <= set(row)
+        # each phase is timed inside one of the two call windows that
+        # make up wall_ms, so the six phases can never (modulo ~1ms of
+        # timer glue) exceed the chunk wall
+        assert sum(row[k] for k in PHASE_MS) <= row["wall_ms"] + 1.0
+        assert all(row[k] >= 0.0 for k in PHASE_MS + ("bubble_ms",))
+    tot = ph["totals_ms"]
+    assert set(tot) == {k for k in PHASE_MS} | {"bubble_ms"} \
+        or set(tot) >= set(PHASE_MS)
+    assert 0.0 <= ph["host_overhead_frac"] <= 1.0
+    assert ph["host_ms"] == pytest.approx(
+        sum(tot[k] for k in PHASE_MS if k != "device_wait_ms"), abs=0.1)
+    # gauges published for the scrape surface
+    g = metrics.snapshot()["gauges"]
+    assert g["engine.host_overhead_frac"] == pytest.approx(
+        ph["host_overhead_frac"], abs=1e-3)
+    assert g["engine.bubble_ms_total"] == ph["bubble_ms_total"]
+    # the bubble is a between-chunk gap: chunk 0 has none by definition
+    assert ph["per_chunk"][0]["bubble_ms"] == 0.0
+
+
+def test_stream_fit_phase_records_capped_not_silently():
+    eng = E.FitEngine()
+    res = eng.stream_fit(_panel(160, 24, seed=1), "ewma", chunk_size=2)
+    ph = res.stats["phases"]
+    assert len(ph["per_chunk"]) == 64          # _PHASE_RECORD_CAP
+    assert ph["records_dropped"] == 80 - 64    # overflow is counted
+    # totals still cover every chunk, not just the recorded ones
+    assert ph["stage_wall_ms"] > 0.0
+
+
+# ---------------------------------------------------------------------------
+# bench_diff: golden over the real in-repo history
+# ---------------------------------------------------------------------------
+
+def _load_tool(name, subdir="tools"):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, subdir, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bench_diff = _load_tool("bench_diff")
+bench_gate = _load_tool("bench_gate")
+
+
+@pytest.mark.skipif(
+    not os.path.exists(os.path.join(REPO, "BENCH_r04.json")),
+    reason="in-repo bench history not present")
+def test_bench_diff_golden_r04_vs_r07():
+    old = bench_gate.load_round(os.path.join(REPO, "BENCH_r04.json"))
+    new = bench_gate.load_round(os.path.join(REPO, "BENCH_r07.json"))
+    d = bench_diff.diff_rounds(old, new, top=12)
+    assert (d["old_round"], d["new_round"]) == (4, 7)
+    assert d["platform"] == "cpu"
+    assert d["headline"]["old"] == pytest.approx(2520.6)
+    assert d["headline"]["new"] == pytest.approx(2026.8)
+    assert d["headline"]["delta_pct"] == pytest.approx(-19.6, abs=0.05)
+    assert d["spans"] and d["counters"]
+    # both rounds predate the self-time block: absent, never zeros
+    assert d["self_times"] is None and d["subsystems"] is None
+    # share percentages are attribution weights over |delta|
+    assert all(0.0 <= r["share_pct"] <= 100.0 for r in d["spans"])
+    # curve diff covers the common panel sizes
+    assert {p["n"] for p in d["curve"]} == {8192, 16384}
+
+
+@pytest.mark.skipif(
+    not os.path.exists(os.path.join(REPO, "BENCH_r04.json")),
+    reason="in-repo bench history not present")
+def test_bench_diff_cli_golden_and_errors(capsys):
+    assert bench_diff.main(["r04", "r07", "--dir", REPO]) == 0
+    out = capsys.readouterr().out
+    assert "bench diff: r04 -> r07" in out
+    assert "2520.6 -> 2026.8 series/s" in out and "-19.6%" in out
+    assert "SPAN TOTALS" in out and "COUNTERS" in out
+    # selector forms are forgiving; JSON mode is machine-readable
+    assert bench_diff.main(["4", "7", "--dir", REPO, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["new_round"] == 7 and doc["headline"]["delta"] is not None
+    # unknown round: usage error, exit 2
+    assert bench_diff.main(["r99", "r07", "--dir", REPO]) == 2
+    assert "no round matching" in capsys.readouterr().err
+    # exactly one selector is an argparse error
+    with pytest.raises(SystemExit):
+        bench_diff.main(["r04", "--dir", REPO])
+
+
+def _diff_round_file(tmp_path, n, value, *, rc=0, self_spans=None,
+                     subsystems=None, attribution=None, spans=None,
+                     counters=None):
+    m = {"spans": {k: {"count": 1, "total_s": v}
+                   for k, v in (spans or {}).items()}}
+    if counters:
+        m["engine"] = counters
+    if self_spans is not None:
+        m["self_times"] = {
+            "spans": [{"name": k, "count": 1, "dur_s": v, "self_s": v}
+                      for k, v in self_spans.items()],
+            "subsystems": subsystems or {},
+            "total_self_s": sum(self_spans.values()),
+        }
+    headline = {"metric": "fit_throughput", "value": value,
+                "unit": "series/sec", "platform": "cpu", "metrics": m,
+                "scaling_curve": {"64": value}}
+    if attribution is not None:
+        headline["engine_attribution"] = attribution
+    wrapper = {"n": n, "cmd": "python bench.py", "rc": rc, "tail": "",
+               "parsed": headline}
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps(wrapper))
+
+
+def test_bench_diff_default_selection_skips_crashed_rounds(tmp_path,
+                                                           capsys):
+    _diff_round_file(tmp_path, 1, 100.0, spans={"a.fit": 1.0})
+    _diff_round_file(tmp_path, 2, 90.0, spans={"a.fit": 2.0})
+    _diff_round_file(tmp_path, 3, 50.0, rc=1, spans={"a.fit": 9.0})
+    # newest crashed round (r03) is not comparable: default diff is
+    # r01 -> r02, exactly bench_gate's filter
+    assert bench_diff.main(["--dir", str(tmp_path)]) == 0
+    assert "bench diff: r01 -> r02" in capsys.readouterr().out
+    # fewer than two comparable rounds: exit 2, not a traceback
+    solo = tmp_path / "solo"
+    solo.mkdir()
+    _diff_round_file(solo, 1, 100.0)
+    assert bench_diff.main(["--dir", str(solo)]) == 2
+    assert "need 2" in capsys.readouterr().err
+
+
+def test_bench_diff_self_time_and_attribution_sections(tmp_path):
+    subs_old = {"engine": {"self_s": 1.0, "spans": 2},
+                "models": {"self_s": 2.0, "spans": 1}}
+    subs_new = {"engine": {"self_s": 3.0, "spans": 2},
+                "models": {"self_s": 2.0, "spans": 1}}
+    att_old = {"host_overhead_frac": 0.10, "bubble_ms_total": 5.0,
+               "host_ms": 100.0, "wall_ms": 1000.0, "totals_ms": {}}
+    att_new = {"host_overhead_frac": 0.30, "bubble_ms_total": 50.0,
+               "host_ms": 300.0, "wall_ms": 1000.0, "totals_ms": {}}
+    _diff_round_file(tmp_path, 1, 100.0,
+                     self_spans={"engine.dispatch": 1.0, "arima.fit": 2.0},
+                     subsystems=subs_old, attribution=att_old,
+                     spans={"engine.stream": 3.0},
+                     counters={"engine.chunks": 4})
+    _diff_round_file(tmp_path, 2, 80.0,
+                     self_spans={"engine.dispatch": 3.0, "arima.fit": 2.0},
+                     subsystems=subs_new, attribution=att_new,
+                     spans={"engine.stream": 5.0},
+                     counters={"engine.chunks": 8})
+    h = bench_gate.load_history(str(tmp_path))
+    d = bench_diff.diff_rounds(h[0], h[1])
+    # the self-time table drops the unchanged span and leads with the
+    # mover, carrying 100% of the absolute movement
+    assert d["self_times"] == [
+        {"name": "engine.dispatch", "old": 1.0, "new": 3.0,
+         "delta": 2.0, "share_pct": 100.0}]
+    assert d["subsystems"][0]["name"] == "engine"
+    assert d["attribution"]["host_overhead_frac"] == {"old": 0.10,
+                                                      "new": 0.30}
+    assert d["attribution"]["bubble_ms_total"]["new"] == 50.0
+    assert d["counters"][0]["name"] == "engine.chunks"
+    rendered = bench_diff.render(d)
+    assert "SPAN SELF-TIME" in rendered and "SUBSYSTEM" in rendered
+    assert "host_overhead_frac 0.100 -> 0.300" in rendered
+
+
+# ---------------------------------------------------------------------------
+# bench gate: host-overhead seeding (tolerated-absent, then armed)
+# ---------------------------------------------------------------------------
+
+def test_gate_host_overhead_tolerated_absent_then_armed(tmp_path):
+    att = lambda f: {"host_overhead_frac": f, "bubble_ms_total": 1.0,
+                     "host_ms": 10.0, "wall_ms": 100.0, "totals_ms": {}}
+    # pre-tier history: the metric is skipped, never a fabricated zero
+    for n in (1, 2, 3):
+        _diff_round_file(tmp_path, n, 1000.0)
+    _diff_round_file(tmp_path, 4, 1000.0, attribution=att(0.10))
+    verdict = bench_gate.evaluate(bench_gate.load_history(str(tmp_path)))
+    rows = {r["metric"]: r for r in verdict["rows"]}
+    assert rows["engine_host_overhead_frac"]["status"] == "skipped"
+    assert verdict["status"] == "pass"
+    # once seeded, a grown fraction regresses (lower-better, 25%)
+    for n in (5, 6):
+        _diff_round_file(tmp_path, n, 1000.0, attribution=att(0.10))
+    _diff_round_file(tmp_path, 7, 1000.0, attribution=att(0.50))
+    verdict = bench_gate.evaluate(bench_gate.load_history(str(tmp_path)))
+    rows = {r["metric"]: r for r in verdict["rows"]}
+    assert rows["engine_host_overhead_frac"]["status"] == "REGRESSED"
+    assert verdict["status"] == "regressed"
+    # and a steady fraction passes
+    _diff_round_file(tmp_path, 7, 1000.0, attribution=att(0.11))
+    verdict = bench_gate.evaluate(bench_gate.load_history(str(tmp_path)))
+    rows = {r["metric"]: r for r in verdict["rows"]}
+    assert rows["engine_host_overhead_frac"]["status"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# bench probe: hard timeout -> CPU fallback with a marker
+# ---------------------------------------------------------------------------
+
+def test_probe_timeout_falls_back_with_marker(monkeypatch):
+    bench = _load_tool("bench", subdir="")
+
+    def hang(*a, **k):
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=0.01)
+
+    monkeypatch.setattr(bench.subprocess, "run", hang)
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT_S", "0.01")
+    bench._PROBE_STATE["timed_out"] = False
+    try:
+        assert bench._probe_backend() is None   # fell back, didn't hang
+        assert bench._PROBE_STATE["timed_out"] is True
+        # every record of the fallback run carries the marker...
+        rec = {"metric": "x", "value": 1.0}
+        bench._mark_degraded(rec, "probe out")
+        assert rec["probe_timed_out"] is True
+        assert rec["degraded"] == bench.DEGRADED_NOTE
+        # ...but a clean (non-degraded) record never does
+        clean = {"metric": "x"}
+        bench._mark_degraded(clean, None)
+        assert "probe_timed_out" not in clean
+    finally:
+        bench._PROBE_STATE["timed_out"] = False
+
+
+# ---------------------------------------------------------------------------
+# surfaces: /snapshot.json attribution section + sts_top
+# ---------------------------------------------------------------------------
+
+def test_snapshot_doc_carries_attribution():
+    _span("engine.stream", 0.0, 1.0)
+    _span("arima.fit", 2.0, 0.5)
+    doc = telemetry.snapshot_doc()
+    att = doc["attribution"]
+    assert set(att["self_times"]["subsystems"]) \
+        == set(tracing.SUBSYSTEMS)
+    names = [r["name"] for r in att["self_times"]["spans"]]
+    assert "engine.stream" in names and "arima.fit" in names
+
+
+def test_sts_top_attribution_panel_and_version_tolerance():
+    from tools import sts_top
+
+    snap = {"pid": 1, "attribution": {
+        "self_times": {
+            "spans": [{"name": "engine.dispatch", "count": 3,
+                       "dur_s": 1.5, "self_s": 1.2}],
+            "subsystems": {"engine": {"self_s": 1.2, "spans": 1}},
+            "total_self_s": 1.2},
+        "engine": {"engine.host_overhead_frac": 0.42,
+                   "engine.bubble_ms_total": 7.5}}}
+    frame = sts_top.render_snapshot(snap)
+    assert "ATTRIBUTION" in frame
+    assert "engine.dispatch" in frame
+    assert "host_overhead_frac 0.420" in frame and "7.5ms" in frame
+    # an older exporter's snapshot renders a marked absence, no crash
+    old = sts_top.render_snapshot({"pid": 1})
+    assert "predates the attribution plane" in old
+    err = sts_top.render_snapshot(
+        {"pid": 1, "attribution": {"error": "boom"}})
+    assert "scrape error: boom" in err
+
+
+def test_sts_top_sort_orders_and_validation(capsys):
+    from tools import sts_top
+
+    def job(jid, eta, hb, fails):
+        return {"job_id": jid, "family": "ar", "status": "running",
+                "chunks_total": 4, "chunks_done": 1,
+                "chunks_failed": fails, "chunks_quarantined": 0,
+                "chunks_degraded": 0, "journal_commits": 0,
+                "eta_s": eta, "throughput_series_per_s": 1.0,
+                "heartbeat_age_s": hb, "stale_after_s": 1e9,
+                "heartbeat_stage": "fit"}
+
+    snap = {"pid": 1, "jobs": [job("a", 50.0, 1.0, 0),
+                               job("b", 10.0, 9.0, 2),
+                               job("c", None, 5.0, 1)]}
+
+    def order(sort):
+        frame = sts_top.render_snapshot(snap, job_sort=sort)
+        jobs_panel = frame[frame.index("JOBS"):frame.index("SERVING")]
+        rows = [ln for ln in jobs_panel.splitlines()
+                if ln.strip()[:1] in ("a", "b", "c")]
+        return [ln.split()[0] for ln in rows]
+
+    assert order("eta") == ["b", "a", "c"]        # None ETA last
+    assert order("hb-age") == ["b", "c", "a"]     # stalest first
+    assert order("fails") == ["b", "c", "a"]      # most failures first
+    assert "sort=fails" in sts_top.render_snapshot(snap,
+                                                   job_sort="fails")
+    # the CLI rejects unknown sorts with a named error, like --interval
+    with pytest.raises(SystemExit) as exc:
+        sts_top.main(["http://127.0.0.1:1/", "--once", "--sort", "nope"])
+    assert exc.value.code == 2
+    assert "--sort must be one of" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# acceptance pin: 0 recompiles with the whole plane armed
+# ---------------------------------------------------------------------------
+
+def test_warmed_tick_zero_compiles_with_attribution_armed():
+    """The attribution plane is pure host accounting: warmed serving
+    ticks with the telemetry exporter up AND self-time reports being
+    pulled between ticks trigger exactly zero XLA compiles."""
+    import jax.numpy as jnp
+
+    from spark_timeseries_tpu.models import arima
+    from spark_timeseries_tpu import statespace as ss
+
+    metrics.install_jax_hooks()
+    panel = _panel(4, 320, seed=11)
+    hist, live = panel[:, :300], panel[:, 300:]
+    model = arima.fit(2, 0, 0, jnp.asarray(hist), warn=False)
+    sess = ss.ServingSession.start(model, hist, label="attpin")
+    srv = telemetry.start(port=0)
+    try:
+        sess.warmup()
+        sess.forecast(6)
+        before = metrics.jax_stats()["jit_compiles"]
+        for t in range(6):
+            sess.update(live[:, t])
+            tracing.self_time_report(8)       # the plane, mid-flight
+            tracing.slowest_spans(5)
+        telemetry.snapshot_doc()              # attribution scrape too
+        sess.forecast(6)
+        assert metrics.jax_stats()["jit_compiles"] - before == 0, \
+            "compiles leaked into the attribution-armed warmed ticks"
+    finally:
+        telemetry.stop()

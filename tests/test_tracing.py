@@ -122,13 +122,32 @@ def test_private_registry_spans_stay_off_global_timeline():
 
 
 def test_slowest_spans_ranked_and_capped():
-    for name, dur in [("a", 0.3), ("b", 0.1), ("c", 0.2)]:
+    # disjoint windows (ts 0/1/2): ranking is by inclusive duration, and
+    # with no nesting each span's self-time equals its duration
+    for name, ts, dur in [("a", 0.0, 0.3), ("b", 1.0, 0.1),
+                          ("c", 2.0, 0.2)]:
         metrics.trace_buffer().append(
-            {"kind": "span", "name": name, "ts": 0.0, "dur": dur,
+            {"kind": "span", "name": name, "ts": ts, "dur": dur,
              "tid": 1, "tname": "t"})
     top = tracing.slowest_spans(2)
     assert [r["name"] for r in top] == ["a", "c"]
     assert top[0]["dur_s"] == pytest.approx(0.3)
+    assert top[0]["self_s"] == pytest.approx(0.3)
+
+
+def test_slowest_spans_tie_order_stable_and_self_time_column():
+    # two equal-duration spans must order by name (the stable secondary
+    # sort), and a parent's row carries self-time net of its child
+    for name, ts, dur in [("zz", 1.0, 0.2), ("aa", 2.0, 0.2),
+                          ("outer", 4.0, 0.5), ("outer/inner", 4.1, 0.3)]:
+        metrics.trace_buffer().append(
+            {"kind": "span", "name": name, "ts": ts, "dur": dur,
+             "tid": 1, "tname": "t"})
+    top = tracing.slowest_spans(4)
+    assert [r["name"] for r in top] == ["outer", "outer/inner",
+                                       "aa", "zz"]
+    assert top[0]["self_s"] == pytest.approx(0.2)     # 0.5 - 0.3
+    assert top[1]["self_s"] == pytest.approx(0.3)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,456 @@
+"""bench_diff: mechanical regression forensics between two BENCH rounds.
+
+``tools/bench_gate.py`` answers *whether* the newest round regressed;
+this tool answers *where the milliseconds went*.  ``make bench-diff``
+(or ``python tools/bench_diff.py [OLD] [NEW]``) loads two
+``BENCH_r*.json`` artifacts — by default the newest two **comparable**
+rounds, with exactly bench_gate's filter (same platform as the newest
+valid round, ``rc == 0``, a non-null headline value) — and attributes
+the headline throughput delta to the concrete spans and counters that
+moved:
+
+- **headline**: old/new series-per-second and the signed percentage
+  delta, plus the gated headline metrics (fit wall, compile seconds,
+  serving p50, ...) side by side;
+- **spans**: per-span inclusive seconds (``metrics.spans[*].total_s``)
+  diffed by name, ranked by absolute change, each with its signed
+  contribution and its share of the total absolute span movement — the
+  "top host-side spans responsible" table;
+- **self-times**: when both rounds carry the attribution plane's
+  ``metrics.self_times`` block (PR 16+), the same table on *exclusive*
+  self-time — a parent that merely wraps a slower child drops out —
+  plus the per-subsystem rollup deltas (engine / statespace / backtest /
+  models / utils);
+- **counters**: the engine / fit / serving / backtest counter blocks
+  diffed by key, ranked by relative change (a counter that appears or
+  disappears ranks first);
+- **attribution**: old-vs-new ``engine_attribution`` summary
+  (host_overhead_frac, bubble_ms_total, per-phase totals) when present;
+- **cost**: the headline family's compiled-program cost report deltas
+  (flops, bytes, peak memory, HLO op count, compile seconds);
+- **curve**: the scaling-curve points both rounds measured, diffed
+  per panel size.
+
+Output is a human table by default, the same structure as JSON with
+``--json``.  This is a forensics tool, not a gate: it exits 0 whenever
+it could diff (regressions and improvements alike), 2 on usage errors
+(unknown round, fewer than two comparable rounds).
+
+Round selectors are forgiving: ``r04``, ``04``, ``4``, or a path to the
+artifact file all name round 4.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def _load_bench_gate():
+    """bench_gate is both a sibling script and (via tools/__init__.py) a
+    package module; load it whichever way the interpreter allows so
+    ``python tools/bench_diff.py``, ``python -m tools.bench_diff``, and
+    an importlib-loaded test all work."""
+    try:
+        from tools import bench_gate  # type: ignore
+        return bench_gate
+    except Exception:  # noqa: BLE001 — fall back to a file load
+        spec = importlib.util.spec_from_file_location(
+            "bench_gate", os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "bench_gate.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+
+bench_gate = _load_bench_gate()
+
+# counter blocks diffed by key (each block's keys are already
+# namespace-prefixed, so one merged dict cannot collide)
+_COUNTER_BLOCKS = ("engine", "fit_counters", "serving", "backtest")
+
+# scalar cost-report fields worth diffing (the HLO op histogram is too
+# wide for a diff table; hlo_ops_total summarizes it)
+_COST_FIELDS = ("flops", "bytes_accessed", "transcendentals",
+                "peak_bytes", "temp_bytes", "hlo_ops_total",
+                "lower_s", "compile_s")
+
+
+def _num(v: Any) -> Optional[float]:
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def _metrics(headline: Optional[dict]) -> dict:
+    m = (headline or {}).get("metrics")
+    return m if isinstance(m, dict) else {}
+
+
+def span_totals(headline: Optional[dict]) -> Dict[str, float]:
+    """``{span path: inclusive total seconds}`` from a round's aggregate
+    span histograms."""
+    out: Dict[str, float] = {}
+    spans = _metrics(headline).get("spans")
+    if isinstance(spans, dict):
+        for name, st in spans.items():
+            v = _num((st or {}).get("total_s")) if isinstance(st, dict) \
+                else None
+            if v is not None:
+                out[name] = v
+    return out
+
+
+def self_totals(headline: Optional[dict]
+                ) -> Optional[Dict[str, float]]:
+    """``{span name: exclusive self seconds}`` from the attribution
+    plane's ``metrics.self_times`` block; None when the round predates
+    it (r01–r07) — a diff must not fabricate zeros for an unmeasured
+    round."""
+    st = _metrics(headline).get("self_times")
+    if not isinstance(st, dict):
+        return None
+    out: Dict[str, float] = {}
+    for row in st.get("spans") or []:
+        if isinstance(row, dict) and isinstance(row.get("name"), str):
+            v = _num(row.get("self_s"))
+            if v is not None:
+                out[row["name"]] = v
+    return out
+
+
+def subsystem_totals(headline: Optional[dict]
+                     ) -> Optional[Dict[str, float]]:
+    st = _metrics(headline).get("self_times")
+    if not isinstance(st, dict) \
+            or not isinstance(st.get("subsystems"), dict):
+        return None
+    out: Dict[str, float] = {}
+    for sub, row in st["subsystems"].items():
+        v = _num((row or {}).get("self_s")) if isinstance(row, dict) \
+            else None
+        if v is not None:
+            out[str(sub)] = v
+    return out
+
+
+def counter_totals(headline: Optional[dict]) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    m = _metrics(headline)
+    for block in _COUNTER_BLOCKS:
+        b = m.get(block)
+        if isinstance(b, dict):
+            for k, v in b.items():
+                n = _num(v)
+                if n is not None:
+                    out[str(k)] = n
+    return out
+
+
+def _delta_rows(old: Dict[str, float], new: Dict[str, float],
+                top: int) -> List[Dict[str, Any]]:
+    """Signed per-key deltas over the union of keys, ranked by absolute
+    change (name-stable on ties), each with its share of the total
+    absolute movement — the attribution weights."""
+    keys = set(old) | set(new)
+    rows = []
+    for k in keys:
+        o, n = old.get(k, 0.0), new.get(k, 0.0)
+        d = n - o
+        if d == 0.0:
+            continue    # a diff shows movement; unchanged rows are noise
+        rows.append({"name": k, "old": round(o, 6), "new": round(n, 6),
+                     "delta": round(d, 6)})
+    total_abs = sum(abs(r["delta"]) for r in rows)
+    for r in rows:
+        r["share_pct"] = round(100.0 * abs(r["delta"]) / total_abs, 1) \
+            if total_abs > 0 else 0.0
+    rows.sort(key=lambda r: (-abs(r["delta"]), r["name"]))
+    return rows[:top]
+
+
+def _rel_delta_rows(old: Dict[str, float], new: Dict[str, float],
+                    top: int) -> List[Dict[str, Any]]:
+    """Counter deltas ranked by *relative* change (mixed units — bytes
+    next to chunk counts — make absolute ranking meaningless); a key
+    present on only one side ranks by its absolute size."""
+    keys = set(old) | set(new)
+    rows = []
+    for k in keys:
+        o, n = old.get(k), new.get(k)
+        ov, nv = o or 0.0, n or 0.0
+        if ov == nv:
+            continue
+        base = min(abs(ov), abs(nv))
+        rel = abs(nv - ov) / base if base > 0 else float("inf")
+        rows.append({"name": k, "old": o, "new": n,
+                     "delta": round(nv - ov, 6), "_rel": rel})
+    rows.sort(key=lambda r: (-r["_rel"], -abs(r["delta"]), r["name"]))
+    for r in rows:
+        del r["_rel"]
+    return rows[:top]
+
+
+def diff_rounds(old: Dict[str, Any], new: Dict[str, Any],
+                top: int = 12) -> Dict[str, Any]:
+    """The full diff document between two loaded rounds (the
+    ``bench_gate.load_round`` shape).  Pure; the CLI renders it."""
+    ho, hn = old["headline"], new["headline"]
+    vo, vn = _num((ho or {}).get("value")), _num((hn or {}).get("value"))
+    headline: Dict[str, Any] = {"old": vo, "new": vn}
+    if vo is not None and vn is not None:
+        headline["delta"] = round(vn - vo, 1)
+        headline["delta_pct"] = round(100.0 * (vn - vo) / vo, 1) \
+            if vo else None
+    gated_old = bench_gate.extract_metrics(ho)
+    gated_new = bench_gate.extract_metrics(hn)
+    gated = {}
+    for k in sorted(set(gated_old) | set(gated_new)):
+        gated[k] = {"old": gated_old.get(k), "new": gated_new.get(k)}
+
+    selfs_o, selfs_n = self_totals(ho), self_totals(hn)
+    subs_o, subs_n = subsystem_totals(ho), subsystem_totals(hn)
+
+    att = None
+    ea_o, ea_n = (ho or {}).get("engine_attribution"), \
+        (hn or {}).get("engine_attribution")
+    if isinstance(ea_o, dict) or isinstance(ea_n, dict):
+        att = {}
+        for field in ("host_overhead_frac", "bubble_ms_total", "host_ms",
+                      "wall_ms"):
+            att[field] = {
+                "old": _num((ea_o or {}).get(field)),
+                "new": _num((ea_n or {}).get(field)),
+            }
+        att["totals_ms"] = {
+            "old": (ea_o or {}).get("totals_ms"),
+            "new": (ea_n or {}).get("totals_ms"),
+        }
+
+    cost = None
+    co = ((ho or {}).get("cost_reports") or {})
+    cn = ((hn or {}).get("cost_reports") or {})
+    fam = next(iter(cn), None) or next(iter(co), None)
+    if fam and isinstance(cn.get(fam) or co.get(fam), dict):
+        cost = {"family": fam}
+        for field in _COST_FIELDS:
+            o = _num((co.get(fam) or {}).get(field))
+            n = _num((cn.get(fam) or {}).get(field))
+            if o is not None or n is not None:
+                cost[field] = {"old": o, "new": n}
+
+    curve = []
+    curve_o = (ho or {}).get("scaling_curve") or {}
+    curve_n = (hn or {}).get("scaling_curve") or {}
+    if isinstance(curve_o, dict) and isinstance(curve_n, dict):
+        for k in sorted(set(curve_o) & set(curve_n),
+                        key=lambda s: int(s) if s.isdigit() else 0):
+            o, n = _num(curve_o[k]), _num(curve_n[k])
+            if o is None or n is None:
+                continue
+            curve.append({
+                "n": int(k) if k.isdigit() else k, "old": o, "new": n,
+                "delta_pct": round(100.0 * (n - o) / o, 1) if o else None,
+            })
+
+    return {
+        "old_round": old["round"],
+        "new_round": new["round"],
+        "platform": (hn or {}).get("platform"),
+        "headline": headline,
+        "gated_metrics": gated,
+        "spans": _delta_rows(span_totals(ho), span_totals(hn), top),
+        "self_times": _delta_rows(selfs_o, selfs_n, top)
+        if selfs_o is not None and selfs_n is not None else None,
+        "subsystems": _delta_rows(subs_o, subs_n, top)
+        if subs_o is not None and subs_n is not None else None,
+        "counters": _rel_delta_rows(counter_totals(ho),
+                                    counter_totals(hn), top),
+        "attribution": att,
+        "cost": cost,
+        "curve": curve,
+    }
+
+
+def _fmt(v: Any, fmt: str = "{:.3f}") -> str:
+    return fmt.format(v) if isinstance(v, (int, float)) else "-"
+
+
+def _delta_table(title: str, rows: List[Dict[str, Any]],
+                 unit: str = "s") -> List[str]:
+    lines = [title]
+    if not rows:
+        lines.append("  (nothing moved)")
+        return lines
+    w = max(len(r["name"]) for r in rows)
+    hdr = (f"  {'name':<{w}} {'old_' + unit:>12} {'new_' + unit:>12} "
+           f"{'delta':>12} {'share%':>7}")
+    lines.append(hdr)
+    lines.append("  " + "-" * (len(hdr) - 2))
+    for r in rows:
+        share = r.get("share_pct")
+        lines.append(
+            f"  {r['name']:<{w}} {_fmt(r['old']):>12} "
+            f"{_fmt(r['new']):>12} "
+            f"{_fmt(r['delta'], '{:+.3f}'):>12} "
+            f"{_fmt(share, '{:.1f}'):>7}")
+    return lines
+
+
+def render(d: Dict[str, Any]) -> str:
+    lines = [
+        f"bench diff: r{d['old_round']:02d} -> r{d['new_round']:02d} "
+        f"(platform={d['platform']})",
+    ]
+    h = d["headline"]
+    pct = h.get("delta_pct")
+    lines.append(
+        f"headline: {_fmt(h.get('old'), '{:.1f}')} -> "
+        f"{_fmt(h.get('new'), '{:.1f}')} series/s"
+        + (f"  ({pct:+.1f}%)" if isinstance(pct, (int, float)) else ""))
+    lines.append("")
+
+    if d.get("self_times") is not None:
+        lines += _delta_table(
+            "SPAN SELF-TIME (exclusive seconds, ranked by |delta|)",
+            d["self_times"])
+        lines.append("")
+        if d.get("subsystems") is not None:
+            lines += _delta_table("SUBSYSTEM SELF-TIME (seconds)",
+                                  d["subsystems"])
+            lines.append("")
+    lines += _delta_table(
+        "SPAN TOTALS (inclusive seconds, ranked by |delta|)", d["spans"])
+    lines.append("")
+    lines += _delta_table(
+        "COUNTERS (ranked by relative change)", d["counters"], unit="n")
+    lines.append("")
+
+    att = d.get("attribution")
+    if att:
+        f = att.get("host_overhead_frac", {})
+        b = att.get("bubble_ms_total", {})
+        lines.append(
+            f"engine attribution: host_overhead_frac "
+            f"{_fmt(f.get('old'))} -> {_fmt(f.get('new'))}   "
+            f"bubble_ms {_fmt(b.get('old'), '{:.1f}')} -> "
+            f"{_fmt(b.get('new'), '{:.1f}')}")
+        lines.append("")
+    cost = d.get("cost")
+    if cost:
+        parts = []
+        for field in _COST_FIELDS:
+            fv = cost.get(field)
+            if isinstance(fv, dict):
+                parts.append(f"{field} {_fmt(fv['old'], '{:.4g}')} -> "
+                             f"{_fmt(fv['new'], '{:.4g}')}")
+        if parts:
+            lines.append(f"cost ({cost.get('family')}): "
+                         + "  ".join(parts))
+            lines.append("")
+    if d.get("curve"):
+        lines.append("scaling curve (series/s):")
+        for p in d["curve"]:
+            pct = p.get("delta_pct")
+            lines.append(
+                f"  n={p['n']:<8} {_fmt(p['old'], '{:.1f}'):>10} -> "
+                f"{_fmt(p['new'], '{:.1f}'):>10}"
+                + (f"  ({pct:+.1f}%)"
+                   if isinstance(pct, (int, float)) else ""))
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def _find_round(history: List[Dict[str, Any]], selector: str
+                ) -> Optional[Dict[str, Any]]:
+    """Resolve ``r04`` / ``04`` / ``4`` / a path to a loaded round."""
+    sel = selector.strip()
+    if os.path.sep in sel or sel.endswith(".json"):
+        target = os.path.abspath(sel)
+        for r in history:
+            if os.path.abspath(r["path"]) == target:
+                return r
+        return None
+    digits = sel[1:] if sel[:1] in ("r", "R") else sel
+    if not digits.isdigit():
+        return None
+    num = int(digits)
+    for r in history:
+        if r["round"] == num:
+            return r
+    return None
+
+
+def pick_default_rounds(history: List[Dict[str, Any]]
+                        ) -> Tuple[Optional[dict], Optional[dict], str]:
+    """The newest two comparable rounds, bench_gate's definition: the
+    newest round with a measured headline fixes the platform; both
+    sides must be rc==0 (or unknown) with a non-null value on that
+    platform."""
+    newest = None
+    for r in reversed(history):
+        h = r["headline"]
+        if isinstance(h, dict) and _num(h.get("value")) is not None:
+            newest = r
+            break
+    if newest is None:
+        return None, None, "no round with a measured headline value"
+    platform = newest["headline"].get("platform")
+    comp = [r for r in history if bench_gate.comparable(r, platform)]
+    if len(comp) < 2:
+        return None, None, (f"{len(comp)} comparable round(s) on "
+                            f"platform {platform!r}, need 2")
+    return comp[-2], comp[-1], ""
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.bench_diff",
+        description="Attribute the throughput delta between two BENCH "
+                    "rounds to the spans/counters that moved "
+                    "(default: the newest two comparable rounds).")
+    ap.add_argument("old", nargs="?", default=None,
+                    help="older round: r04 / 4 / a path "
+                         "(default: second-newest comparable)")
+    ap.add_argument("new", nargs="?", default=None,
+                    help="newer round (default: newest comparable)")
+    ap.add_argument("--dir", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--glob", default=bench_gate.DEFAULT_GLOB,
+                    help=f"artifact glob (default "
+                         f"{bench_gate.DEFAULT_GLOB})")
+    ap.add_argument("--top", type=int, default=12,
+                    help="rows per delta table (default 12)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the diff document as JSON")
+    args = ap.parse_args(argv)
+    if (args.old is None) != (args.new is None):
+        ap.error("give both OLD and NEW rounds, or neither")
+
+    history = bench_gate.load_history(args.dir, args.glob)
+    if args.old is not None:
+        old = _find_round(history, args.old)
+        new = _find_round(history, args.new)
+        for sel, r in ((args.old, old), (args.new, new)):
+            if r is None:
+                print(f"bench diff: no round matching {sel!r} under "
+                      f"{args.dir}", file=sys.stderr)
+                return 2
+    else:
+        old, new, why = pick_default_rounds(history)
+        if old is None:
+            print(f"bench diff: {why}", file=sys.stderr)
+            return 2
+    d = diff_rounds(old, new, top=max(1, args.top))
+    if args.json:
+        print(json.dumps(d, indent=2, sort_keys=True))
+    else:
+        print(render(d), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

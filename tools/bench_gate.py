@@ -39,6 +39,7 @@ automated check (``make gate``):
   backtest_champion_mase        headline ``backtest_demo.champion_mase``      higher
   serving_live_smape            headline ``serving_demo.quality.live_smape``  higher
   drift_false_alarms            headline ``serving_demo.quality.drift_alarms`` higher
+  engine_host_overhead_frac     headline ``engine_attribution.host_overhead_frac`` higher
   ============================  ============================================  ======
 
   (``engine_cache_misses`` is the streaming engine's executable-cache
@@ -177,6 +178,7 @@ METRICS = [
     ("backtest_champion_mase", "lower_better", 25.0),
     ("serving_live_smape", "lower_better", 25.0),
     ("drift_false_alarms", "lower_better", 50.0),
+    ("engine_host_overhead_frac", "lower_better", 25.0),
     ("lint_findings", "lower_better", 50.0),
     ("contracts_failed", "lower_better", 50.0),
 ]
@@ -304,6 +306,19 @@ def extract_metrics(headline: Optional[dict]) -> Dict[str, float]:
             v = q.get("drift_alarms", 0)
             if isinstance(v, (int, float)):
                 out["drift_false_alarms"] = float(v)
+    # attribution plane (ISSUE 16): the headline point's measured
+    # host-overhead fraction — host-side phase seconds (prep, pad,
+    # dispatch, reattach, commit) over the stream's wall, from
+    # stream_fit's per-chunk phase accounting.  Lower-better: a rising
+    # fraction means the interpretive boundary crossings (the Flare
+    # cost) grew even if throughput hasn't caught it yet.  Tolerated-
+    # absent in rounds that predate the attribution plane — same
+    # protocol as serving_update_p50, no fabricated zeros.
+    ea = headline.get("engine_attribution")
+    if isinstance(ea, dict) \
+            and isinstance(ea.get("host_overhead_frac"), (int, float)):
+        out["engine_host_overhead_frac"] = \
+            float(ea["host_overhead_frac"])
     m = headline.get("metrics")
     if isinstance(m, dict):
         spans = m.get("spans")

@@ -18,12 +18,19 @@ exporter's ``/snapshot.json`` (``utils.telemetry``; armed with
 - **fleet**: per-scheduler admission/coalescing/shed state — tenants
   (live vs shed, queue depth, admitted/rejected/dropped, cache
   serves) under the aggregate p95 and SLO burn count;
+- **attribution**: the performance attribution plane (docs/design.md
+  §6g) — top span self-times with per-subsystem rollups, and the
+  streaming engine's host-overhead fraction / device-idle bubble;
 - **incidents**: the flight recorder's newest bundles (kind, age,
   size) so a crash's forensics are one glance away.
 
 ``--once`` prints a single frame and exits (scripts/CI); the default
 loop redraws every ``--interval`` seconds (default 2.0; junk or a
-non-positive value is rejected up front) until Ctrl-C.  Rendering is
+non-positive value is rejected up front) until Ctrl-C.  ``--sort``
+orders the JOBS panel by ``eta`` (soonest-finishing first, the
+default), ``hb-age`` (stalest heartbeat first), or ``fails`` (most
+failed chunks first); an unknown key is rejected up front, named, like
+a bad ``--interval``.  Rendering is
 pure (``render_snapshot(dict) -> str``) and **version-tolerant**: a
 snapshot from an older exporter (no ``fleets`` section, no per-session
 ``quality`` block) or with junk entries renders with the missing panels
@@ -81,6 +88,21 @@ def _table(headers: List[str], rows: List[List[str]]) -> List[str]:
     for row in rows:
         out.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
     return out
+
+
+# JOBS panel orderings (--sort): each key maps a job dict to a sort
+# tuple; jobs missing the field sort last (a None ETA is "unknown", not
+# "imminent")
+JOB_SORTS: Dict[str, Any] = {
+    "eta": lambda j: (not isinstance(j.get("eta_s"), (int, float)),
+                      j.get("eta_s") or 0.0),
+    "hb-age": lambda j: (
+        not isinstance(j.get("heartbeat_age_s"), (int, float)),
+        -(j.get("heartbeat_age_s") or 0.0)),
+    "fails": lambda j: (
+        not isinstance(j.get("chunks_failed"), (int, float)),
+        -(j.get("chunks_failed") or 0)),
+}
 
 
 def _job_rows(jobs: List[Dict[str, Any]]) -> List[List[str]]:
@@ -210,8 +232,50 @@ def _incident_rows(incidents: List[Dict[str, Any]],
     return rows
 
 
-def render_snapshot(snap: Dict[str, Any]) -> str:
-    """One full frame from a ``/snapshot.json`` payload (pure)."""
+def _attribution_lines(att: Any) -> List[str]:
+    """The ATTRIBUTION panel body: top span self-times, the subsystem
+    rollup, and the engine's host-overhead/bubble gauges.  Version-
+    tolerant like every other panel — an older exporter (no
+    ``attribution`` section) or a scrape-isolated error renders as a
+    marked absence, never a KeyError."""
+    if not isinstance(att, dict):
+        return ["  (exporter predates the attribution plane)"]
+    if "error" in att and "self_times" not in att:
+        return [f"  (scrape error: {str(att['error'])[:60]})"]
+    lines: List[str] = []
+    st = att.get("self_times")
+    spans = _dicts((st or {}).get("spans"))
+    if spans:
+        lines += _table(
+            ["SPAN", "SELF-s", "TOTAL-s", "N"],
+            [[str(s.get("name", "?")),
+              _fmt_num(s.get("self_s"), "{:.3f}"),
+              _fmt_num(s.get("dur_s"), "{:.3f}"),
+              str(s.get("count", "-"))] for s in spans])
+    else:
+        lines.append("  (no spans in the trace ring)")
+    subs = (st or {}).get("subsystems")
+    if isinstance(subs, dict):
+        lines.append("  subsystems: " + "  ".join(
+            f"{k} {_fmt_num((v or {}).get('self_s'), '{:.3f}')}s"
+            for k, v in sorted(subs.items())
+            if isinstance(v, dict)))
+    eng = att.get("engine")
+    if isinstance(eng, dict) and eng:
+        frac = eng.get("engine.host_overhead_frac")
+        bub = eng.get("engine.bubble_ms_total")
+        lines.append(
+            f"  engine: host_overhead_frac "
+            f"{_fmt_num(frac, '{:.3f}')}  "
+            f"bubble {_fmt_num(bub, '{:.1f}')}ms")
+    return lines
+
+
+def render_snapshot(snap: Dict[str, Any], job_sort: str = "eta") -> str:
+    """One full frame from a ``/snapshot.json`` payload (pure).
+    ``job_sort`` orders the JOBS panel (a key of :data:`JOB_SORTS`;
+    unknown keys fall back to snapshot order rather than crashing the
+    frame — the CLI validates before calling)."""
     now = snap.get("time_unix") or time.time()
     counters = (snap.get("registry") or {}).get("counters", {})
     jx = snap.get("jax") or {}
@@ -226,8 +290,11 @@ def render_snapshot(snap: Dict[str, Any]) -> str:
     jobs = _dicts(snap.get("jobs"))
     recent = [j for j in _dicts(snap.get("recent_jobs"))
               if j.get("status") != "done" or j.get("chunks_failed")]
-    lines.append(f"JOBS ({len(jobs)} active)")
+    lines.append(f"JOBS ({len(jobs)} active, sort={job_sort})")
     all_jobs = jobs + recent[-4:]
+    key = JOB_SORTS.get(job_sort)
+    if key is not None:
+        all_jobs = sorted(all_jobs, key=key)
     if all_jobs:
         lines += _table(
             ["JOB", "FAMILY", "CHUNKS", "FAIL", "QUAR", "DEG", "JRNL",
@@ -286,6 +353,10 @@ def render_snapshot(snap: Dict[str, Any]) -> str:
         lines.append("  (no live fleet schedulers)")
     lines.append("")
 
+    lines.append("ATTRIBUTION (span self-time)")
+    lines += _attribution_lines(snap.get("attribution"))
+    lines.append("")
+
     incidents = _dicts(snap.get("incidents"))
     dirname = snap.get("incident_dir")
     lines.append(f"INCIDENTS"
@@ -314,12 +385,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="print one frame and exit (scripts/CI)")
     ap.add_argument("--no-clear", action="store_true",
                     help="append frames instead of clearing the screen")
+    ap.add_argument("--sort", default="eta",
+                    help="JOBS panel order: eta (soonest-finishing "
+                         "first; default), hb-age (stalest heartbeat "
+                         "first), or fails (most failed chunks first)")
     args = ap.parse_args(argv)
     if not math.isfinite(args.interval) or args.interval <= 0:
         # a zero/negative/NaN interval would spin the scrape loop flat
         # out against the exporter — reject it up front, named
         ap.error(f"--interval must be a positive number of seconds, "
                  f"got {args.interval!r}")
+    if args.sort not in JOB_SORTS:
+        # same contract as --interval: junk is rejected up front, named,
+        # not discovered as a silently-unsorted frame
+        ap.error(f"--sort must be one of "
+                 f"{', '.join(sorted(JOB_SORTS))}, got {args.sort!r}")
 
     while True:
         try:
@@ -331,7 +411,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 return 1
             time.sleep(args.interval)
             continue
-        frame = render_snapshot(snap)
+        frame = render_snapshot(snap, job_sort=args.sort)
         if args.once:
             sys.stdout.write(frame)
             return 0
