@@ -15,7 +15,8 @@ STS_COMPILE_CACHE ?=
 
 .PHONY: help verify compileall tier1 verify-faults verify-durability \
 	verify-perf verify-serving verify-long verify-telemetry verify-fleet \
-	verify-backtest verify-quality verify-races verify-attribution gate \
+	verify-backtest verify-quality verify-races verify-attribution \
+	verify-runtime gate \
 	bench-diff trace lint lint-baseline contracts verify-static \
 	jax-audit warmup
 
@@ -54,6 +55,10 @@ help:
 	@echo "  verify-quality live forecast-quality suite (anomaly-score oracle, online"
 	@echo "                sMAPE/MASE/coverage, Page-Hinkley drift + drifted-lane heal,"
 	@echo "                stationary zero-false-alarm pin), plain and under STS_FAULT_INJECT=1"
+	@echo "  verify-runtime autonomous fleet-runtime suite (supervised pump restarts,"
+	@echo "                blocking backpressure, auto-checkpoint generations + kill -9"
+	@echo "                mid-checkpoint recovery, self-driving rebalance), plain and"
+	@echo "                under STS_FAULT_INJECT=1 (pump_crash/pump_hang/checkpoint_torn)"
 	@echo "  verify-perf   attribution suite + perf gate: newest BENCH_r*.json vs"
 	@echo "                trailing-median baseline"
 	@echo "  verify-attribution attribution-plane suite (span self-time oracle, stream_fit"
@@ -138,7 +143,7 @@ tier1:
 # modes) runs under the same env, so heal()'s batch refit exercises its
 # forced-retry path too.
 verify-faults: verify-durability verify-telemetry verify-fleet \
-		verify-quality
+		verify-quality verify-runtime
 	STS_FAULT_INJECT=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 		-m 'not slow' --continue-on-collection-errors \
 		-p no:cacheprovider -p no:xdist -p no:randomly
@@ -236,6 +241,22 @@ verify-quality:
 		-p no:xdist -p no:randomly
 	STS_FAULT_INJECT=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 		-m quality --continue-on-collection-errors \
+		-p no:cacheprovider -p no:xdist -p no:randomly
+
+# autonomous fleet-runtime gate (ISSUE 17): the `runtime`-marked subset
+# — supervised-pump supervision (pump_crash restarts counted, ticks
+# delivered exactly once bitwise), blocking backpressure + named
+# timeout, crash-only auto-checkpoint generations (incl. the slow
+# kill -9 mid-checkpoint subprocess pair tier-1 skips), self-driving
+# drain/adopt rebalance, and the race-harness + 0-recompile pins with
+# the runtime armed.  Second pass under STS_FAULT_INJECT=1 forces the
+# pump_crash / pump_hang / checkpoint_torn paths wherever armed.
+verify-runtime:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m runtime \
+		--continue-on-collection-errors -p no:cacheprovider \
+		-p no:xdist -p no:randomly
+	STS_FAULT_INJECT=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
+		-m runtime --continue-on-collection-errors \
 		-p no:cacheprovider -p no:xdist -p no:randomly
 
 # attribution-plane suite (ISSUE 16): span self-time vs a hand-computed

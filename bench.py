@@ -892,12 +892,18 @@ def main():
     # the global serving counters; the block's own shed_lanes field is
     # what tools/bench_gate.py zero-baselines (a bench fleet must not
     # shed under its own nominal load), and fleet_ticks_per_s is gated
-    # higher-is-better once two rounds carry it.
+    # higher-is-better once two rounds carry it.  Since ISSUE 17 the
+    # timed loop runs through FleetRuntime's supervised background pump
+    # (blocking producer-side admission), so fleet_ticks_per_s also
+    # guards the async runtime's overhead and pump_restarts /
+    # checkpoint_failures become zero-baselined supervision gates.
     fleet_demo = None
     if error is None and os.environ.get("BENCH_FLEET", "1") == "1":
         try:
             from spark_timeseries_tpu.statespace import (AdmissionPolicy,
-                                                         FleetScheduler)
+                                                         FleetRuntime,
+                                                         FleetScheduler,
+                                                         RuntimePolicy)
             from spark_timeseries_tpu.statespace import serving as sstate
 
             n_sessions = max(2, int(os.environ.get("BENCH_FLEET_SESSIONS",
@@ -924,13 +930,19 @@ def main():
                     sched.attach(sess)
                 sched.warmup()             # compile outside the timing
                 live = fl_hist[:, 64:64 + rounds]
-                t0 = time.perf_counter()
-                for t in range(rounds):
-                    for i in range(n_sessions):
-                        sched.submit(f"bench-t{i}",
-                                     live[i * per:(i + 1) * per, t])
-                    sched.pump()
-                fleet_s = time.perf_counter() - t0
+                rt = FleetRuntime(sched, registry=fleet_reg,
+                                  label="bench-fleet",
+                                  policy=RuntimePolicy(
+                                      pump_interval_s=0.0005))
+                with rt:
+                    t0 = time.perf_counter()
+                    for t in range(rounds):
+                        for i in range(n_sessions):
+                            rt.submit(f"bench-t{i}",
+                                      live[i * per:(i + 1) * per, t],
+                                      block=True, timeout=60.0)
+                    rt.quiesce(timeout=60.0)
+                    fleet_s = time.perf_counter() - t0
                 pooled = np.concatenate([
                     np.fromiter(sched.session(la)._tick_lat,
                                 dtype=np.float64)
@@ -983,6 +995,12 @@ def main():
                 "shed_lanes": int(fl_counters.get("fleet.shed_lanes", 0)),
                 "slo_burns": int(fl_counters.get("fleet.slo_burns", 0)),
                 "rejected": int(fl_counters.get("fleet.rejected", 0)),
+                "pump_restarts": int(
+                    fl_counters.get("fleet.pump_restarts", 0)),
+                "checkpoint_failures": int(
+                    fl_counters.get("fleet.checkpoint_failures", 0)),
+                "backpressure_waits": int(
+                    fl_counters.get("fleet.backpressure_waits", 0)),
                 "seconds": round(fleet_s, 3),
                 "quality": fl_quality,
             }

@@ -23,13 +23,18 @@ Page-Hinkley drift alarms — fused into the same jitted tick),
 :mod:`serving` (warm sessions, tick ingest, lane healing,
 checkpoint/restore), :mod:`fleet` (the multi-tenant front-end:
 admission control, tick coalescing onto the shared executables,
-SLO-aware shedding, checkpoint-based lane migration).
+SLO-aware shedding, checkpoint-based lane migration), :mod:`runtime`
+(the autonomous layer over the fleet: supervised background pump with
+watchdog restarts, blocking admission backpressure, crash-only
+auto-checkpoint generations, self-driving drain/adopt rebalancing).
 """
 
 from . import (convert, fleet, health, kalman, quality,  # noqa: F401
-               serving, ssm)
+               runtime, serving, ssm)
 from .fleet import (AdmissionPolicy, FleetRestoreMismatch,  # noqa: F401
                     FleetSaturated, FleetScheduler)
+from .runtime import (FleetBackpressureTimeout, FleetRuntime,  # noqa: F401
+                      RuntimePolicy)
 from .convert import Bootstrapped, bootstrap, to_statespace  # noqa: F401
 from .health import (LANE_DIVERGED, LANE_DRIFTED, LANE_OK,  # noqa: F401
                      LANE_SUSPECT, HealthPolicy, LaneHealth,
@@ -48,6 +53,7 @@ from .ssm import (FilterState, SSMeta, StateSpace,  # noqa: F401
 
 __all__ = [
     "ssm", "kalman", "convert", "health", "quality", "serving", "fleet",
+    "runtime",
     "StateSpace", "SSMeta", "FilterState", "initial_state", "state_nbytes",
     "filter_step_panel", "filter_panel", "filter_panel_parallel",
     "filter_forecast_origin", "forecast_mean",
@@ -63,4 +69,5 @@ __all__ = [
     "ServingRestoreMismatch", "shed_priority",
     "FleetScheduler", "AdmissionPolicy", "FleetSaturated",
     "FleetRestoreMismatch",
+    "FleetRuntime", "RuntimePolicy", "FleetBackpressureTimeout",
 ]

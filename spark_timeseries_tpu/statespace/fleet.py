@@ -284,6 +284,11 @@ class FleetScheduler:
         # makes the identity check safe — a healed session swaps in a
         # NEW ssm object, which misses and re-gathers.
         self._gather_cache: Dict[Any, Tuple[list, Any]] = {}
+        # set by statespace.runtime.FleetRuntime when it adopts this
+        # scheduler as a shard: a zero-arg callable returning the pump
+        # supervision summary, folded into telemetry_summary() so the
+        # scrape plane and sts_top see liveness next to the tenants
+        self._runtime_info = None
         _telemetry.register_fleet(self)
         _telemetry.ensure_started_from_env()
         self._reg.inc("fleet.schedulers")
@@ -817,16 +822,12 @@ class FleetScheduler:
 
     # -- migration ----------------------------------------------------------
 
-    def drain(self, label: str, path: str) -> Dict[str, Any]:
-        """Move a tenant out of this scheduler: flush nothing, lose
-        nothing — the bundle carries the session's full
-        ``checkpoint_blob`` PLUS every still-queued/buffered tick, and
-        lands via the atomic pytree writer, so a ``kill -9`` one
-        instruction after :meth:`drain` returns leaves a bundle another
-        process adopts bitwise.  The tenant is detached on success.
-        The ``drop_tenant_process`` fault SIGKILLs right after the
-        commit (forensics bundle first), pinning exactly that."""
-        t = self._require(label)
+    def _pack_bundle(self, t: _Tenant) -> Dict[str, Any]:
+        """The migration/checkpoint bundle for one tenant: the session's
+        full ``checkpoint_blob`` PLUS every still-queued/buffered tick
+        with its exogenous offsets.  :meth:`drain` and
+        :meth:`checkpoint_tenant` write the SAME format — one adopt path
+        restores both."""
 
         def pack(ticks, offsets):
             """(k, n_series) tick rows + offset rows (or None when no
@@ -847,7 +848,7 @@ class FleetScheduler:
                                      [q[1] for q in t.queue])
         catchup, catchup_offs = pack([c[0] for c in t.catchup],
                                      [c[1] for c in t.catchup])
-        bundle = {
+        return {
             "format": _BUNDLE_FORMAT,
             "label": t.label,
             "mode": t.mode,
@@ -858,6 +859,35 @@ class FleetScheduler:
             "catchup_offsets": catchup_offs,
             "session": t.session.checkpoint_blob(),
         }
+
+    def checkpoint_tenant(self, label: str, path: str) -> Dict[str, Any]:
+        """Crash-only snapshot of one tenant: the exact :meth:`drain`
+        bundle (session blob + undispatched ticks), written via the
+        atomic pytree writer — but the tenant stays attached and keeps
+        serving.  ``adopt()`` of the bundle in a fresh process lands the
+        tenant bitwise where it was at the snapshot; everything admitted
+        after the snapshot is the caller's (auto-checkpointer's) loss
+        window to bound."""
+        t = self._require(label)
+        bundle = self._pack_bundle(t)
+        _checkpoint.save_pytree_atomic(path, bundle)
+        self._reg.inc("fleet.tenant_checkpoints")
+        return {"tenant": label, "path": path,
+                "pending": int(bundle["pending"].shape[0]),
+                "catchup": int(bundle["catchup"].shape[0])}
+
+    def drain(self, label: str, path: str) -> Dict[str, Any]:
+        """Move a tenant out of this scheduler: flush nothing, lose
+        nothing — the bundle carries the session's full
+        ``checkpoint_blob`` PLUS every still-queued/buffered tick, and
+        lands via the atomic pytree writer, so a ``kill -9`` one
+        instruction after :meth:`drain` returns leaves a bundle another
+        process adopts bitwise.  The tenant is detached on success.
+        The ``drop_tenant_process`` fault SIGKILLs right after the
+        commit (forensics bundle first), pinning exactly that."""
+        t = self._require(label)
+        bundle = self._pack_bundle(t)
+        pending, catchup = bundle["pending"], bundle["catchup"]
         _checkpoint.save_pytree_atomic(path, bundle)
         self._reg.inc("fleet.drained")
         _metrics.trace_instant(
@@ -987,6 +1017,7 @@ class FleetScheduler:
             "tenants": len(self._tenants),
             "groups": len(self._groups),
             "queued": qd,
+            "queue_depth": self.policy.queue_depth,
             "shed_tenants": len(self._shed_order),
             "slo_ms": self._slo_ms,
             "slo_burns": self._slo_burns,
@@ -997,8 +1028,16 @@ class FleetScheduler:
     def telemetry_summary(self) -> Dict[str, Any]:
         """Scrape-ready fleet panel for ``/snapshot.json``
         (``utils.telemetry.fleet_summaries``): the aggregate plus one
-        row per tenant."""
-        return {**self.stats(),
-                "tenant_rows": [t.summary() for t in
-                                sorted(self._tenants.values(),
-                                       key=lambda t: t.label)]}
+        row per tenant, plus — when a :class:`~.runtime.FleetRuntime`
+        supervises this scheduler — its pump liveness block."""
+        out = {**self.stats(),
+               "tenant_rows": [t.summary() for t in
+                               sorted(self._tenants.values(),
+                                      key=lambda t: t.label)]}
+        info = self._runtime_info
+        if info is not None:
+            try:
+                out["pump"] = info()
+            except Exception as e:  # noqa: BLE001 — scrape isolation
+                out["pump"] = {"error": f"{type(e).__name__}: {e}"}
+        return out

@@ -79,6 +79,7 @@ KNOWN_LOCKS: Tuple[Tuple[str, str], ...] = (
     ("spark_timeseries_tpu.utils.telemetry", "_jobs_lock"),
     ("spark_timeseries_tpu.utils.telemetry", "_sessions_lock"),
     ("spark_timeseries_tpu.utils.telemetry", "_fleets_lock"),
+    ("spark_timeseries_tpu.utils.telemetry", "_runtimes_lock"),
     ("spark_timeseries_tpu.utils.telemetry", "_server_lock"),
     ("spark_timeseries_tpu.utils.metrics", "_install_lock"),
     ("spark_timeseries_tpu.native", "_lock"),

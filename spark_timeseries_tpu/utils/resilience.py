@@ -61,7 +61,8 @@ __all__ = [
     "STATUS_ABANDONED", "STATUS_NAMES",
     "classify_series", "unfittable_mask",
     "FitOutcome", "RetryPolicy", "retry_kwargs", "StageResult",
-    "FaultSpec", "InjectedOOM", "fault_injection", "fault_spec",
+    "FaultSpec", "InjectedOOM", "InjectedPumpCrash",
+    "fault_injection", "fault_spec",
     "chunk_fault", "serving_fault", "fleet_fault", "fault_scope_token",
     "forced_optimizer_failures", "corrupt_values", "resilient_fit",
 ]
@@ -297,6 +298,24 @@ class FaultSpec(NamedTuple):
       ``drain()`` bundle commits (forensics bundle written first, like
       ``kill_after_chunk``) — the killed-mid-migration scenario whose
       bundle another process must ``adopt()`` bitwise.
+
+    Fleet-runtime modes (consumed host-side by
+    ``statespace.runtime.FleetRuntime``'s supervised pump loop via
+    :func:`fleet_fault`; never traced):
+
+    - ``"pump_crash"``: every ``n_attempts``-th pump sweep dies with
+      :class:`InjectedPumpCrash` before dispatching — the crashed pump
+      thread the watchdog must restart (with backoff) without losing a
+      single admitted tick;
+    - ``"pump_hang"``: one pump sweep per fault scope sleeps ``hang_s``
+      seconds *outside* the runtime lock — the wedged-pump scenario the
+      heartbeat watchdog must detect (``/healthz`` goes stale) and
+      recover from by abandoning the hung thread;
+    - ``"checkpoint_torn"``: an auto-checkpoint generation is SIGKILLed
+      after ``n_attempts`` tenant bundles have landed but before the
+      generation manifest commits (forensics bundle first) — the torn
+      checkpoint whose recovery must fall back to the previous
+      committed generation.
     """
     mode: str
     n_attempts: int = 1
@@ -312,12 +331,20 @@ class InjectedOOM(RuntimeError):
     XLA OOM would."""
 
 
+class InjectedPumpCrash(RuntimeError):
+    """Synthetic pump-thread death raised by the ``pump_crash`` fault
+    mode at the top of a ``FleetRuntime`` pump sweep — before any
+    dispatch, so the admitted queues stay transactionally intact and the
+    supervisor's restart must deliver every tick exactly once."""
+
+
 _VALID_MODES = ("force_nonconverge", "corrupt_nan", "corrupt_inf",
                 "hang_chunk", "oom_chunk", "kill_after_chunk",
                 "corrupt_journal",
                 "tick_corrupt_nan", "tick_corrupt_inf", "state_poison",
                 "tenant_flood", "coalesce_straggler",
-                "drop_tenant_process")
+                "drop_tenant_process",
+                "pump_crash", "pump_hang", "checkpoint_torn")
 _CHUNK_MODES = _VALID_MODES[3:7]
 _SERVING_MODES = _VALID_MODES[7:10]
 _FLEET_MODES = _VALID_MODES[10:]
@@ -373,8 +400,11 @@ def fleet_fault(mode: str) -> Optional[FaultSpec]:
     """The active fault spec when it is a fleet-tier fault of the given
     ``mode``, else None.  Read host-side by
     ``statespace.fleet.FleetScheduler`` at submit / coalesced-dispatch /
-    drain time — these modes amplify ingress, withhold straggler ticks,
-    or kill the process; none of them ever enters traced code."""
+    drain time, and by ``statespace.runtime.FleetRuntime`` at pump-sweep
+    / auto-checkpoint time — these modes amplify ingress, withhold
+    straggler ticks, crash or wedge the pump, tear a checkpoint
+    generation, or kill the process; none of them ever enters traced
+    code."""
     if mode not in _FLEET_MODES:
         raise ValueError(
             f"unknown fleet fault mode {mode!r}; expected one of "

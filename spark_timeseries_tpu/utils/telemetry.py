@@ -76,7 +76,8 @@ __all__ = [
     "new_job_id", "register_job", "finish_job", "active_jobs",
     "recent_jobs", "register_session", "live_sessions",
     "session_summaries", "register_fleet", "live_fleets",
-    "fleet_summaries",
+    "fleet_summaries", "register_fleet_runtime", "live_fleet_runtimes",
+    "fleet_runtime_rows",
     "snapshot_doc", "healthz_doc", "json_safe", "env_positive",
     "DEFAULT_STALE_FACTOR", "DEFAULT_EXPECTED_CHUNK_S", "RECENT_JOBS_KEPT",
 ]
@@ -401,6 +402,13 @@ def session_summaries() -> List[Dict[str, Any]]:
 _fleets_lock = threading.Lock()
 _fleets: "weakref.WeakSet" = weakref.WeakSet()
 
+# live FleetRuntimes (statespace.runtime): the /healthz route consults
+# their pump heartbeats — a stale pump answers 503 so an external
+# supervisor can restart the process.  Same weak-reference + lock
+# discipline as the fleets above.
+_runtimes_lock = threading.Lock()
+_runtimes: "weakref.WeakSet" = weakref.WeakSet()
+
 
 def register_fleet(fleet: Any) -> None:
     with _fleets_lock:
@@ -410,6 +418,28 @@ def register_fleet(fleet: Any) -> None:
 def live_fleets() -> List[Any]:
     with _fleets_lock:
         return list(_fleets)
+
+
+def register_fleet_runtime(runtime: Any) -> None:
+    with _runtimes_lock:
+        _runtimes.add(runtime)
+
+
+def live_fleet_runtimes() -> List[Any]:
+    with _runtimes_lock:
+        return list(_runtimes)
+
+
+def fleet_runtime_rows() -> List[Dict[str, Any]]:
+    """One ``pump_health()`` row per live runtime for ``/healthz`` —
+    scrape isolation as everywhere else."""
+    out = []
+    for rt in live_fleet_runtimes():
+        try:
+            out.append(json_safe(rt.pump_health()))
+        except Exception as e:  # noqa: BLE001 — scrape isolation
+            out.append({"error": f"{type(e).__name__}: {e}"})
+    return out
 
 
 def fleet_summaries() -> List[Dict[str, Any]]:
@@ -483,8 +513,11 @@ def snapshot_doc(registry: Optional[Any] = None) -> Dict[str, Any]:
 def healthz_doc(registry: Optional[Any] = None) -> Dict[str, Any]:
     """The ``/healthz`` payload.  ``status`` is ``"ok"`` unless any
     active job's heartbeat is stale (older than the staleness threshold
-    — see :meth:`JobProgress.stale_after_s`), in which case it is
-    ``"stale"`` and the HTTP route answers 503."""
+    — see :meth:`JobProgress.stale_after_s`) or any fleet runtime's
+    pump heartbeat is stale (same ``STS_TELEMETRY_STALE_FACTOR``
+    contract; see ``FleetRuntime.stale_after_s``), in which case it is
+    ``"stale"`` and the HTTP route answers 503 — the signal an external
+    supervisor restarts the process on."""
     jobs = []
     any_stale = False
     for p in active_jobs():
@@ -497,6 +530,9 @@ def healthz_doc(registry: Optional[Any] = None) -> Dict[str, Any]:
             "stale_after_s": round(p.stale_after_s(), 3),
             "stale": stale,
         })
+    pumps = fleet_runtime_rows()
+    for row in pumps:
+        any_stale = any_stale or bool(row.get("stale"))
     return {
         "status": "stale" if any_stale else "ok",
         "pid": os.getpid(),
@@ -504,7 +540,9 @@ def healthz_doc(registry: Optional[Any] = None) -> Dict[str, Any]:
         "uptime_s": round(time.time() - _started_unix, 3),
         "n_active_jobs": len(jobs),
         "n_serving_sessions": len(live_sessions()),
+        "n_fleet_pumps": len(pumps),
         "jobs": jobs,
+        "fleet_pumps": pumps,
     }
 
 

@@ -1,0 +1,870 @@
+"""Autonomous fleet runtime (ISSUE 17).
+
+The acceptance scenarios live here:
+
+- the supervised background pump delivers every admitted tick exactly
+  once, **bitwise** what per-session updates produce — including across
+  injected pump crashes (``pump_crash`` → watchdog restart, counted in
+  ``fleet.pump_restarts``, flight-recorder bundle per death);
+- a wedged pump (``pump_hang``) flips ``/healthz`` to stale under the
+  jobs' ``STS_TELEMETRY_STALE_FACTOR`` contract, the watchdog abandons
+  and respawns it, and the endpoint flips back;
+- blocking admission backpressure parks the producer instead of raising
+  ``FleetSaturated`` and raises the named ``FleetBackpressureTimeout``
+  past its deadline;
+- auto-checkpointing commits per-tenant drain bundles as atomic
+  *generations* (fsync'd ``MANIFEST.json`` is the commit point): a
+  ``kill -9`` mid-pass (``checkpoint_torn``, subprocess pair) leaves the
+  torn generation invisible and ``restore_latest()`` resumes bitwise
+  from the previous committed one;
+- the self-driving rebalancer consolidates fragmented coalescing groups
+  across shards through the drain/adopt path with zero tick loss;
+- the PR-13 race harness drives pump vs submit vs checkpoint vs scrape
+  with an acyclic acquisition-order graph, and the warmed tick path
+  stays at **zero** recompiles with runtime + quality + telemetry armed.
+
+Fast in-process scenarios run in tier-1; the subprocess pair and the
+jax-heavy race run are ``slow`` and run via ``make verify-runtime``
+(the ``runtime`` marker), which ``verify-faults`` also drives under
+``STS_FAULT_INJECT=1``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_timeseries_tpu import statespace as ss
+from spark_timeseries_tpu.models import arima
+from spark_timeseries_tpu.statespace.fleet import (
+    AdmissionPolicy, FleetSaturated, FleetScheduler)
+from spark_timeseries_tpu.statespace.runtime import (
+    _GEN_PREFIX, _MANIFEST, FleetBackpressureTimeout, FleetRuntime,
+    RuntimePolicy)
+from spark_timeseries_tpu.utils import metrics, resilience, telemetry
+
+pytestmark = pytest.mark.runtime
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+S, N_HIST = 4, 120       # the shared test_fleet geometry -> one shared
+#                          fit executable and serving bucket module-wide
+
+
+def _ar2_panel(n_series, n, seed=0):
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=(n_series, n + 16))
+    y = np.zeros((n_series, n + 16))
+    for t in range(2, n + 16):
+        y[:, t] = 0.3 + 0.5 * y[:, t - 1] - 0.2 * y[:, t - 2] + e[:, t]
+    return y[:, 16:]
+
+
+def _tenant_fixtures(n_tenants, seed0=1):
+    hists = [_ar2_panel(S, N_HIST, seed=seed0 + i)
+             for i in range(n_tenants)]
+    models = [arima.fit(2, 0, 0, jnp.asarray(h), warn=False)
+              for h in hists]
+    return models, hists
+
+
+def _build_runtime(n_tenants, *, policy=None, admission=None, seed0=1,
+                   n_shards=1, warm=True):
+    """(runtime, models, hists, registry) — n same-geometry tenants
+    spread round-robin over n_shards schedulers under one runtime."""
+    reg = metrics.MetricsRegistry()
+    models, hists = _tenant_fixtures(n_tenants, seed0=seed0)
+    shards = [FleetScheduler(admission, registry=reg, auto_pump=False)
+              for _ in range(n_shards)]
+    for i, (m, h) in enumerate(zip(models, hists)):
+        sess = ss.ServingSession.start(m, h, label=f"t{i}", registry=reg)
+        shards[i % n_shards].attach(sess)
+    rt = FleetRuntime(shards if n_shards > 1 else shards[0],
+                      policy=policy, registry=reg)
+    if warm:
+        rt.warmup()
+    return rt, models, hists, reg
+
+
+def _mirrors(models, hists):
+    return [ss.ServingSession.start(m, h,
+                                    registry=metrics.MetricsRegistry())
+            for m, h in zip(models, hists)]
+
+
+def _assert_bitwise(rt, mirrors):
+    for i, mirror in enumerate(mirrors):
+        sh, t = rt._find(f"t{i}")
+        sess = t.session
+        assert sess.ticks_seen == mirror.ticks_seen
+        np.testing.assert_array_equal(np.asarray(sess._state.a),
+                                      np.asarray(mirror._state.a))
+        np.testing.assert_array_equal(np.asarray(sess._state.P),
+                                      np.asarray(mirror._state.P))
+        np.testing.assert_array_equal(sess.loglik, mirror.loglik)
+
+
+# ---------------------------------------------------------------------------
+# policy + plumbing
+# ---------------------------------------------------------------------------
+
+def test_runtime_policy_validation_rejects_nonsense():
+    with pytest.raises(ValueError, match="pump_interval_s"):
+        RuntimePolicy(pump_interval_s=0).validate()
+    with pytest.raises(ValueError, match="stall_after_s"):
+        RuntimePolicy(stall_after_s=-1.0).validate()
+    with pytest.raises(ValueError, match="keep_generations"):
+        RuntimePolicy(keep_generations=0).validate()
+    with pytest.raises(ValueError, match="rebalance_imbalance"):
+        RuntimePolicy(rebalance_imbalance=0.5).validate()
+    with pytest.raises(ValueError, match="max_moves_per_cycle"):
+        RuntimePolicy(max_moves_per_cycle=0).validate()
+    # auto-checkpoint triggers without a directory are a config error,
+    # not a silent no-op
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        RuntimePolicy(checkpoint_interval_s=1.0).validate()
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        RuntimePolicy(checkpoint_dirty_ticks=8).validate()
+    assert RuntimePolicy().validate() == RuntimePolicy()
+
+
+def test_runtime_fault_modes_are_registered():
+    for mode in ("pump_crash", "pump_hang", "checkpoint_torn"):
+        assert mode in resilience._VALID_MODES
+        assert resilience.fleet_fault(mode) is None      # no scope armed
+        with resilience.fault_injection(mode, n_attempts=2):
+            spec = resilience.fleet_fault(mode)
+            assert spec is not None and spec.n_attempts == 2
+    assert issubclass(resilience.InjectedPumpCrash, RuntimeError)
+
+
+def test_runtime_rejects_duplicate_labels_across_shards():
+    reg = metrics.MetricsRegistry()
+    models, hists = _tenant_fixtures(1, seed0=61)
+    shards = [FleetScheduler(registry=reg, auto_pump=False)
+              for _ in range(2)]
+    for sh in shards:
+        sh.attach(ss.ServingSession.start(models[0], hists[0],
+                                          label="dup", registry=reg))
+    with pytest.raises(ValueError, match="dup"):
+        FleetRuntime(shards, registry=reg)
+    with pytest.raises(ValueError, match="at least one"):
+        FleetRuntime([], registry=reg)
+
+
+def test_attach_routes_least_loaded_and_validates():
+    rt, models, hists, reg = _build_runtime(2, n_shards=2, seed0=63,
+                                            warm=False)
+    m, h = _tenant_fixtures(1, seed0=66)
+    extra = ss.ServingSession.start(m[0], h[0], label="extra",
+                                    registry=reg)
+    # both shards hold 1 tenant; least-loaded picks the first min
+    assert rt.attach(extra) == "extra"
+    with pytest.raises(ValueError, match="already"):
+        rt.attach(extra)
+    m2, h2 = _tenant_fixtures(1, seed0=67)
+    other = ss.ServingSession.start(m2[0], h2[0], label="other",
+                                    registry=reg)
+    with pytest.raises(KeyError, match="no shard"):
+        rt.attach(other, shard="nope")
+    with pytest.raises(KeyError, match="no tenant"):
+        rt.forecast("missing", 3)
+    assert reg.snapshot()["counters"]["fleet.runtimes"] == 1
+
+
+# ---------------------------------------------------------------------------
+# async dispatch: bitwise, exactly-once
+# ---------------------------------------------------------------------------
+
+def test_async_runtime_delivers_ticks_bitwise():
+    rt, models, hists, reg = _build_runtime(3, seed0=11)
+    mirrors = _mirrors(models, hists)
+    rng = np.random.default_rng(3)
+    ticks = rng.normal(size=(3, S, 10))
+    with rt:
+        for t in range(10):
+            for i in range(3):
+                rt.submit(f"t{i}", ticks[i, :, t], block=True,
+                          timeout=30.0)
+        assert rt.quiesce(timeout=30.0)
+        for i in range(3):
+            for t in range(10):
+                mirrors[i].update(ticks[i, :, t])
+        _assert_bitwise(rt, mirrors)
+        # forecasts ride the same locked passthrough, bitwise
+        np.testing.assert_array_equal(rt.forecast("t0", 5),
+                                      mirrors[0].forecast(5))
+    assert not rt.running
+    assert rt.pump_summary()["restarts"] == 0
+    counters = reg.snapshot()["counters"]
+    assert counters.get("fleet.pump_restarts", 0) == 0
+
+
+def test_stopped_runtime_cannot_restart_and_stop_is_idempotent():
+    rt, models, hists, _ = _build_runtime(1, seed0=21, warm=False)
+    with rt:
+        assert rt.running
+        with pytest.raises(RuntimeError, match="already"):
+            rt.start()
+    rt.stop()                                # second stop: no-op
+    with pytest.raises(RuntimeError, match="stopped"):
+        rt.start()
+
+
+# ---------------------------------------------------------------------------
+# backpressure
+# ---------------------------------------------------------------------------
+
+def test_unstarted_runtime_degrades_to_nonblocking_admission():
+    rt, models, hists, _ = _build_runtime(
+        1, admission=AdmissionPolicy(queue_depth=2), seed0=31,
+        warm=False)
+    tick = np.zeros(S)
+    rt.submit("t0", tick)                    # queue 1/2
+    rt.submit("t0", tick)                    # queue 2/2
+    # blocking would never end without a pump; the call degrades to the
+    # raw admission behavior instead of deadlocking the producer
+    with pytest.raises(FleetSaturated):
+        rt.submit("t0", tick, block=True)
+    # manual sweeps drain: one coalesced dispatch per sweep per group
+    assert rt.pump_once() == 1
+    assert rt.pump_once() == 1
+    assert rt.pump_once() == 0
+
+
+def test_backpressure_blocks_waits_and_times_out():
+    rt, models, hists, reg = _build_runtime(
+        1, admission=AdmissionPolicy(queue_depth=2), seed0=33,
+        policy=RuntimePolicy(pump_interval_s=0.005, stall_after_s=30.0))
+    mirror = _mirrors(models, hists)[0]
+    rng = np.random.default_rng(7)
+    ticks = rng.normal(size=(S, 8))
+    with resilience.fault_injection("pump_hang", hang_s=2.0):
+        with rt:
+            # the first sweep sleeps 2 s OUTSIDE the lock: submits
+            # proceed, nothing drains
+            rt.submit("t0", ticks[:, 0], block=False)
+            rt.submit("t0", ticks[:, 1], block=False)
+            t0 = time.monotonic()
+            with pytest.raises(FleetBackpressureTimeout, match="t0"):
+                rt.submit("t0", ticks[:, 2], block=True, timeout=0.4)
+            assert time.monotonic() - t0 >= 0.4
+            # no deadline: the producer parks until the pump drains
+            for t in range(2, 8):
+                rt.submit("t0", ticks[:, t], block=True, timeout=30.0)
+            assert rt.quiesce(timeout=30.0)
+            for t in range(8):
+                mirror.update(ticks[:, t])
+            _assert_bitwise(rt, [mirror])
+    counters = reg.snapshot()["counters"]
+    assert counters["fleet.backpressure_timeouts"] == 1
+    assert counters["fleet.backpressure_waits"] >= 1
+    assert counters.get("fleet.rejected", 0) == 0, \
+        "a blocking producer saw the saturation path"
+
+
+# ---------------------------------------------------------------------------
+# pump supervision: crash + hang
+# ---------------------------------------------------------------------------
+
+def test_pump_crash_supervision_restarts_and_stays_bitwise(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("STS_INCIDENT_DIR", str(tmp_path / "incidents"))
+    rt, models, hists, reg = _build_runtime(
+        3, seed0=41,
+        policy=RuntimePolicy(pump_interval_s=0.002,
+                             watchdog_interval_s=0.01))
+    mirrors = _mirrors(models, hists)
+    rng = np.random.default_rng(9)
+    ticks = rng.normal(size=(3, S, 10))
+    with resilience.fault_injection("pump_crash", n_attempts=3):
+        with rt:
+            for t in range(10):
+                for i in range(3):
+                    rt.submit(f"t{i}", ticks[i, :, t], block=True,
+                              timeout=60.0)
+            assert rt.quiesce(timeout=60.0)
+            summary = rt.pump_summary()
+    assert summary["restarts"] >= 1, summary
+    counters = reg.snapshot()["counters"]
+    assert counters["fleet.pump_restarts"] == summary["restarts"]
+    assert counters["fleet.pump_deaths"] >= 1
+    # every admitted tick was dispatched exactly once across the crashes
+    for i in range(3):
+        for t in range(10):
+            mirrors[i].update(ticks[i, :, t])
+    _assert_bitwise(rt, mirrors)
+    # each death left a flight-recorder bundle
+    inc_dir = str(tmp_path / "incidents")
+    names = os.listdir(inc_dir) if os.path.isdir(inc_dir) else []
+    assert any("fleet_pump_death" in n for n in names), names
+
+
+def test_pump_hang_flips_healthz_and_watchdog_recovers(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("STS_INCIDENT_DIR", str(tmp_path / "incidents"))
+    monkeypatch.setenv("STS_TELEMETRY_STALE_FACTOR", "0.25")
+    rt, models, hists, reg = _build_runtime(
+        1, seed0=43,
+        policy=RuntimePolicy(pump_interval_s=0.005,
+                             watchdog_interval_s=0.05,
+                             stall_after_s=0.8))
+    assert rt.stale_after_s() == pytest.approx(0.25)  # 0.25 * max(.005,1)
+
+    def _my_row(doc):
+        return [r for r in doc["fleet_pumps"]
+                if r.get("runtime") == rt.label]
+
+    with resilience.fault_injection("pump_hang", hang_s=1.5):
+        with rt:
+            # the hung pump's heartbeat ages past the scrape-plane
+            # threshold (0.25 s) well before the watchdog's 0.8 s
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                doc = telemetry.healthz_doc()
+                rows = _my_row(doc)
+                if rows and rows[0]["stale"]:
+                    break
+                time.sleep(0.01)
+            else:
+                pytest.fail("healthz never went stale during the hang")
+            assert doc["status"] == "stale"
+            # watchdog: declare wedged, record the stall, respawn
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if rt.pump_summary()["restarts"] >= 1:
+                    break
+                time.sleep(0.01)
+            else:
+                pytest.fail("watchdog never restarted the hung pump")
+            # the replacement pump heartbeats -> healthz flips back
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                doc = telemetry.healthz_doc()
+                rows = _my_row(doc)
+                if rows and not rows[0]["stale"] \
+                        and doc["status"] == "ok":
+                    break
+                time.sleep(0.01)
+            else:
+                pytest.fail("healthz never recovered after the restart")
+            # and the recovered runtime still serves
+            rng = np.random.default_rng(13)
+            ticks = rng.normal(size=(S, 4))
+            for t in range(4):
+                rt.submit("t0", ticks[:, t], block=True, timeout=30.0)
+            assert rt.quiesce(timeout=30.0)
+            sh, ten = rt._find("t0")
+            assert ten.session.ticks_seen == N_HIST + 4
+    assert reg.snapshot()["counters"]["fleet.pump_restarts"] >= 1
+    inc_dir = str(tmp_path / "incidents")
+    names = os.listdir(inc_dir) if os.path.isdir(inc_dir) else []
+    assert any("fleet_pump_stall" in n for n in names), names
+
+
+# ---------------------------------------------------------------------------
+# auto-checkpoint generations
+# ---------------------------------------------------------------------------
+
+def test_auto_checkpoint_commits_generations_and_prunes(tmp_path):
+    ck = str(tmp_path / "ck")
+    rt, models, hists, reg = _build_runtime(
+        2, seed0=51,
+        policy=RuntimePolicy(checkpoint_dir=ck, checkpoint_dirty_ticks=4,
+                             keep_generations=2))
+    rng = np.random.default_rng(15)
+    for gen in range(3):                     # 3 dirty-tick triggers
+        for k in range(2):
+            for i in range(2):
+                rt.submit(f"t{i}", rng.normal(size=S))
+        rt.pump_once()                       # 4 dirty -> commit
+    assert reg.snapshot()["counters"]["fleet.checkpoints"] == 3
+    committed = FleetRuntime._scan_generations(ck)
+    assert [g for g, _ in committed] == [2, 3]   # pruned to keep=2
+    found = FleetRuntime.latest_generation(ck)
+    assert found is not None
+    gen, gdir, manifest = found
+    assert gen == 3 and manifest["format"] == 1
+    rows = {r["tenant"]: r for r in manifest["tenants"]}
+    assert set(rows) == {"t0", "t1"}
+    assert all(os.path.exists(os.path.join(gdir, la) + ".npz")
+               for la in rows)
+    assert rt.pump_summary()["checkpoint_generation"] == 3
+
+
+def test_restore_latest_replays_pending_bitwise(tmp_path):
+    ck = str(tmp_path / "ck")
+    rt, models, hists, reg = _build_runtime(
+        2, seed0=53, policy=RuntimePolicy(checkpoint_dir=ck))
+    mirrors = _mirrors(models, hists)
+    rng = np.random.default_rng(17)
+    ticks = rng.normal(size=(2, S, 12))
+    for t in range(6):
+        for i in range(2):
+            rt.submit(f"t{i}", ticks[i, :, t])
+        rt.pump_once()
+    for i in range(2):                       # two pending ticks ride
+        rt.submit(f"t{i}", ticks[i, :, 6])   # the bundles
+        rt.submit(f"t{i}", ticks[i, :, 7])
+    rep = rt.checkpoint()
+    assert rep == {"generation": 1,
+                   "dir": os.path.join(ck, f"{_GEN_PREFIX}00000001"),
+                   "tenants": 2}
+    # a fresh runtime (empty shards) adopts + replays the generation
+    reg2 = metrics.MetricsRegistry()
+    rt2 = FleetRuntime(FleetScheduler(registry=reg2, auto_pump=False),
+                       policy=RuntimePolicy(checkpoint_dir=ck),
+                       registry=reg2)
+    assert sorted(rt2.restore_latest()) == ["t0", "t1"]
+    for i in range(2):
+        for t in range(8):
+            mirrors[i].update(ticks[i, :, t])
+    _assert_bitwise(rt2, mirrors)
+    # and keeps serving bitwise
+    for t in range(8, 12):
+        for i in range(2):
+            rt2.submit(f"t{i}", ticks[i, :, t])
+            mirrors[i].update(ticks[i, :, t])
+        rt2.pump_once()
+    _assert_bitwise(rt2, mirrors)
+    np.testing.assert_array_equal(rt2.forecast("t1", 4),
+                                  mirrors[1].forecast(4))
+    assert reg2.snapshot()["counters"]["fleet.restored_tenants"] == 2
+
+
+def test_torn_generation_is_invisible_and_never_reused(tmp_path):
+    ck = str(tmp_path / "ck")
+    rt, models, hists, _ = _build_runtime(
+        1, seed0=55, policy=RuntimePolicy(checkpoint_dir=ck))
+    rt.submit("t0", np.zeros(S))
+    rt.pump_once()
+    assert rt.checkpoint()["generation"] == 1
+    # fabricate torn debris: bundles landed, manifest never did
+    torn = os.path.join(ck, f"{_GEN_PREFIX}00000002")
+    os.makedirs(torn)
+    with open(os.path.join(torn, "t0.npz"), "wb") as f:
+        f.write(b"half a bundle")
+    found = FleetRuntime.latest_generation(ck)
+    assert found is not None and found[0] == 1
+    assert FleetRuntime._scan_generations(ck, committed_only=False)[-1][0] \
+        == 2
+    # a new incarnation numbers PAST the debris — gen 2 is never reused
+    reg2 = metrics.MetricsRegistry()
+    sched2 = FleetScheduler(registry=reg2, auto_pump=False)
+    rt2 = FleetRuntime(sched2, policy=RuntimePolicy(checkpoint_dir=ck),
+                       registry=reg2)
+    assert rt2.restore_latest() == ["t0"]
+    assert rt2.checkpoint()["generation"] == 3
+
+
+def test_checkpoint_requires_dir_and_failures_never_commit(tmp_path):
+    rt, models, hists, _ = _build_runtime(1, seed0=57, warm=False)
+    with pytest.raises(RuntimeError, match="checkpoint_dir"):
+        rt.checkpoint()
+    with pytest.raises(RuntimeError, match="checkpoint_dir"):
+        rt.restore_latest()
+    # a generation dir that cannot be created: the pass fails, counts,
+    # and commits nothing (crash-only — the pump would survive it)
+    ck = str(tmp_path / "ck")
+    reg2 = metrics.MetricsRegistry()
+    models2, hists2 = _tenant_fixtures(1, seed0=58)
+    sched2 = FleetScheduler(registry=reg2, auto_pump=False)
+    sched2.attach(ss.ServingSession.start(models2[0], hists2[0],
+                                          label="t0", registry=reg2))
+    rt2 = FleetRuntime(sched2, registry=reg2,
+                       policy=RuntimePolicy(checkpoint_dir=ck))
+    # a regular file squats on the next generation's directory path
+    with open(os.path.join(ck, f"{_GEN_PREFIX}00000001"), "w") as f:
+        f.write("file in the way")
+    assert rt2.checkpoint() is None
+    assert reg2.snapshot()["counters"]["fleet.checkpoint_failures"] == 1
+    assert rt2.pump_summary()["checkpoint_failures"] == 1
+    assert FleetRuntime.latest_generation(ck) is None
+
+
+def test_stop_takes_a_final_checkpoint(tmp_path):
+    ck = str(tmp_path / "ck")
+    rt, models, hists, reg = _build_runtime(
+        1, seed0=59,
+        policy=RuntimePolicy(checkpoint_dir=ck,
+                             checkpoint_dirty_ticks=10_000))
+    with rt:
+        rt.submit("t0", np.zeros(S), block=True, timeout=30.0)
+        assert rt.quiesce(timeout=30.0)
+    found = FleetRuntime.latest_generation(ck)
+    assert found is not None
+    assert found[2]["tenants"][0]["tenant"] == "t0"
+
+
+_TORN_CHILD = """
+import os
+import numpy as np
+import jax.numpy as jnp
+from spark_timeseries_tpu import statespace as ss
+from spark_timeseries_tpu.models import arima
+from spark_timeseries_tpu.utils import metrics, resilience
+
+def panel(n_series, n, seed):
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=(n_series, n + 16))
+    y = np.zeros((n_series, n + 16))
+    for t in range(2, n + 16):
+        y[:, t] = 0.3 + 0.5*y[:, t-1] - 0.2*y[:, t-2] + e[:, t]
+    return y[:, 16:]
+
+S = 4
+reg = metrics.MetricsRegistry()
+sched = ss.FleetScheduler(registry=reg, auto_pump=False)
+for i in range(2):
+    hist = panel(S, 120, 71 + i)
+    model = arima.fit(2, 0, 0, jnp.asarray(hist), warn=False)
+    sched.attach(ss.ServingSession.start(model, hist, label=f"t{i}",
+                                         registry=reg))
+rt = ss.FleetRuntime(
+    sched, registry=reg,
+    policy=ss.RuntimePolicy(checkpoint_dir=os.environ["STS_TEST_CKPT"]))
+live = [panel(S, 40, 81 + i) for i in range(2)]
+for t in range(8):
+    for i in range(2):
+        rt.submit(f"t{i}", live[i][:, t])
+    rt.pump_once()
+for i in range(2):
+    rt.submit(f"t{i}", live[i][:, 8])      # one pending tick per tenant
+rep = rt.checkpoint()                      # generation 1 commits
+assert rep is not None and rep["generation"] == 1, rep
+rt.pump_once()                             # dispatch tick 8
+for i in range(2):
+    rt.submit(f"t{i}", live[i][:, 9])
+with resilience.fault_injection("checkpoint_torn", n_attempts=1):
+    rt.checkpoint()                        # t0 bundle lands, then kill -9
+print("UNREACHABLE: checkpoint survived checkpoint_torn", flush=True)
+raise SystemExit(3)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(900)
+def test_kill9_mid_auto_checkpoint_restores_previous_generation(tmp_path):
+    """The crash-only acceptance pin: a process SIGKILLed between a
+    generation's bundles and its manifest leaves the torn generation
+    invisible — a fresh process resumes from the previous *committed*
+    generation, replays its buffered ticks, and every subsequent tick
+    and forecast is bitwise an uninterrupted fleet's."""
+    ck = str(tmp_path / "ck")
+    inc_dir = str(tmp_path / "incidents")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               STS_TEST_CKPT=ck, STS_INCIDENT_DIR=inc_dir)
+    out = subprocess.run([sys.executable, "-c", _TORN_CHILD],
+                         capture_output=True, text=True, cwd=REPO,
+                         env=env, timeout=600)
+    assert out.returncode == -9, (out.returncode, out.stderr[-2000:])
+    # gen 1 committed; gen 2 is torn debris (bundles, no manifest)
+    found = FleetRuntime.latest_generation(ck)
+    assert found is not None and found[0] == 1, found
+    torn = os.path.join(ck, f"{_GEN_PREFIX}00000002")
+    assert os.path.isdir(torn)
+    assert not os.path.exists(os.path.join(torn, _MANIFEST))
+    # the pre-kill forensics bundle landed
+    names = os.listdir(inc_dir) if os.path.isdir(inc_dir) else []
+    assert any("checkpoint_torn" in n for n in names), names
+
+    # restore in THIS process; the uninterrupted mirror recomputes the
+    # child's whole stream locally (fits are cross-process bitwise
+    # deterministic — the journal resume suite pins that)
+    def panel(n_series, n, seed):
+        rng = np.random.default_rng(seed)
+        e = rng.normal(size=(n_series, n + 16))
+        y = np.zeros((n_series, n + 16))
+        for t in range(2, n + 16):
+            y[:, t] = 0.3 + 0.5 * y[:, t - 1] - 0.2 * y[:, t - 2] \
+                + e[:, t]
+        return y[:, 16:]
+
+    hists = [panel(S, 120, 71 + i) for i in range(2)]
+    live = [panel(S, 40, 81 + i) for i in range(2)]
+    models = [arima.fit(2, 0, 0, jnp.asarray(h), warn=False)
+              for h in hists]
+    mirrors = _mirrors(models, hists)
+    reg = metrics.MetricsRegistry()
+    rt = FleetRuntime(FleetScheduler(registry=reg, auto_pump=False),
+                      policy=RuntimePolicy(checkpoint_dir=ck),
+                      registry=reg)
+    assert sorted(rt.restore_latest()) == ["t0", "t1"]
+    # gen 1 = ticks 0..7 applied + tick 8 pending; adopt replayed it
+    for i in range(2):
+        for t in range(9):
+            mirrors[i].update(live[i][:, t])
+    _assert_bitwise(rt, mirrors)
+    # the resumed fleet keeps serving bitwise — and checkpoints number
+    # PAST the torn debris (generation 3, never a reused 2)
+    for t in range(9, 13):
+        for i in range(2):
+            rt.submit(f"t{i}", live[i][:, t])
+            mirrors[i].update(live[i][:, t])
+        rt.pump_once()
+    _assert_bitwise(rt, mirrors)
+    np.testing.assert_array_equal(rt.forecast("t0", 6),
+                                  mirrors[0].forecast(6))
+    assert rt.checkpoint()["generation"] == 3
+
+
+# ---------------------------------------------------------------------------
+# self-driving rebalance
+# ---------------------------------------------------------------------------
+
+def test_rebalance_consolidates_fragmented_group_bitwise(tmp_path):
+    # 3 same-key tenants split 2/1 across shards: the group dispatches
+    # two under-filled batches per sweep until consolidation heals it
+    rt, models, hists, reg = _build_runtime(
+        3, n_shards=2, seed0=73,
+        policy=RuntimePolicy(checkpoint_dir=str(tmp_path / "ck")))
+    mirrors = _mirrors(models, hists)
+    rng = np.random.default_rng(19)
+    ticks = rng.normal(size=(3, S, 6))
+    for t in range(3):
+        for i in range(3):
+            rt.submit(f"t{i}", ticks[i, :, t])
+        rt.pump_once()
+    assert len(rt.shards[0]._tenants) == 2        # t0, t2
+    assert len(rt.shards[1]._tenants) == 1        # t1 — the fragment
+    moves = rt.rebalance()
+    assert [(m["tenant"], m["from"], m["to"]) for m in moves] == \
+        [("t1", rt.shards[1].label, rt.shards[0].label)]
+    assert len(rt.shards[0]._tenants) == 3
+    assert len(rt.shards[1]._tenants) == 0
+    assert rt.rebalance() == []                   # converged: no churn
+    # zero tick loss, bitwise, through the move
+    for t in range(3, 6):
+        for i in range(3):
+            rt.submit(f"t{i}", ticks[i, :, t])
+        rt.pump_once()
+    for i in range(3):
+        for t in range(6):
+            mirrors[i].update(ticks[i, :, t])
+    _assert_bitwise(rt, mirrors)
+    assert reg.snapshot()["counters"]["fleet.rebalanced_tenants"] == 1
+
+
+def test_rebalance_spreads_load_when_groups_are_whole(tmp_path):
+    # distinct update keys (different model orders) -> no fragmentation;
+    # a 3-vs-0 load split exceeds the imbalance ratio and spreads
+    reg = metrics.MetricsRegistry()
+    hists = [_ar2_panel(S, N_HIST, seed=75 + i) for i in range(3)]
+    orders = [(2, 0, 0), (1, 0, 0), (0, 0, 1)]
+    models = [arima.fit(p, d, q, jnp.asarray(h), warn=False)
+              for (p, d, q), h in zip(orders, hists)]
+    shards = [FleetScheduler(registry=reg, auto_pump=False)
+              for _ in range(2)]
+    for i, (m, h) in enumerate(zip(models, hists)):
+        shards[0].attach(ss.ServingSession.start(m, h, label=f"t{i}",
+                                                 registry=reg))
+    rt = FleetRuntime(shards, registry=reg,
+                      policy=RuntimePolicy(
+                          checkpoint_dir=str(tmp_path / "ck"),
+                          rebalance_imbalance=2.0))
+    moves = rt.rebalance()
+    assert len(moves) == 1
+    assert moves[0]["from"] == shards[0].label
+    assert moves[0]["to"] == shards[1].label
+    assert len(shards[0]._tenants) == 2
+    assert len(shards[1]._tenants) == 1
+    assert reg.snapshot()["counters"]["fleet.rebalanced_tenants"] == 1
+
+
+# ---------------------------------------------------------------------------
+# race harness: pump vs submit vs checkpoint vs scrape (+ drain/adopt)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.timeout(900)
+@pytest.mark.parametrize("seed", [1, 5])
+def test_runtime_pump_submit_checkpoint_scrape_acyclic(seed, tmp_path):
+    """Seeded adversarial interleavings of every runtime entry point;
+    the recorded acquisition-order graph must stay acyclic (the runtime
+    cross-check of the §6d lock table rows 1-2) and no thread may see a
+    torn scheduler state."""
+    from spark_timeseries_tpu.utils import races
+
+    reg = metrics.MetricsRegistry()
+    models, hists = _tenant_fixtures(3, seed0=77)
+    shards = [FleetScheduler(AdmissionPolicy(queue_depth=64),
+                             registry=reg, auto_pump=False)
+              for _ in range(2)]
+    for i, (m, h) in enumerate(zip(models, hists)):
+        shards[i % 2].attach(ss.ServingSession.start(
+            m, h, label=f"t{i}", registry=reg))
+    for sh in shards:
+        sh.warmup()
+    rng = np.random.default_rng(21)
+    ticks = rng.normal(size=(3, S, 4))
+    with races.instrument(seed=seed) as h:
+        # built INSIDE the scope: the runtime's instance locks (and the
+        # condition variable sharing the main one) come from the traced
+        # factories
+        rt = FleetRuntime(shards, registry=reg,
+                          policy=RuntimePolicy(
+                              checkpoint_dir=str(tmp_path / f"ck{seed}")))
+
+        def producer():
+            for t in range(4):
+                for i in range(3):
+                    # queues stay far below depth: a blocking wait would
+                    # park outside the instrumented boundaries
+                    rt.submit(f"t{i}", ticks[i, :, t], block=False)
+
+        def pumper():
+            for _ in range(6):
+                rt.pump_once()
+
+        def checkpointer():
+            for _ in range(2):
+                rt.checkpoint()
+
+        def scraper():
+            for _ in range(6):
+                rt.pump_summary()
+                for sh in rt.shards:
+                    sh.telemetry_summary()
+                telemetry.healthz_doc()
+
+        def rebalancer():
+            rt.rebalance()
+
+        for fn, label in ((producer, "producer"), (pumper, "pumper"),
+                          (checkpointer, "checkpointer"),
+                          (scraper, "scraper"),
+                          (rebalancer, "rebalancer")):
+            h.spawn(fn, label=label)
+        h.join_all()
+        h.raise_errors()
+        h.assert_acyclic()
+    # drain the remainder: uneven queues park behind the coalesce
+    # window (0.05 s), so sweep until empty, not until one idle sweep
+    deadline = time.monotonic() + 30.0
+    while any(t.queue for sh in rt.shards
+              for t in sh._tenants.values()):
+        assert time.monotonic() < deadline, "post-race drain wedged"
+        rt.pump_once()
+    total = sum(t.session.ticks_seen - N_HIST
+                for sh in rt.shards for t in sh._tenants.values())
+    assert total == 12, "ticks lost or double-dispatched under races"
+
+
+# ---------------------------------------------------------------------------
+# 0-recompile pin with runtime + quality + telemetry armed; surfaces
+# ---------------------------------------------------------------------------
+
+def test_warmed_runtime_zero_compiles_with_quality_and_telemetry():
+    metrics.install_jax_hooks()
+    reg = metrics.MetricsRegistry()
+    models, hists = _tenant_fixtures(3, seed0=91)
+    sched = FleetScheduler(registry=reg, auto_pump=False)
+    for i, (m, h) in enumerate(zip(models, hists)):
+        sched.attach(ss.ServingSession.start(
+            m, h, label=f"t{i}", registry=reg,
+            quality=ss.QualityPolicy()))
+    rt = FleetRuntime(sched, registry=reg)
+    srv = telemetry.start(port=0)
+    try:
+        rt.warmup()
+        for i in range(3):
+            rt.forecast(f"t{i}", 5)          # warm this horizon
+        rng = np.random.default_rng(23)
+        ticks = rng.normal(size=(3, S, 4))
+        with rt:
+            before = metrics.jax_stats()["jit_compiles"]
+            for t in range(4):
+                for i in range(3):
+                    rt.submit(f"t{i}", ticks[i, :, t], block=True,
+                              timeout=30.0)
+            assert rt.quiesce(timeout=30.0)
+            for i in range(3):
+                rt.forecast(f"t{i}", 5)
+            assert metrics.jax_stats()["jit_compiles"] - before == 0, \
+                "compiles leaked into the runtime-armed warmed tick path"
+            # the scrape surfaces carry the pump while traffic flows
+            doc = telemetry.healthz_doc()
+            mine = [r for r in doc["fleet_pumps"]
+                    if r.get("runtime") == rt.label]
+            assert mine and mine[0]["running"] and not mine[0]["stale"]
+            assert doc["n_fleet_pumps"] >= 1
+            snap = telemetry.snapshot_doc()
+            panel = [f for f in snap["fleets"]
+                     if f.get("label") == sched.label]
+            assert panel and isinstance(panel[0].get("pump"), dict)
+            assert panel[0]["pump"]["runtime"] == rt.label
+            assert panel[0]["queue_depth"] == sched.policy.queue_depth
+    finally:
+        telemetry.stop()
+
+
+def test_sts_top_renders_pump_line_and_degrades():
+    from tools.sts_top import _fleet_pump_line, render_snapshot
+
+    snap = {"pid": 1, "time_unix": time.time(), "fleets": [{
+        "label": "fl0", "tenants": 1, "groups": 1, "queued": 3,
+        "shed_tenants": 0, "p95_ms": 1.5, "slo_burns": 0, "slo_ms": None,
+        "queue_depth": 8,
+        "pump": {"runtime": "rtA", "running": True, "pumps": 42,
+                 "restarts": 2, "heartbeat_age_s": 0.01,
+                 "stale_after_s": 5.0, "stalled": False,
+                 "backpressure_waiters": 1, "checkpoint_generation": 7,
+                 "checkpoint_failures": 0, "last_checkpoint_unix": None,
+                 "last_error": None},
+        "tenant_rows": [{"tenant": "t0", "mode": 0, "n_series": 4,
+                         "queued": 3, "admitted": 9, "rejected": 0,
+                         "dropped": 0, "cache_serves": 0, "health": {}}],
+    }]}
+    frame = render_snapshot(json.loads(json.dumps(snap)))
+    assert "pump rtA" in frame
+    assert "restarts 2" in frame
+    assert "ckpt-gen 7" in frame
+    assert "3/8" in frame                    # backpressure fill / depth
+    assert "STALLED" not in frame
+    # stalled and stopped pumps flag loudly
+    stalled = dict(snap["fleets"][0]["pump"], stalled=True)
+    assert "[STALLED]" in _fleet_pump_line(stalled)
+    stopped = dict(snap["fleets"][0]["pump"], running=False)
+    assert "[STOPPED]" in _fleet_pump_line(stopped)
+    assert "scrape error" in _fleet_pump_line({"error": "boom"})
+    # version tolerance: pre-runtime exporters send no pump block and
+    # no queue_depth — the panel renders, raw queue depth shown
+    old = {"pid": 1, "fleets": [{"label": "fl0", "tenants": 1,
+                                 "tenant_rows": [{"tenant": "t0",
+                                                  "queued": 3}]}]}
+    frame = render_snapshot(old)
+    assert "fl0" in frame and "pump" not in frame
+    assert " 3 " in frame or "3" in frame
+
+
+def test_bench_gate_extracts_runtime_supervision_metrics():
+    from tools.bench_gate import METRICS, extract_metrics
+
+    names = [m[0] for m in METRICS]
+    assert "fleet_pump_restarts" in names
+    assert "fleet_checkpoint_failures" in names
+
+    # fleet block present + key absent = measured 0 (registry counters
+    # materialize on first increment)
+    h = {"value": 1.0, "fleet_demo": {"fleet_ticks_per_s": 5000.0}}
+    got = extract_metrics(h)
+    assert got["fleet_pump_restarts"] == 0.0
+    assert got["fleet_checkpoint_failures"] == 0.0
+
+    h = {"value": 1.0, "fleet_demo": {
+        "fleet_ticks_per_s": 5000.0, "pump_restarts": 2,
+        "checkpoint_failures": 1}}
+    got = extract_metrics(h)
+    assert got["fleet_pump_restarts"] == 2.0
+    assert got["fleet_checkpoint_failures"] == 1.0
+
+    # pre-runtime rounds and errored demos fabricate nothing
+    assert "fleet_pump_restarts" not in extract_metrics({"value": 1.0})
+    assert "fleet_pump_restarts" not in extract_metrics(
+        {"value": 1.0, "fleet_demo": {"error": "boom"}})

@@ -35,6 +35,8 @@ automated check (``make gate``):
   incidents_written             ``metrics.telemetry["incidents_written"]``    higher
   fleet_ticks_per_s             headline ``fleet_demo.fleet_ticks_per_s``     lower
   fleet_shed_lanes              headline ``fleet_demo.shed_lanes``            higher
+  fleet_pump_restarts           headline ``fleet_demo.pump_restarts``         higher
+  fleet_checkpoint_failures     headline ``fleet_demo.checkpoint_failures``   higher
   backtest_champion_smape       headline ``backtest_demo.champion_smape``     higher
   backtest_champion_mase        headline ``backtest_demo.champion_mase``      higher
   serving_live_smape            headline ``serving_demo.quality.live_smape``  higher
@@ -101,6 +103,16 @@ automated check (``make gate``):
   the SLO, so any round where the scheduler started shedding lanes is
   flagged against an all-zero history.  Both tolerated-absent in
   pre-fleet rounds.
+
+  ``fleet_pump_restarts`` / ``fleet_checkpoint_failures`` are the
+  autonomous-runtime supervision gates (ISSUE 17): the fleet demo now
+  runs through ``FleetRuntime``'s supervised background pump, and a
+  healthy round restarts that pump zero times and fails zero
+  auto-checkpoint generations.  Zero-baselined like the reliability
+  counters (block present + key absent = measured 0, since registry
+  counters materialize on first increment); tolerated-absent in
+  pre-runtime rounds.  ``fleet_ticks_per_s`` doubling as the guard
+  that arming the async runtime did not tax throughput.
 
   ``backtest_champion_smape`` / ``backtest_champion_mase`` are the
   repo's first ACCURACY gates (ISSUE 13): the bench's ``backtest_demo``
@@ -174,6 +186,8 @@ METRICS = [
     ("incidents_written", "lower_better", 50.0),
     ("fleet_ticks_per_s", "higher_better", 25.0),
     ("fleet_shed_lanes", "lower_better", 50.0),
+    ("fleet_pump_restarts", "lower_better", 50.0),
+    ("fleet_checkpoint_failures", "lower_better", 50.0),
     ("backtest_champion_smape", "lower_better", 25.0),
     ("backtest_champion_mase", "lower_better", 25.0),
     ("serving_live_smape", "lower_better", 25.0),
@@ -279,6 +293,16 @@ def extract_metrics(headline: Optional[dict]) -> Dict[str, float]:
             v = fd.get("shed_lanes", 0)
             if isinstance(v, (int, float)):
                 out["fleet_shed_lanes"] = float(v)
+            # runtime supervision gates (ISSUE 17): a healthy bench
+            # fleet restarts its pump zero times and tears zero
+            # checkpoints — block present + key absent = measured 0
+            # (pre-runtime rounds emit no fleet block keys at all)
+            for src, dst in (("pump_restarts", "fleet_pump_restarts"),
+                             ("checkpoint_failures",
+                              "fleet_checkpoint_failures")):
+                v = fd.get(src, 0)
+                if isinstance(v, (int, float)):
+                    out[dst] = float(v)
     # backtest tier (ISSUE 13): the first accuracy (not throughput)
     # gates — panel-mean champion out-of-sample error on the pinned
     # synthetic demo panel, higher-is-regression; tolerated-absent in

@@ -191,17 +191,45 @@ def _serving_rows(sessions: List[Dict[str, Any]]) -> List[List[str]]:
     return rows
 
 
-def _fleet_tenant_rows(rows: List[Dict[str, Any]]) -> List[List[str]]:
+def _fleet_pump_line(pump: Dict[str, Any]) -> str:
+    """Pump-liveness sub-line for one supervised fleet (absent on
+    snapshots from pre-runtime exporters — the caller skips it)."""
+    if "error" in pump and "runtime" not in pump:
+        return f"  pump: (scrape error: {str(pump['error'])[:60]})"
+    restarts = pump.get("restarts", 0)
+    line = (f"  pump {pump.get('runtime', '?')}: "
+            f"hb {_fmt_age(pump.get('heartbeat_age_s'))}  "
+            f"restarts {restarts}  "
+            f"waiters {pump.get('backpressure_waiters', 0)}  "
+            f"ckpt-gen {pump.get('checkpoint_generation', 0)}")
+    fails = pump.get("checkpoint_failures", 0)
+    if fails:
+        line += f"  ckpt-fail {fails}"
+    if not pump.get("running", True):
+        line += "  [STOPPED]"
+    elif pump.get("stalled"):
+        line += "  [STALLED]"
+    return line
+
+
+def _fleet_tenant_rows(rows: List[Dict[str, Any]],
+                       queue_depth: Any = None) -> List[List[str]]:
     out = []
     for t in rows:
         health = t.get("health") or {}
         hstr = " ".join(f"{k}:{v}" for k, v in sorted(health.items())) \
             or "-"
+        queued = t.get("queued", 0)
+        # backpressure depth: fill over the bounded ingress queue
+        # (pre-runtime exporters don't send queue_depth — show raw)
+        qstr = f"{queued}/{queue_depth}" \
+            if isinstance(queue_depth, int) and queue_depth > 0 \
+            else str(queued)
         out.append([
             str(t.get("tenant", "?")),
             str(t.get("mode", "?")).upper(),
             str(t.get("n_series", "?")),
-            str(t.get("queued", 0)),
+            qstr,
             str(t.get("admitted", 0)),
             str(t.get("rejected", 0)),
             str(t.get("dropped", 0)),
@@ -343,12 +371,15 @@ def render_snapshot(snap: Dict[str, Any], job_sort: str = "eta") -> str:
                 f"shed {fl.get('shed_tenants', 0)}  p95 {p95s}  "
                 f"slo_burns {fl.get('slo_burns', 0)}  "
                 f"slo_ms {fl.get('slo_ms') or '-'}")
+            pump = fl.get("pump")
+            if isinstance(pump, dict):
+                lines.append(_fleet_pump_line(pump))
             rows = _dicts(fl.get("tenant_rows"))
             if rows:
                 lines += ["    " + ln for ln in _table(
                     ["TENANT", "MODE", "SERIES", "QUEUED", "ADM",
                      "REJ", "DROP", "CACHE", "HEALTH"],
-                    _fleet_tenant_rows(rows))]
+                    _fleet_tenant_rows(rows, fl.get("queue_depth")))]
     else:
         lines.append("  (no live fleet schedulers)")
     lines.append("")
