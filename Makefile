@@ -16,7 +16,7 @@ STS_COMPILE_CACHE ?=
 .PHONY: help verify compileall tier1 verify-faults verify-durability \
 	verify-perf verify-serving verify-long verify-telemetry verify-fleet \
 	verify-backtest verify-quality verify-races verify-attribution \
-	verify-runtime gate \
+	verify-runtime verify-lineage gate \
 	bench-diff trace lint lint-baseline contracts verify-static \
 	jax-audit warmup
 
@@ -59,6 +59,10 @@ help:
 	@echo "                blocking backpressure, auto-checkpoint generations + kill -9"
 	@echo "                mid-checkpoint recovery, self-driving rebalance), plain and"
 	@echo "                under STS_FAULT_INJECT=1 (pump_crash/pump_hang/checkpoint_torn)"
+	@echo "  verify-lineage tick-lineage suite (stage decomposition covers the e2e wall,"
+	@echo "                exactly-once lineage under pump_crash + drain/adopt, cache-serve"
+	@echo "                detours, ring bounds, 0-recompile pin armed), plain and under"
+	@echo "                STS_FAULT_INJECT=1"
 	@echo "  verify-perf   attribution suite + perf gate: newest BENCH_r*.json vs"
 	@echo "                trailing-median baseline"
 	@echo "  verify-attribution attribution-plane suite (span self-time oracle, stream_fit"
@@ -143,7 +147,7 @@ tier1:
 # modes) runs under the same env, so heal()'s batch refit exercises its
 # forced-retry path too.
 verify-faults: verify-durability verify-telemetry verify-fleet \
-		verify-quality verify-runtime
+		verify-quality verify-runtime verify-lineage
 	STS_FAULT_INJECT=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 		-m 'not slow' --continue-on-collection-errors \
 		-p no:cacheprovider -p no:xdist -p no:randomly
@@ -257,6 +261,22 @@ verify-runtime:
 		-p no:xdist -p no:randomly
 	STS_FAULT_INJECT=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 		-m runtime --continue-on-collection-errors \
+		-p no:cacheprovider -p no:xdist -p no:randomly
+
+# tick-lineage gate (ISSUE 18): the `lineage`-marked subset — per-tick
+# stage decomposition covering ≥90% of each tick's submit→delivery wall
+# on the pumped path, exactly-once lineage (every begin finalized by one
+# complete) under pump_crash restarts and drain/adopt migration incl.
+# the seeded race harness, shed→cache serves recorded via=cache,
+# bounded-ring overflow accounting, and the warmed-tick 0-recompile pin
+# with lineage + quality + telemetry + runtime all armed.  Second pass
+# under STS_FAULT_INJECT=1 forces the pump_crash path wherever armed.
+verify-lineage:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m lineage \
+		--continue-on-collection-errors -p no:cacheprovider \
+		-p no:xdist -p no:randomly
+	STS_FAULT_INJECT=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
+		-m lineage --continue-on-collection-errors \
 		-p no:cacheprovider -p no:xdist -p no:randomly
 
 # attribution-plane suite (ISSUE 16): span self-time vs a hand-computed

@@ -60,6 +60,19 @@ deterministically fault-injectable (``utils.resilience``):
   raises :class:`FleetRestoreMismatch` naming the differing fields (the
   ``JournalSpecMismatch`` discipline).
 
+Every admitted tick also carries a **lineage record**
+(``utils.lineage``): a monotonic trace id plus contiguous stage
+timestamps — admit → queue → gather → dispatch → scatter → deliver,
+with detour markers for shed rolls, cache serves, catch-up replay,
+drain/adopt migration, and pump-restart redelivery — so the end-to-end
+latency a *caller* experiences decomposes per stage
+(``fleet.e2e.<tenant>.p50_ms``/``.p95_ms`` gauges, the
+``/snapshot.json`` ``lineage`` section, lineage spans interleaved in
+``/trace.json``).  The record rides the queue entry itself, so it
+survives pump crashes and migrates with the tenant; every record is
+finalised exactly once (``delivered``/``rejected``/``dropped``/
+``migrated``).  Strictly host-side; ``STS_LINEAGE=0`` disarms.
+
 Like a single session, a scheduler is one logical serving plane: not
 thread-safe per instance — shard across schedulers (the compiled
 programs are shared through the jit cache anyway).
@@ -86,6 +99,7 @@ from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 import numpy as np
 
 from ..utils import checkpoint as _checkpoint
+from ..utils import lineage as _lineage
 from ..utils import metrics as _metrics
 from ..utils import resilience as _resilience
 from ..utils import telemetry as _telemetry
@@ -198,9 +212,10 @@ class _Tenant:
     def __init__(self, session: ServingSession, policy: AdmissionPolicy):
         self.session = session
         self.label = session.label
-        self.queue: deque = deque()          # (tick, offset, t_arrival)
+        self.queue: deque = deque()   # (tick, offset, t_arrival, lineage)
         self.mode = TENANT_LIVE
         self.shed_reason: Optional[str] = None
+        # (tick, offset, lineage) — bounded shed-lane replay buffer
         self.catchup: deque = deque(maxlen=policy.catchup_ring)
         self.cache_fc: Optional[np.ndarray] = None   # (n_series, H)
         self.cache_stamp = 0                 # `arrived` at cache time
@@ -326,6 +341,10 @@ class FleetScheduler:
         if t.queue or t.catchup:
             self._reg.inc("fleet.dropped_ticks",
                           len(t.queue) + len(t.catchup))
+            for entry in t.queue:
+                _lineage.complete(entry[3], self._reg, outcome="dropped")
+            for entry in t.catchup:
+                _lineage.complete(entry[2], self._reg, outcome="dropped")
         return t.session
 
     def _pop_tenant(self, label: str) -> _Tenant:
@@ -378,12 +397,16 @@ class FleetScheduler:
         if self.auto_pump:
             self.pump()
 
-    def _admit_one(self, t: _Tenant, tick, offset) -> None:
+    def _admit_one(self, t: _Tenant, tick, offset, lin=None) -> None:
         # width is validated HERE, at the admission boundary: a
         # malformed tick discovered only inside a coalesced dispatch
         # would already have dequeued the peers' ticks (losing them) and
         # would raise out of an unrelated tenant's submit — the bad
         # producer must be the one that sees the error
+        if lin is None:
+            # minted once per admitted tick — the "degrade" branch
+            # re-enters with the SAME record (one tick, one lineage)
+            lin = _lineage.begin(t.label)
         tick = np.asarray(tick).reshape(-1)
         if tick.shape[0] != t.n_series:
             raise ValueError(
@@ -401,9 +424,14 @@ class FleetScheduler:
             if len(t.catchup) == t.catchup.maxlen:
                 t.dropped += 1
                 self._reg.inc("fleet.dropped_ticks")
+                _lineage.complete(t.catchup[0][2], self._reg,
+                                  outcome="dropped")
+            if lin is not None:
+                lin.detour("shed")
+                lin.stage_end("admit")
             t.catchup.append((np.array(tick, copy=True),
                               None if offset is None
-                              else np.array(offset, copy=True)))
+                              else np.array(offset, copy=True), lin))
             t.admitted += 1
             t.arrived += 1
             self._reg.inc("fleet.admitted")
@@ -413,6 +441,7 @@ class FleetScheduler:
             if mode == "reject":
                 t.rejected += 1
                 self._reg.inc("fleet.rejected")
+                _lineage.complete(lin, self._reg, outcome="rejected")
                 raise FleetSaturated(
                     f"tenant {t.label!r} ingress queue is full "
                     f"({self.policy.queue_depth} ticks) and the "
@@ -420,14 +449,18 @@ class FleetScheduler:
                     f"scheduler, slow the producer, or use "
                     f"on_full='drop_oldest'/'degrade'")
             if mode == "drop_oldest":
-                t.queue.popleft()
+                evicted = t.queue.popleft()
                 t.dropped += 1
                 self._reg.inc("fleet.dropped_ticks")
+                _lineage.complete(evicted[3], self._reg,
+                                  outcome="dropped")
             else:                     # degrade: shed onto the cache lane
                 self._shed(t, reason="admission")
-                self._admit_one(t, tick, offset)
+                self._admit_one(t, tick, offset, lin)
                 return
-        t.queue.append((np.asarray(tick), offset, time.monotonic()))
+        if lin is not None:
+            lin.stage_end("admit")
+        t.queue.append((np.asarray(tick), offset, time.monotonic(), lin))
         t.admitted += 1
         t.arrived += 1
         self._reg.inc("fleet.admitted")
@@ -462,18 +495,26 @@ class FleetScheduler:
                 (time.monotonic() - oldest) >= self.policy.coalesce_window_s
             if not (force or all_present or expired):
                 continue
-            reports.append(self._dispatch_group(key, with_ticks))
+            # a window-deadline flush with members still missing is the
+            # straggler-pays-alone path — the dispatched ticks' lineage
+            # records mark it, so a latency regression can be attributed
+            # to partial batching rather than the device call
+            reports.append(self._dispatch_group(
+                key, with_ticks,
+                deadline_flush=expired and not all_present))
         self._shed_restore_step()
         return reports
 
-    def _dispatch_group(self, key, members: List[_Tenant]
-                        ) -> Dict[str, Any]:
+    def _dispatch_group(self, key, members: List[_Tenant],
+                        deadline_flush: bool = False) -> Dict[str, Any]:
         """One coalesced device call: pop one queued tick per member,
         gather the group's pytrees lane-wise, run the SAME jitted update
         the sessions run solo, scatter each member's slice back through
         its session's absorb path.  Bitwise the per-session ticks — the
         math is per-lane, the function object is shared, and the host
-        accounting is the session's own."""
+        accounting is the session's own.  Each popped tick's lineage
+        record closes its ``queue`` segment here and then tracks
+        gather/dispatch/scatter/deliver through this call."""
         import jax
         import jax.numpy as jnp
 
@@ -481,8 +522,14 @@ class FleetScheduler:
         G = len(members)
         slots = _slots_for(G)
         prepped = []
+        lins = []
         for m in members:
-            tick, offset, _ = m.queue.popleft()
+            tick, offset, _, lin = m.queue.popleft()
+            if lin is not None:
+                lin.stage_end("queue")
+                if deadline_flush:
+                    lin.detour("window_deadline")
+            lins.append(lin)
             host, y, off = m.session._prepare_tick(tick, offset)
             prepped.append((m, host, y, off))
 
@@ -523,6 +570,9 @@ class FleetScheduler:
             off_all[i * bucket:(i + 1) * bucket] = off
 
         fn = _jitted("update")
+        for lin in lins:
+            if lin is not None:
+                lin.stage_end("gather")
         t0 = time.perf_counter()
         with _metrics.span("fleet.coalesced_step"):
             state2, health2, qstate2, v, f, ll_inc, anom = fn(
@@ -542,6 +592,9 @@ class FleetScheduler:
                     np.asarray(anom[lo:lo + n]),
                     np.asarray(health2.ew[lo:lo + n])))
         dt = time.perf_counter() - t0
+        for lin in lins:
+            if lin is not None:
+                lin.stage_end("dispatch")
 
         def take(i):
             lo = i * bucket
@@ -553,11 +606,17 @@ class FleetScheduler:
             sub_q = jax.tree_util.tree_map(take(i), qstate2) \
                 if quality is not None else None
             m.session._absorb_tick(host, sub_state, sub_health, outs[i],
-                                   dt, sub_q)
+                                   dt, sub_q, lineage=lins[i])
             m.ticks_dispatched += 1
         self._reg.inc("fleet.coalesced_dispatches")
         self._reg.inc("fleet.coalesced_ticks", G)
         self._note_latency(dt)
+        # delivery: the results are committed and visible to readers —
+        # close each journey and publish its e2e sample
+        for lin in lins:
+            if lin is not None:
+                lin.stage_end("deliver")
+                _lineage.complete(lin, self._reg)
         return {"key": (bucket, meta.family, meta.m), "tenants": G,
                 "slots": slots, "wall_ms": round(dt * 1e3, 3),
                 "dtype": _dtype}
@@ -732,13 +791,17 @@ class FleetScheduler:
         # undispatched queued ticks roll into the catch-up ring so a
         # later restore replays them in order
         while t.queue:
-            tick, offset, _ = t.queue.popleft()
+            tick, offset, _, lin = t.queue.popleft()
             if len(t.catchup) == t.catchup.maxlen:
                 t.dropped += 1
                 self._reg.inc("fleet.dropped_ticks")
+                _lineage.complete(t.catchup[0][2], self._reg,
+                                  outcome="dropped")
+            if lin is not None:
+                lin.detour("shed")
             t.catchup.append((np.array(tick, copy=True),
                               None if offset is None
-                              else np.array(offset, copy=True)))
+                              else np.array(offset, copy=True), lin))
         self._reg.inc("fleet.shed_lanes", t.n_series)
         self._reg.inc("fleet.shed_events")
         self._reg.set_gauge("fleet.shed_tenants", len(self._shed_order))
@@ -756,8 +819,15 @@ class FleetScheduler:
         the deterministic price of the overload window."""
         replayed = 0
         while t.catchup:
-            tick, offset = t.catchup.popleft()
+            tick, offset, lin = t.catchup.popleft()
+            if lin is not None:
+                lin.stage_end("queue")
+                lin.via = "replay"
+                lin.detour("catchup_replay")
             t.session.update(tick, offset)
+            if lin is not None:
+                lin.stage_end("replay")
+                _lineage.complete(lin, self._reg)
             replayed += 1
         t.mode = TENANT_LIVE
         t.shed_reason = None
@@ -801,20 +871,33 @@ class FleetScheduler:
             # state has not absorbed yet
             t.cache_stamp = t.arrived - len(t.queue)
             return fc
+        # a cache serve is a real request with a real latency — without
+        # its own lineage, a shed tenant's e2e panel would silently go
+        # blank exactly while it is degraded
+        lin = _lineage.begin(t.label, via="cache")
         shift = t.elapsed_since_cache()
         if t.cache_fc is not None and shift <= self.policy.cache_staleness \
                 and shift + horizon <= t.cache_fc.shape[1]:
             t.cache_serves += 1
             self._reg.inc("fleet.cache_serves")
-            return t.cache_fc[:, shift:shift + horizon]
+            out = t.cache_fc[:, shift:shift + horizon]
+            if lin is not None:
+                lin.stage_end("cache")
+                _lineage.complete(lin, self._reg)
+            return out
         # stale (or too-short) cache: predict-only refresh off the
         # frozen state — still no tick dispatched, still bounded work;
         # cache far enough ahead to keep serving through the bound
+        if lin is not None:
+            lin.detour("cache_stale")
         self._reg.inc("fleet.cache_stale")
         depth = horizon + self.policy.cache_staleness
         fc = t.session.forecast(depth)
         t.cache_fc = np.array(fc, copy=True)
         t.cache_stamp = t.arrived
+        if lin is not None:
+            lin.stage_end("cache")
+            _lineage.complete(lin, self._reg)
         return fc[:, :horizon]
 
     def last_status(self, label: str) -> np.ndarray:
@@ -890,6 +973,20 @@ class FleetScheduler:
         pending, catchup = bundle["pending"], bundle["catchup"]
         _checkpoint.save_pytree_atomic(path, bundle)
         self._reg.inc("fleet.drained")
+        # the bundle is committed: the queued ticks' journeys end HERE
+        # in this process (the adopting scheduler mints fresh records) —
+        # finalised before the injectable SIGKILL below, like the
+        # forensics bundle, so a drain-kill leaves no orphans behind
+        for entry in t.queue:
+            if entry[3] is not None:
+                entry[3].detour("drain")
+                _lineage.complete(entry[3], self._reg,
+                                  outcome="migrated")
+        for entry in t.catchup:
+            if entry[2] is not None:
+                entry[2].detour("drain")
+                _lineage.complete(entry[2], self._reg,
+                                  outcome="migrated")
         _metrics.trace_instant(
             "fleet.tenant_drained",
             {"tenant": t.label, "pending": int(pending.shape[0]),
@@ -988,11 +1085,24 @@ class FleetScheduler:
             # parking ticks there would reorder them behind new
             # submits, or lose them)
             now = time.monotonic()
+
+            def _migrated_lin():
+                # fresh records for the adopted ticks — trace ids never
+                # cross a process boundary; the origin finalised its
+                # records as "migrated" at drain commit
+                lin = _lineage.begin(label)
+                if lin is not None:
+                    lin.detour("adopt_migration")
+                    lin.stage_end("admit")
+                return lin
+
             deferred = [(np.array(row, copy=True),
-                         None if c_offs is None else c_offs[i], now)
+                         None if c_offs is None else c_offs[i], now,
+                         _migrated_lin())
                         for i, row in enumerate(catchup)]
             deferred += [(np.array(row, copy=True),
-                          None if p_offs is None else p_offs[i], now)
+                          None if p_offs is None else p_offs[i], now,
+                          _migrated_lin())
                          for i, row in enumerate(pending)]
             t.queue.extendleft(reversed(deferred))
             # the deferred ticks are stream arrivals for this tenant:

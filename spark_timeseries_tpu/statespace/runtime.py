@@ -27,6 +27,14 @@ pump that nobody babysits.  :class:`FleetRuntime` is that layer:
   of racing :class:`~.fleet.FleetSaturated`; the pump notifies after
   every sweep.  A deadline turns into the named
   :class:`FleetBackpressureTimeout` so producers degrade gracefully.
+  Submit also opens the tick's lineage clock (``utils.lineage``)
+  *before* any park, so the eventual record's ``admit`` stage carries
+  the backpressure wait (detour ``backpressure``); ticks still queued
+  when the watchdog replaces a crashed pump are marked
+  ``pump_restart_redelivery`` by the next generation's first sweep —
+  the record itself rides the queue entry, so redelivery is the same
+  record, never a duplicate (exactly-once, pinned under ``pump_crash``
+  by the race harness).
 
 - **Crash-only auto-checkpoint.**  Interval- and dirty-tick-driven
   snapshots of every tenant through the *drain bundle* format
@@ -66,6 +74,7 @@ import threading
 import time
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
+from ..utils import lineage as _lineage
 from ..utils import metrics as _metrics
 from ..utils import resilience as _resilience
 from ..utils import telemetry as _telemetry
@@ -224,6 +233,10 @@ class FleetRuntime:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._gen = 0                    # pump-thread generation token
+        # set by the watchdog on every pump restart; the replacement
+        # generation's first sweep consumes it and marks still-queued
+        # ticks' lineage records as redelivered
+        self._redeliver = False
         self._pump_thread: Optional[threading.Thread] = None
         self._watchdog_thread: Optional[threading.Thread] = None
         self._started = False
@@ -381,6 +394,10 @@ class FleetRuntime:
         from .fleet import FleetSaturated
         deadline = None if timeout is None \
             else time.monotonic() + float(timeout)
+        # open the lineage clock BEFORE any backpressure park: the
+        # record minted at admission inherits this thread's entry time,
+        # so its "admit" stage carries the wait a caller actually felt
+        _lineage.submit_entry()
         with self._cv:
             waited = False
             while True:
@@ -405,6 +422,9 @@ class FleetRuntime:
                     else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     self._reg.inc("fleet.backpressure_timeouts")
+                    # nothing was admitted: drop the pending lineage
+                    # context so it cannot leak into a later submit
+                    _lineage.submit_abandon()
                     raise FleetBackpressureTimeout(
                         f"tenant {label!r} ingress queue stayed full "
                         f"({sh.policy.queue_depth} ticks) for "
@@ -413,6 +433,7 @@ class FleetRuntime:
                         f"timeout/queue depth")
                 self._waiters += 1
                 self._wake.set()         # kick the pump to drain
+                _lineage.submit_parked()
                 try:
                     self._cv.wait(remaining)
                 finally:
@@ -495,6 +516,7 @@ class FleetRuntime:
                 raise _resilience.InjectedPumpCrash(
                     f"injected pump crash at sweep {self._pump_count} "
                     f"(every {max(1, int(crash.n_attempts))} sweeps)")
+            self._mark_redelivery_locked()
             n = 0
             for sh in self.shards:
                 n += len(sh.pump())
@@ -517,6 +539,7 @@ class FleetRuntime:
         with self._lock:
             self._pump_count += 1
             self._job.heartbeat("pump")
+            self._mark_redelivery_locked()
             n = 0
             for sh in self.shards:
                 n += len(sh.pump())
@@ -525,6 +548,25 @@ class FleetRuntime:
             self._maybe_rebalance_locked(now)
             self._cv.notify_all()
             return n
+
+    def _mark_redelivery_locked(self) -> None:
+        """Consume the watchdog's restart flag: every tick still queued
+        across the pump generation change keeps its ORIGINAL lineage
+        record (the queue survived the crash intact — that is the
+        crash-only design), and gets a ``pump_restart_redelivery``
+        detour so the trace shows the journey crossed a supervision
+        event.  Runtime lock held; the mgmt lock nests under it per the
+        §6d order."""
+        with self._mgmt_lock:
+            redeliver = self._redeliver
+            self._redeliver = False
+        if not redeliver:
+            return
+        for sh in self.shards:
+            for t in sh._tenants.values():
+                for entry in t.queue:
+                    if entry[3] is not None:
+                        entry[3].detour("pump_restart_redelivery")
 
     def _note_pump_death(self, exc: BaseException) -> None:
         from ..utils import flightrec as _flightrec
@@ -555,6 +597,7 @@ class FleetRuntime:
                 self._consec_failures += 1
                 self._restarts += 1
                 self._gen += 1           # abandon the old pump thread
+                self._redeliver = True   # next sweep marks survivors
                 attempt = min(self._consec_failures, 16)
             self._reg.inc("fleet.pump_restarts")
             if wedged:

@@ -469,7 +469,7 @@ class ServingSession:
         return host, y, off
 
     def _absorb_tick(self, host, state2, health2, out: TickResult,
-                     dt_s: float, qstate2=None) -> TickResult:
+                     dt_s: float, qstate2=None, lineage=None) -> TickResult:
         """Commit one tick's outputs into the session: state/health/
         quality swap, transition + latency accounting, history-ring
         push.  ``state2``/``health2``/``qstate2`` are the bucket-width
@@ -477,7 +477,10 @@ class ServingSession:
         per-session slices); ``out`` carries the already-materialized
         real-lane results.  The other half of :meth:`_prepare_tick`; the
         fleet scheduler calls the pair around its shared device call so
-        coalesced ticks are bitwise the per-session ticks."""
+        coalesced ticks are bitwise the per-session ticks.  ``lineage``
+        (the fleet's per-tick trace record) closes its ``scatter``
+        segment once the commit is visible — host-side accounting only,
+        never traced state."""
         self._state = state2
         self._health = health2
         if self._quality is not None and qstate2 is not None:
@@ -496,6 +499,8 @@ class ServingSession:
         self.ticks_seen += 1
         self._reg.inc("serving.updates")
         self._reg.inc("serving.ticks", self.n_series)
+        if lineage is not None:
+            lineage.stage_end("scatter")
         return out
 
     def update(self, ticks, offset=None) -> TickResult:

@@ -75,6 +75,11 @@ DEFAULT_KEEP = 20
 # overrides); the full 65536-event ring would make every bundle ~10 MB
 DEFAULT_TRACE_EVENTS = 4096
 
+# newest completed tick-lineage records embedded per bundle
+# (STS_INCIDENT_LINEAGE_RECORDS overrides) — a crashed pump's recent
+# in-flight ticks, stage by stage
+DEFAULT_LINEAGE_RECORDS = 64
+
 # top-level keys every schema-valid bundle must carry (the contract
 # tests and sts_top validate against)
 REQUIRED_KEYS = ("format", "kind", "time_unix", "time_iso", "pid",
@@ -184,6 +189,14 @@ def _trace_block() -> dict:
     return _tracing.to_chrome_trace(limit=limit)
 
 
+def _lineage_block() -> dict:
+    from . import lineage as _lineage
+
+    limit = _telemetry.env_positive("STS_INCIDENT_LINEAGE_RECORDS", int,
+                                    DEFAULT_LINEAGE_RECORDS)
+    return _lineage.incident_block(limit=limit)
+
+
 def record_incident(kind: str, *, exc: Optional[BaseException] = None,
                     job: Optional[Any] = None,
                     journal_path: Optional[str] = None,
@@ -220,6 +233,10 @@ def record_incident(kind: str, *, exc: Optional[BaseException] = None,
             "journal": _journal_block(journal_path),
             "registry": _telemetry.json_safe(reg.snapshot()),
             "trace": _trace_block(),
+            # optional (not in REQUIRED_KEYS: bundles from pre-lineage
+            # builds stay schema-valid) — the newest completed tick
+            # journeys at the moment of the incident
+            "lineage": _lineage_block(),
             "config": _config_block(),
         }
         if extra is not None:
@@ -318,4 +335,10 @@ def validate_bundle(bundle: Dict[str, Any]) -> List[str]:
         problems.append("config must be a dict")
     if not isinstance(bundle.get("jobs"), list):
         problems.append("jobs must be a list")
+    # optional key (absent from pre-lineage bundles): validated only
+    # when present, so old incidents stay schema-valid forever
+    lin = bundle.get("lineage")
+    if lin is not None and (not isinstance(lin, dict)
+                            or "records" not in lin):
+        problems.append("lineage, when present, must carry records")
     return problems

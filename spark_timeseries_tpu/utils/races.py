@@ -82,6 +82,7 @@ KNOWN_LOCKS: Tuple[Tuple[str, str], ...] = (
     ("spark_timeseries_tpu.utils.telemetry", "_runtimes_lock"),
     ("spark_timeseries_tpu.utils.telemetry", "_server_lock"),
     ("spark_timeseries_tpu.utils.metrics", "_install_lock"),
+    ("spark_timeseries_tpu.utils.lineage", "_lock"),
     ("spark_timeseries_tpu.native", "_lock"),
 )
 

@@ -501,6 +501,14 @@ def snapshot_doc(registry: Optional[Any] = None) -> Dict[str, Any]:
         }
     except Exception as e:  # noqa: BLE001 — scrape isolation
         doc["attribution"] = {"error": f"{type(e).__name__}: {e}"}
+    # tick lineage (docs/design.md §6h): per-tenant end-to-end latency
+    # with stage decomposition and slowest-tick exemplars — the E2E
+    # panel sts_top renders
+    try:
+        from . import lineage as _lineage
+        doc["lineage"] = json_safe(_lineage.lineage_summary())
+    except Exception as e:  # noqa: BLE001 — scrape isolation
+        doc["lineage"] = {"error": f"{type(e).__name__}: {e}"}
     try:
         from . import flightrec as _flightrec
         doc["incident_dir"] = _flightrec.incident_dir()

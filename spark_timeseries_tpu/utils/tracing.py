@@ -73,6 +73,7 @@ _SUBSYSTEM_BY_PREFIX = {
     "kalman": "statespace",
     "statespace": "statespace",
     "fleet": "statespace",
+    "lineage": "statespace",
     "quality": "statespace",
     "backtest": "backtest",
     "arima": "models",
@@ -223,9 +224,23 @@ def to_chrome_trace(events: Optional[List[Dict[str, Any]]] = None,
     ``limit`` keeps only the newest N events (by begin time) — the
     payload bound the telemetry exporter's ``/trace.json?limit=`` and
     the flight recorder's embedded trace use (a full 65536-event ring
-    renders to ~10 MB, too heavy for a scrape or an incident bundle)."""
+    renders to ~10 MB, too heavy for a scrape or an incident bundle).
+
+    When ``events`` is None the export also interleaves completed tick
+    lineage stages (``utils.lineage``) as spans on synthetic
+    ``lineage-*`` thread rows — the per-request journeys render right
+    next to the engine spans they contain, which is the whole point of
+    a trace: *this* tick's queue wait sits beside *that* dispatch.
+    Only the export merges them — :func:`self_times` /
+    :func:`self_time_report` keep reading the span ring alone, so
+    attribution totals are unchanged by the lineage plane."""
     if events is None:
         events = _metrics.trace_events()
+        try:
+            from . import lineage as _lineage
+            events = events + _lineage.trace_events()
+        except Exception:  # noqa: BLE001 — the trace must render even
+            pass           # if the lineage plane is broken mid-scrape
     if limit is not None and len(events) > limit:
         events = sorted(events, key=lambda e: e["ts"])[-int(limit):]
     pid = os.getpid()

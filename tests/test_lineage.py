@@ -1,0 +1,613 @@
+"""Tick lineage plane (ISSUE 18).
+
+The acceptance scenarios live here:
+
+- every delivered tick's stage decomposition (admit → queue → gather →
+  dispatch → scatter → deliver) is contiguous and its segment sum covers
+  ≥90% of the tick's submit→delivery wall time;
+- **exactly-once lineage**: every ``begin()`` is finalised by exactly
+  one ``complete()`` — across injected pump crashes (the queue entries
+  carry their records over the generation change), drain/adopt
+  migration (the origin finalises ``migrated``, the adopter mints fresh
+  ``adopt_migration`` records), and seeded adversarial interleavings
+  via the PR-13 race harness;
+- shed→cache serves record a real ``via="cache"`` lineage (the fix this
+  PR ships: a degraded tenant's e2e panel must not go blank), and
+  catch-up replay completes the buffered records ``via="replay"``;
+- backpressure park time lands inside the ``admit`` stage (detour
+  ``backpressure``) and an abandoned timed-out submit leaks no record;
+- the completed-record ring is bounded (overwrite-oldest, overflow
+  counted, never silent) and resizable;
+- the consumers hold: ``/snapshot.json`` ``lineage`` section, the
+  ``sts_top`` E2E panel (version-tolerant), Chrome-trace interleaving
+  on synthetic integer lanes (span self-time attribution unchanged),
+  flight-recorder bundles, and the bench-gate extraction;
+- the warmed tick path stays at **zero** recompiles with lineage +
+  quality + telemetry + runtime all armed.
+
+Fast in-process scenarios run in tier-1; the seeded race run is
+``slow`` and runs via ``make verify-lineage`` (the ``lineage`` marker),
+which ``verify-faults`` also drives under ``STS_FAULT_INJECT=1``.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from spark_timeseries_tpu import statespace as ss
+from spark_timeseries_tpu.models import arima
+from spark_timeseries_tpu.statespace.fleet import (
+    TENANT_LIVE, TENANT_SHED, AdmissionPolicy, FleetScheduler)
+from spark_timeseries_tpu.statespace.runtime import (
+    FleetBackpressureTimeout, FleetRuntime, RuntimePolicy)
+from spark_timeseries_tpu.utils import (
+    flightrec, lineage, metrics, resilience, telemetry, tracing)
+
+pytestmark = pytest.mark.lineage
+
+S, N_HIST = 4, 120       # the shared test_fleet geometry -> one shared
+#                          fit executable and serving bucket module-wide
+
+DISPATCH_STAGES = {"admit", "queue", "gather",
+                   "dispatch", "scatter", "deliver"}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_lineage():
+    """Lineage state is per-process module state; every test starts from
+    an empty ring and restores capacity/armed afterwards."""
+    prev_cap = lineage._cap
+    prev_armed = lineage.armed()
+    lineage.reset()
+    yield
+    lineage.arm(prev_armed)
+    lineage.set_capacity(prev_cap)
+    lineage.reset()
+
+
+def _ar2_panel(n_series, n, seed=0):
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=(n_series, n + 16))
+    y = np.zeros((n_series, n + 16))
+    for t in range(2, n + 16):
+        y[:, t] = 0.3 + 0.5 * y[:, t - 1] - 0.2 * y[:, t - 2] + e[:, t]
+    return y[:, 16:]
+
+
+def _tenant_fixtures(n_tenants, seed0=1):
+    hists = [_ar2_panel(S, N_HIST, seed=seed0 + i)
+             for i in range(n_tenants)]
+    models = [arima.fit(2, 0, 0, jnp.asarray(h), warn=False)
+              for h in hists]
+    return models, hists
+
+
+def _build_fleet(n_tenants, policy=None, seed0=1):
+    reg = metrics.MetricsRegistry()
+    sched = FleetScheduler(policy, registry=reg, auto_pump=False)
+    models, hists = _tenant_fixtures(n_tenants, seed0=seed0)
+    for i, (m, h) in enumerate(zip(models, hists)):
+        sched.attach(ss.ServingSession.start(m, h, label=f"t{i}",
+                                             registry=reg))
+    return sched, models, hists, reg
+
+
+def _build_runtime(n_tenants, *, policy=None, admission=None, seed0=1):
+    reg = metrics.MetricsRegistry()
+    models, hists = _tenant_fixtures(n_tenants, seed0=seed0)
+    sched = FleetScheduler(admission, registry=reg, auto_pump=False)
+    for i, (m, h) in enumerate(zip(models, hists)):
+        sched.attach(ss.ServingSession.start(m, h, label=f"t{i}",
+                                             registry=reg))
+    rt = FleetRuntime(sched, policy=policy, registry=reg)
+    return rt, models, hists, reg
+
+
+def _delivered():
+    return [r for r in lineage.records() if r["outcome"] == "delivered"]
+
+
+# ---------------------------------------------------------------------------
+# the record/ring substrate (no jax)
+# ---------------------------------------------------------------------------
+
+def test_ring_is_bounded_and_overflow_is_counted():
+    lineage.set_capacity(8)
+    minted = []
+    for _ in range(12):
+        lin = lineage.begin("rb")
+        minted.append(lin.trace_id)
+        lin.stage_end("admit")
+        lineage.complete(lin)
+    summary = lineage.lineage_summary()
+    assert summary["ring"] == {"len": 8, "capacity": 8, "dropped": 4}
+    ids = [r["trace_id"] for r in lineage.records()]
+    assert ids == minted[4:], \
+        "overflow must evict oldest; reads rotate oldest-first"
+    # shrink keeps the newest records that still fit
+    lineage.set_capacity(4)
+    assert [r["trace_id"] for r in lineage.records()] == ids[-4:]
+    with pytest.raises(ValueError, match="capacity"):
+        lineage.set_capacity(0)
+
+
+def test_exactly_once_duplicates_and_none_are_counted_not_raised():
+    reg = metrics.MetricsRegistry()
+    lineage.complete(None, reg)              # disarmed call sites: no-op
+    lin = lineage.begin("dup")
+    lin.stage_end("admit")
+    lineage.complete(lin, reg)
+    lineage.complete(lin, reg)               # a bug, surfaced countable
+    summary = lineage.lineage_summary()
+    assert summary["outcomes"] == {"delivered": 1}
+    assert summary["duplicate_completions"] == 1
+    assert summary["open"] == 0 and lineage.open_records() == 0
+    counters = reg.snapshot()["counters"]
+    assert counters["fleet.e2e.delivered"] == 1
+    assert counters["fleet.e2e.duplicate_completions"] == 1
+
+
+def test_non_delivered_outcomes_ring_but_never_histogram():
+    for outcome in ("rejected", "dropped", "migrated"):
+        lin = lineage.begin("sad")
+        lin.stage_end("admit")
+        lineage.complete(lin, outcome=outcome)
+    summary = lineage.lineage_summary()
+    assert summary["outcomes"] == {"rejected": 1, "dropped": 1,
+                                   "migrated": 1}
+    assert summary["e2e"]["n"] == 0, \
+        "failed journeys must not enter the latency histograms"
+    assert summary["tenants"] == {} and summary["stage_totals_ms"] == {}
+    assert len(lineage.records()) == 3
+
+
+def test_disarmed_plane_is_inert():
+    lineage.arm(False)
+    lineage.submit_entry()
+    lineage.submit_parked()
+    assert lineage.begin("off") is None
+    lineage.complete(None)
+    summary = lineage.lineage_summary()
+    assert summary["armed"] is False and summary["started"] == 0
+    assert lineage.records() == [] and lineage.trace_events() == []
+
+
+def test_tenant_cardinality_is_bounded(monkeypatch):
+    monkeypatch.setattr(lineage, "MAX_TENANTS", 2)
+    for label in ("ta", "tb", "tc"):
+        lin = lineage.begin(label)
+        lin.stage_end("admit")
+        lineage.complete(lin)
+    summary = lineage.lineage_summary()
+    assert set(summary["tenants"]) == {"ta", "tb"}
+    assert summary["tenant_overflow"] == 1
+    # the overflow tenant's record still ring-records — bounded maps,
+    # not silent loss
+    assert {r["tenant"] for r in lineage.records()} == {"ta", "tb", "tc"}
+
+
+# ---------------------------------------------------------------------------
+# the pumped dispatch path: stage decomposition + acceptance pin
+# ---------------------------------------------------------------------------
+
+def test_stage_decomposition_covers_the_e2e_wall():
+    sched, models, hists, reg = _build_fleet(3, seed0=11)
+    rng = np.random.default_rng(3)
+    ticks = rng.normal(size=(3, S, 5))
+    for t in range(5):
+        for i in range(3):
+            sched.submit(f"t{i}", ticks[i, :, t])
+        sched.pump(force=True)
+    recs = _delivered()
+    assert len(recs) == 15 and lineage.open_records() == 0
+    ids = [r["trace_id"] for r in recs]
+    assert len(set(ids)) == 15, "trace ids must be unique"
+    for rec in recs:
+        assert set(rec["stages"]) == DISPATCH_STAGES
+        assert rec["via"] == "dispatch" and rec["detours"] == []
+        # contiguity is the design: segments share one clock, so their
+        # sum reconstructs the journey (the >=90% acceptance pin)
+        covered = sum(rec["stages"].values())
+        assert covered >= 0.9 * rec["e2e_ms"], rec
+        starts = [ts for _, ts, _ in rec["segs"]]
+        assert starts == sorted(starts)
+    # per-tenant consumer surfaces
+    summary = lineage.lineage_summary()
+    for i in range(3):
+        td = summary["tenants"][f"t{i}"]
+        assert td["n"] == 5 and td["delivered"] == 5
+        assert td["worst_stage"] in DISPATCH_STAGES
+    assert summary["e2e"]["n"] == 15
+    assert summary["exemplars"], "slowest-tick exemplars must capture"
+    gauges = reg.snapshot()["gauges"]
+    for i in range(3):
+        assert gauges[f"fleet.e2e.t{i}.p50_ms"] > 0
+        assert gauges[f"fleet.e2e.t{i}.p95_ms"] >= \
+            gauges[f"fleet.e2e.t{i}.p50_ms"]
+
+
+def test_window_deadline_flush_marks_the_straggler_payers():
+    # two same-key tenants coalesce; only t0 has ticks, so the group
+    # waits for t1 until the window expires and flushes partial
+    sched, models, hists, _ = _build_fleet(
+        2, policy=AdmissionPolicy(coalesce_window_s=0.05), seed0=21)
+    sched.submit("t0", np.zeros(S))
+    assert sched.pump() == [], "an unexpired partial group must wait"
+    time.sleep(0.06)
+    assert len(sched.pump()) == 1
+    (rec,) = _delivered()
+    assert rec["tenant"] == "t0"
+    assert rec["detours"] == ["window_deadline"]
+    assert set(rec["stages"]) == DISPATCH_STAGES
+
+
+# ---------------------------------------------------------------------------
+# detours: shed -> cache serve -> catch-up replay (the via=cache fix)
+# ---------------------------------------------------------------------------
+
+def test_cache_serves_record_via_cache_and_replay_completes():
+    sched, models, hists, _ = _build_fleet(
+        1, policy=AdmissionPolicy(queue_depth=1, on_full="degrade",
+                                  shed_cooldown=1), seed0=31)
+    rng = np.random.default_rng(5)
+    sched.submit("t0", rng.normal(size=S))     # queue 1/1
+    sched.submit("t0", rng.normal(size=S))     # degrade: tenant sheds
+    t = sched._tenants["t0"]
+    assert t.mode == TENANT_SHED and len(t.catchup) == 2
+    assert lineage.open_records() == 2         # buffered, not finalised
+    # a degraded tenant's forecasts are REAL requests: first read has no
+    # cache (stale path refreshes), second serves the cached path
+    sched.forecast("t0", 3)
+    sched.forecast("t0", 3)
+    cache_recs = [r for r in _delivered() if r["via"] == "cache"]
+    assert len(cache_recs) == 2
+    assert set(cache_recs[0]["stages"]) == {"cache"}
+    assert cache_recs[0]["detours"] == ["cache_stale"]
+    assert cache_recs[1]["detours"] == []
+    summary = lineage.lineage_summary()
+    assert summary["tenants"]["t0"]["cache_serves"] == 2
+    # the restore ladder replays the buffered ticks: same records,
+    # completed via=replay — exactly-once through the whole degradation
+    sched.pump()
+    sched.pump()
+    assert sched._tenants["t0"].mode == TENANT_LIVE, \
+        "tenant should have restored"
+    replay_recs = [r for r in _delivered() if r["via"] == "replay"]
+    assert len(replay_recs) == 2
+    for rec in replay_recs:
+        assert "shed" in rec["detours"]
+        assert "catchup_replay" in rec["detours"]
+        assert "replay" in rec["stages"]
+    assert lineage.open_records() == 0
+    assert lineage.lineage_summary()["duplicate_completions"] == 0
+
+
+def test_shed_ring_eviction_and_drop_oldest_complete_as_dropped():
+    sched, models, hists, _ = _build_fleet(
+        1, policy=AdmissionPolicy(queue_depth=2, on_full="drop_oldest"),
+        seed0=41)
+    rng = np.random.default_rng(7)
+    for _ in range(4):                         # 2 queued + 2 evictions
+        sched.submit("t0", rng.normal(size=S))
+    summary = lineage.lineage_summary()
+    assert summary["outcomes"].get("dropped") == 2
+    assert lineage.open_records() == 2
+    sched.pump(force=True)
+    sched.pump(force=True)
+    summary = lineage.lineage_summary()
+    assert summary["outcomes"] == {"dropped": 2, "delivered": 2}
+    assert lineage.open_records() == 0
+
+
+# ---------------------------------------------------------------------------
+# runtime path: backpressure, redelivery, pump_crash exactly-once
+# ---------------------------------------------------------------------------
+
+def test_backpressure_park_lands_in_admit_and_timeouts_leak_nothing():
+    rt, models, hists, _ = _build_runtime(
+        1, admission=AdmissionPolicy(queue_depth=2), seed0=51,
+        policy=RuntimePolicy(pump_interval_s=0.005, stall_after_s=30.0))
+    rng = np.random.default_rng(9)
+    ticks = rng.normal(size=(S, 5))
+    with resilience.fault_injection("pump_hang", hang_s=1.5):
+        with rt:
+            # the first sweep sleeps outside the lock: submits proceed,
+            # nothing drains
+            rt.submit("t0", ticks[:, 0], block=False)
+            rt.submit("t0", ticks[:, 1], block=False)
+            with pytest.raises(FleetBackpressureTimeout):
+                rt.submit("t0", ticks[:, 2], block=True, timeout=0.3)
+            # the abandoned submit admitted nothing and minted nothing
+            assert lineage.lineage_summary()["started"] == 2
+            # this producer parks until the hung pump recovers + drains
+            rt.submit("t0", ticks[:, 3], block=True, timeout=30.0)
+            assert rt.quiesce(timeout=30.0)
+            # an uncontended submit afterwards never parks
+            rt.submit("t0", ticks[:, 4], block=True, timeout=30.0)
+            assert rt.quiesce(timeout=30.0)
+    recs = _delivered()
+    assert len(recs) == 4 and lineage.open_records() == 0
+    parked = [r for r in recs if "backpressure" in r["detours"]]
+    assert [r["trace_id"] for r in parked] == [recs[2]["trace_id"]], \
+        "exactly the parked submit carries the backpressure detour"
+    # the park happened before admission, so the admit stage carries it
+    assert parked[0]["stages"]["admit"] == max(
+        parked[0]["stages"].values())
+
+
+def test_pump_restart_redelivery_marks_surviving_queue_entries():
+    # deterministic variant: no real crash needed — the watchdog's only
+    # lineage-visible action is the redeliver flag, so raise it by hand
+    # and let the next sweep consume it
+    rt, models, hists, _ = _build_runtime(
+        1, admission=AdmissionPolicy(queue_depth=64), seed0=61)
+    rng = np.random.default_rng(11)
+    for t in range(3):
+        rt.submit("t0", rng.normal(size=S), block=False)
+    with rt._mgmt_lock:
+        rt._redeliver = True
+    while rt.pump_once():
+        pass
+    recs = _delivered()
+    assert len(recs) == 3 and lineage.open_records() == 0
+    for rec in recs:
+        assert "pump_restart_redelivery" in rec["detours"]
+        assert set(rec["stages"]) == DISPATCH_STAGES
+
+
+def test_exactly_once_lineage_under_pump_crash():
+    rt, models, hists, _ = _build_runtime(
+        3, seed0=71,
+        policy=RuntimePolicy(pump_interval_s=0.002,
+                             watchdog_interval_s=0.01))
+    rt.warmup()
+    rng = np.random.default_rng(13)
+    ticks = rng.normal(size=(3, S, 10))
+    with resilience.fault_injection("pump_crash", n_attempts=3):
+        with rt:
+            for t in range(10):
+                for i in range(3):
+                    rt.submit(f"t{i}", ticks[i, :, t], block=True,
+                              timeout=60.0)
+            assert rt.quiesce(timeout=60.0)
+            restarts = rt.pump_summary()["restarts"]
+    assert restarts >= 1, "the crash injector never fired"
+    summary = lineage.lineage_summary()
+    # the crash-only property, lineage edition: the queues survive the
+    # generation change carrying their records, so every admitted tick
+    # is delivered against exactly one record — no orphan, no duplicate
+    assert summary["started"] == 30
+    assert summary["outcomes"] == {"delivered": 30}
+    assert summary["open"] == 0
+    assert summary["duplicate_completions"] == 0
+    ids = [r["trace_id"] for r in lineage.records()]
+    assert len(set(ids)) == len(ids) == 30
+
+
+def test_exactly_once_lineage_across_drain_adopt(tmp_path):
+    src, models, hists, _ = _build_fleet(1, seed0=81)
+    rng = np.random.default_rng(15)
+    ticks = rng.normal(size=(S, 3))
+    for t in range(3):
+        src.submit("t0", ticks[:, t])
+    path = str(tmp_path / "t0.bundle")
+    src.drain("t0", path)
+    summary = lineage.lineage_summary()
+    # the origin's journeys end at the drain commit, finalised migrated
+    assert summary["outcomes"] == {"migrated": 3}
+    assert summary["open"] == 0
+    drained = [r for r in lineage.records() if r["outcome"] == "migrated"]
+    assert all("drain" in r["detours"] for r in drained)
+    old_ids = {r["trace_id"] for r in drained}
+    # the adopter mints FRESH records (trace ids never cross a process
+    # boundary) and delivers the deferred ticks through its own pump
+    dst = FleetScheduler(registry=metrics.MetricsRegistry(),
+                         auto_pump=False)
+    dst.adopt(path, replay=False)
+    assert lineage.open_records() == 3
+    dst.pump(force=True)
+    dst.pump(force=True)
+    dst.pump(force=True)
+    summary = lineage.lineage_summary()
+    assert summary["outcomes"] == {"migrated": 3, "delivered": 3}
+    assert summary["open"] == 0
+    adopted = _delivered()
+    assert len(adopted) == 3
+    for rec in adopted:
+        assert "adopt_migration" in rec["detours"]
+        assert rec["trace_id"] not in old_ids
+    assert summary["duplicate_completions"] == 0
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(900)
+@pytest.mark.parametrize("seed", [2, 7])
+def test_race_harness_exactly_once_lineage(seed):
+    """Seeded adversarial interleavings of submit vs pump vs the lineage
+    scrape: the module lock joins the instrumented set (races.KNOWN_LOCKS),
+    the recorded acquisition-order graph stays acyclic, and every
+    admitted tick ends with exactly one completed record."""
+    from spark_timeseries_tpu.utils import races
+
+    reg = metrics.MetricsRegistry()
+    models, hists = _tenant_fixtures(3, seed0=91)
+    shards = [FleetScheduler(AdmissionPolicy(queue_depth=64),
+                             registry=reg, auto_pump=False)
+              for _ in range(2)]
+    for i, (m, h) in enumerate(zip(models, hists)):
+        shards[i % 2].attach(ss.ServingSession.start(
+            m, h, label=f"t{i}", registry=reg))
+    for sh in shards:
+        sh.warmup()
+    rng = np.random.default_rng(17)
+    ticks = rng.normal(size=(3, S, 4))
+    with races.instrument(seed=seed) as h:
+        rt = FleetRuntime(shards, registry=reg)
+
+        def producer():
+            for t in range(4):
+                for i in range(3):
+                    rt.submit(f"t{i}", ticks[i, :, t], block=False)
+
+        def pumper():
+            for _ in range(6):
+                rt.pump_once()
+
+        def scraper():
+            for _ in range(6):
+                lineage.lineage_summary()
+                lineage.records()
+                rt.pump_summary()
+
+        for fn, label in ((producer, "producer"), (pumper, "pumper"),
+                          (scraper, "scraper")):
+            h.spawn(fn, label=label)
+        h.join_all()
+        h.raise_errors()
+        h.assert_acyclic()
+    # drain the remainder outside the instrumented scope
+    deadline = time.monotonic() + 30.0
+    while any(t.queue for sh in rt.shards
+              for t in sh._tenants.values()):
+        assert time.monotonic() < deadline, "post-race drain wedged"
+        rt.pump_once()
+    summary = lineage.lineage_summary()
+    assert summary["started"] == 12
+    assert summary["outcomes"] == {"delivered": 12}
+    assert summary["open"] == 0
+    assert summary["duplicate_completions"] == 0
+
+
+# ---------------------------------------------------------------------------
+# 0-recompile pin with every plane armed; consumer surfaces
+# ---------------------------------------------------------------------------
+
+def test_warmed_zero_compiles_with_lineage_quality_telemetry_runtime():
+    metrics.install_jax_hooks()
+    reg = metrics.MetricsRegistry()
+    models, hists = _tenant_fixtures(3, seed0=101)
+    sched = FleetScheduler(registry=reg, auto_pump=False)
+    for i, (m, h) in enumerate(zip(models, hists)):
+        sched.attach(ss.ServingSession.start(
+            m, h, label=f"t{i}", registry=reg,
+            quality=ss.QualityPolicy()))
+    rt = FleetRuntime(sched, registry=reg)
+    srv = telemetry.start(port=0)
+    try:
+        assert lineage.armed()
+        rt.warmup()
+        rng = np.random.default_rng(19)
+        ticks = rng.normal(size=(3, S, 4))
+        with rt:
+            before = metrics.jax_stats()["jit_compiles"]
+            for t in range(4):
+                for i in range(3):
+                    rt.submit(f"t{i}", ticks[i, :, t], block=True,
+                              timeout=30.0)
+            assert rt.quiesce(timeout=30.0)
+            assert metrics.jax_stats()["jit_compiles"] - before == 0, \
+                "compiles leaked into the lineage-armed warmed tick path"
+            # ...and the plane actually measured the traffic it rode
+            summary = lineage.lineage_summary()
+            assert summary["outcomes"].get("delivered", 0) >= 12
+            snap = telemetry.snapshot_doc()
+            assert snap["lineage"]["armed"] is True
+            assert snap["lineage"]["outcomes"]["delivered"] >= 12
+            json.dumps(snap["lineage"])         # scrape-able, JSON-safe
+    finally:
+        telemetry.stop()
+
+
+def test_sts_top_e2e_panel_renders_and_degrades():
+    from tools.sts_top import _e2e_lines, render_snapshot
+
+    sched, models, hists, _ = _build_fleet(2, seed0=111)
+    rng = np.random.default_rng(23)
+    for t in range(3):
+        for i in range(2):
+            sched.submit(f"t{i}", rng.normal(size=S))
+        sched.pump(force=True)
+    snap = {"pid": 1, "time_unix": time.time(),
+            "lineage": telemetry.json_safe(lineage.lineage_summary())}
+    frame = render_snapshot(json.loads(json.dumps(snap)))
+    assert "E2E (tick lineage)" in frame
+    assert "t0" in frame and "t1" in frame
+    assert "slowest:" in frame
+    assert "stages:" in frame
+    # version tolerance: pre-lineage exporters, scrape errors, disarmed
+    assert _e2e_lines(None) == ["  (exporter predates the lineage plane)"]
+    assert "scrape error" in _e2e_lines({"error": "boom"})[0]
+    assert "disarmed" in _e2e_lines({"armed": False})[0]
+    old = render_snapshot({"pid": 1})
+    assert "predates the lineage plane" in old
+
+
+def test_trace_export_interleaves_lineage_lanes():
+    sched, models, hists, _ = _build_fleet(1, seed0=121)
+    rng = np.random.default_rng(29)
+    for t in range(2):
+        sched.submit("t0", rng.normal(size=S))
+        sched.pump(force=True)
+    events = lineage.trace_events()
+    assert {e["name"] for e in events} == \
+        {f"lineage.{s}" for s in DISPATCH_STAGES}
+    for e in events:
+        assert isinstance(e["tid"], int) and e["tid"] >= (1 << 20)
+        assert e["args"]["outcome"] == "delivered"
+    doc = tracing.to_chrome_trace()
+    names = {e.get("name") for e in doc["traceEvents"]}
+    assert "lineage.dispatch" in names
+    rows = [e for e in doc["traceEvents"]
+            if e.get("ph") == "M" and e.get("name") == "thread_name"
+            and str(e["args"]["name"]).startswith("lineage-")]
+    assert rows, "lineage lanes must be named thread rows"
+    # the merge is export-only: attribution still reads the span ring
+    report = tracing.self_time_report()
+    assert not any(row["name"].startswith("lineage.")
+                   for row in report["spans"])
+    # trace_events(limit=) bounds the scrape payload from the newest end
+    assert len(lineage.trace_events(limit=1)) == len(DISPATCH_STAGES)
+
+
+def test_flightrec_bundle_embeds_and_validates_lineage(tmp_path):
+    sched, models, hists, reg = _build_fleet(1, seed0=131)
+    sched.submit("t0", np.zeros(S))
+    sched.pump(force=True)
+    flightrec.configure(str(tmp_path))
+    try:
+        path = flightrec.record_incident("lineage_probe", registry=reg)
+        assert path is not None
+        bundle = flightrec.load_incident(path)
+    finally:
+        flightrec.configure(None)
+    assert flightrec.validate_bundle(bundle) == []
+    lin = bundle["lineage"]
+    assert lin["records"] and lin["outcomes"]["delivered"] == 1
+    assert lin["records"][-1]["tenant"] == "t0"
+    # optional key: absent stays valid (pre-lineage bundles), malformed
+    # is flagged
+    pruned = {k: v for k, v in bundle.items() if k != "lineage"}
+    assert flightrec.validate_bundle(pruned) == []
+    assert any("lineage" in p for p in flightrec.validate_bundle(
+        dict(bundle, lineage="nope")))
+
+
+def test_bench_gate_extracts_fleet_e2e_p95():
+    from tools.bench_gate import METRICS, extract_metrics
+
+    assert ("fleet_e2e_p95_ms", "lower_better", 25.0) in METRICS
+    h = {"value": 1.0, "fleet_demo": {"fleet_ticks_per_s": 5000.0,
+                                      "fleet_e2e_p95_ms": 3.25}}
+    assert extract_metrics(h)["fleet_e2e_p95_ms"] == 3.25
+    # tolerated-absent, disarmed-null, and pre-lineage rounds fabricate
+    # nothing — the serving_update_p50 seeding protocol
+    h = {"value": 1.0, "fleet_demo": {"fleet_ticks_per_s": 5000.0,
+                                      "fleet_e2e_p95_ms": None}}
+    assert "fleet_e2e_p95_ms" not in extract_metrics(h)
+    assert "fleet_e2e_p95_ms" not in extract_metrics(
+        {"value": 1.0, "fleet_demo": {"fleet_ticks_per_s": 5000.0}})
+    assert "fleet_e2e_p95_ms" not in extract_metrics({"value": 1.0})

@@ -12,7 +12,7 @@ import sys
 import numpy as np
 import pytest
 
-from spark_timeseries_tpu.utils import costs, metrics, tracing
+from spark_timeseries_tpu.utils import costs, lineage, metrics, tracing
 from spark_timeseries_tpu.utils.metrics import TraceBuffer
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -31,9 +31,14 @@ bench_gate = _load_bench_gate()
 
 @pytest.fixture(autouse=True)
 def _clean_trace():
+    # to_chrome_trace() merges TWO global rings: the span trace buffer and
+    # the tick-lineage ring (records left behind by other suites' fleet
+    # traffic would add lineage.* lanes and break exact-count assertions).
     metrics.clear_trace()
+    lineage.reset()
     yield
     metrics.clear_trace()
+    lineage.reset()
 
 
 # ---------------------------------------------------------------------------

@@ -42,6 +42,7 @@ automated check (``make gate``):
   serving_live_smape            headline ``serving_demo.quality.live_smape``  higher
   drift_false_alarms            headline ``serving_demo.quality.drift_alarms`` higher
   engine_host_overhead_frac     headline ``engine_attribution.host_overhead_frac`` higher
+  fleet_e2e_p95_ms              headline ``fleet_demo.fleet_e2e_p95_ms``      higher
   ============================  ============================================  ======
 
   (``engine_cache_misses`` is the streaming engine's executable-cache
@@ -113,6 +114,18 @@ automated check (``make gate``):
   counters materialize on first increment); tolerated-absent in
   pre-runtime rounds.  ``fleet_ticks_per_s`` doubling as the guard
   that arming the async runtime did not tax throughput.
+
+  ``fleet_e2e_p95_ms`` is the tick-lineage plane's end-to-end gate
+  (ISSUE 18): the fleet demo's pumped run reports the p95
+  submit→delivery wall time per tick from the lineage ring — the full
+  async path including admission backpressure, per-tenant queueing,
+  coalesce gather, the jitted dispatch, scatter and delivery.  A >25%
+  jump over the trailing median means tail latency regressed somewhere
+  ``fleet_ticks_per_s`` (an aggregate rate) can't see — one slow stage
+  is invisible to throughput until it dominates.  Tolerated-absent in
+  rounds that predate the lineage plane (and in runs with the plane
+  disarmed, which emit nulls) — same protocol as ``serving_update_p50``,
+  no fabricated zeros.
 
   ``backtest_champion_smape`` / ``backtest_champion_mase`` are the
   repo's first ACCURACY gates (ISSUE 13): the bench's ``backtest_demo``
@@ -188,6 +201,7 @@ METRICS = [
     ("fleet_shed_lanes", "lower_better", 50.0),
     ("fleet_pump_restarts", "lower_better", 50.0),
     ("fleet_checkpoint_failures", "lower_better", 50.0),
+    ("fleet_e2e_p95_ms", "lower_better", 25.0),
     ("backtest_champion_smape", "lower_better", 25.0),
     ("backtest_champion_mase", "lower_better", 25.0),
     ("serving_live_smape", "lower_better", 25.0),
@@ -289,6 +303,12 @@ def extract_metrics(headline: Optional[dict]) -> Dict[str, float]:
     if isinstance(fd, dict):
         if isinstance(fd.get("fleet_ticks_per_s"), (int, float)):
             out["fleet_ticks_per_s"] = float(fd["fleet_ticks_per_s"])
+        # lineage plane (ISSUE 18): end-to-end submit→delivery p95 from
+        # the tick-lineage ring.  Present-and-numeric only — a disarmed
+        # plane emits null and pre-lineage rounds omit the key, and
+        # neither contributes a baseline sample (no fabricated zeros).
+        if isinstance(fd.get("fleet_e2e_p95_ms"), (int, float)):
+            out["fleet_e2e_p95_ms"] = float(fd["fleet_e2e_p95_ms"])
         if "error" not in fd:
             v = fd.get("shed_lanes", 0)
             if isinstance(v, (int, float)):

@@ -21,6 +21,10 @@ exporter's ``/snapshot.json`` (``utils.telemetry``; armed with
 - **attribution**: the performance attribution plane (docs/design.md
   §6g) — top span self-times with per-subsystem rollups, and the
   streaming engine's host-overhead fraction / device-idle bubble;
+- **e2e**: the tick lineage plane (docs/design.md §6h) — per-tenant
+  end-to-end p50/p95 with worst-stage attribution, pooled stage
+  shares, exactly-once counters, and the slowest tick's full stage
+  timeline;
 - **incidents**: the flight recorder's newest bundles (kind, age,
   size) so a crash's forensics are one glance away.
 
@@ -299,6 +303,79 @@ def _attribution_lines(att: Any) -> List[str]:
     return lines
 
 
+def _e2e_lines(lin: Any) -> List[str]:
+    """The E2E panel body: per-tenant end-to-end latency percentiles
+    with worst-stage attribution, the pooled stage shares, exactly-once
+    counters, and the slowest exemplar's full stage timeline.  Version-
+    tolerant like ATTRIBUTION — an older exporter (no ``lineage``
+    section) or a scrape-isolated error renders as a marked absence."""
+    if not isinstance(lin, dict):
+        return ["  (exporter predates the lineage plane)"]
+    if "error" in lin and "tenants" not in lin:
+        return [f"  (scrape error: {str(lin['error'])[:60]})"]
+    if lin.get("armed") is False:
+        return ["  (lineage plane disarmed: STS_LINEAGE=0)"]
+    lines: List[str] = []
+    e2e = lin.get("e2e") or {}
+    outcomes = lin.get("outcomes") or {}
+    ring = lin.get("ring") or {}
+    lines.append(
+        f"  e2e p50 {_fmt_num(e2e.get('p50_ms'), '{:.3f}')}ms  "
+        f"p95 {_fmt_num(e2e.get('p95_ms'), '{:.3f}')}ms  "
+        f"delivered {outcomes.get('delivered', 0)}  "
+        f"open {lin.get('open', '-')}  "
+        f"dups {lin.get('duplicate_completions', 0)}  "
+        f"ring {ring.get('len', '-')}/{ring.get('capacity', '-')}"
+        f" (dropped {ring.get('dropped', 0)})")
+    shares = lin.get("stage_totals_ms")
+    if isinstance(shares, dict) and shares:
+        total = sum(v for v in shares.values()
+                    if isinstance(v, (int, float))) or 1.0
+        lines.append("  stages: " + "  ".join(
+            f"{k} {v / total:.0%}" for k, v in sorted(
+                shares.items(), key=lambda kv: -kv[1])
+            if isinstance(v, (int, float))))
+    tenants = lin.get("tenants")
+    rows = []
+    if isinstance(tenants, dict):
+        for label, td in sorted(tenants.items()):
+            if not isinstance(td, dict):
+                continue
+            share = td.get("worst_stage_share")
+            worst = td.get("worst_stage") or "-"
+            rows.append([
+                str(label),
+                _fmt_num(td.get("p50_ms"), "{:.3f}"),
+                _fmt_num(td.get("p95_ms"), "{:.3f}"),
+                str(td.get("delivered", "-")),
+                str(td.get("cache_serves", "-")),
+                f"{worst} {share:.0%}" if isinstance(
+                    share, (int, float)) else str(worst),
+            ])
+    if rows:
+        lines += _table(
+            ["TENANT", "P50ms", "P95ms", "TICKS", "CACHE", "WORST-STAGE"],
+            rows)
+    else:
+        lines.append("  (no delivered ticks yet)")
+    exemplars = _dicts(lin.get("exemplars"))
+    if exemplars:
+        ex = exemplars[0]
+        stages = ex.get("stages")
+        timeline = "  ".join(
+            f"{k} {v:.2f}" for k, v in sorted(
+                stages.items(), key=lambda kv: -kv[1])
+            if isinstance(v, (int, float))) \
+            if isinstance(stages, dict) else "-"
+        det = ",".join(ex.get("detours") or []) or "-"
+        lines.append(
+            f"  slowest: #{ex.get('trace_id', '?')} "
+            f"{ex.get('tenant', '?')} via={ex.get('via', '?')} "
+            f"{_fmt_num(ex.get('e2e_ms'), '{:.3f}')}ms  "
+            f"[{timeline}]  detours: {det}")
+    return lines
+
+
 def render_snapshot(snap: Dict[str, Any], job_sort: str = "eta") -> str:
     """One full frame from a ``/snapshot.json`` payload (pure).
     ``job_sort`` orders the JOBS panel (a key of :data:`JOB_SORTS`;
@@ -386,6 +463,10 @@ def render_snapshot(snap: Dict[str, Any], job_sort: str = "eta") -> str:
 
     lines.append("ATTRIBUTION (span self-time)")
     lines += _attribution_lines(snap.get("attribution"))
+    lines.append("")
+
+    lines.append("E2E (tick lineage)")
+    lines += _e2e_lines(snap.get("lineage"))
     lines.append("")
 
     incidents = _dicts(snap.get("incidents"))
