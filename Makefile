@@ -18,7 +18,7 @@ STS_COMPILE_CACHE ?=
 	verify-backtest verify-quality verify-races verify-attribution \
 	verify-runtime verify-lineage gate \
 	bench-diff trace lint lint-baseline contracts verify-static \
-	jax-audit warmup
+	jax-audit fusion-audit warmup
 
 help:
 	@echo "Targets:"
@@ -26,7 +26,7 @@ help:
 	@echo "  warmup        precompile fit executables at bench shapes (WARMUP_FAMILIES/"
 	@echo "                WARMUP_SHAPES; set STS_COMPILE_CACHE=dir to persist across processes)"
 	@echo "  lint          sts-lint static analysis (tracer safety, dtype, recompiles,"
-	@echo "                lock discipline STS101-STS104)"
+	@echo "                lock discipline STS101-STS104, host-boundary STS201-STS205)"
 	@echo "  lint-baseline regenerate tools/sts_lint/baseline.json (the debt ledger)"
 	@echo "  contracts     jaxpr/HLO contract checks: ten fit families + the serving"
 	@echo "                update, long-combine, fleet pump, backtest metric kernel,"
@@ -36,7 +36,10 @@ help:
 	@echo "                materialize, fleet pump vs scrape, journal vs flightrec)"
 	@echo "  verify-static lint + contracts + verify-races (the full static-analysis gate)"
 	@echo "  jax-audit     inventory version-sensitive JAX API touchpoints (monitoring,"
-	@echo "                profiler, compilation cache, shard_map, pallas) pre-upgrade"
+	@echo "                profiler, compilation cache, shard_map, pallas, metrics"
+	@echo "                bridge callers) pre-upgrade"
+	@echo "  fusion-audit  host-boundary fusion report (FUSION_AUDIT.json): STS205 chains"
+	@echo "                ranked by span self-time + pipeline program/transfer contracts"
 	@echo "  verify-faults tier-1 sweep with STS_FAULT_INJECT=1 (retry/fallback paths forced),"
 	@echo "                plus the verify-durability subset and the serving suite under"
 	@echo "                the serving-tier fault modes (tick corruption, state poison)"
@@ -90,7 +93,9 @@ lint-baseline:
 # quality-armed update, longseries combine, fleet coalesced pump,
 # backtest metric kernel, and pinned-state-path programs — from
 # ShapeDtypeStructs and assert the no-f64 / no-host-callback /
-# stable-jaxpr contracts (48 checks).
+# stable-jaxpr contracts (48 checks), then the host-boundary pipeline
+# contracts (ISSUE 19): programs-per-stage vs the budget table and
+# device→host bytes per warmed chunk (0 unsanctioned).
 contracts:
 	JAX_PLATFORMS=cpu $(PY) -m spark_timeseries_tpu.utils.contracts
 
@@ -105,12 +110,25 @@ verify-races:
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly
 
+# the full static-analysis gate: all three lint tiers, the jaxpr/HLO +
+# host-boundary contract sweeps, the race harness, and the
+# boundary-marked test suite (transfer-byte pin, fusion-audit report)
 verify-static: lint contracts verify-races
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m boundary \
+		--continue-on-collection-errors -p no:cacheprovider \
+		-p no:xdist -p no:randomly
 
 # static inventory of version-sensitive JAX API touchpoints — ROADMAP
 # item 2 requires this audit before the JAX upgrade refactor lands.
 jax-audit:
 	$(PY) -m tools.jax_audit spark_timeseries_tpu
+
+# the machine-readable evidence base for ROADMAP item 1 (whole-pipeline
+# fusion): STS205 chain inventory ranked by bench-round span self-time,
+# joined with the pipeline program/transfer contract measurements.
+fusion-audit:
+	JAX_PLATFORMS=cpu $(PY) -m tools.fusion_audit \
+		--json FUSION_AUDIT.json
 
 # precompile the default fit families at the bench chunk shapes through
 # the streaming engine's AOT executable cache; with STS_COMPILE_CACHE set

@@ -415,6 +415,23 @@ def main():
         else:
             block["contracts_checked"] = 0
             block["contracts_failed"] = 0
+        if fams:
+            # host-boundary tier (ISSUE 19): warmed-chunk program count
+            # + device→host bytes, gated by bench_gate from this block
+            try:
+                with metrics.span("bench.boundary_contracts"):
+                    pc = contracts.pipeline_contracts()
+                block["boundary"] = {
+                    "pipeline_programs": pc["pipeline_programs"],
+                    "programs_budget": pc["programs_budget"],
+                    "host_transfer_bytes_per_chunk":
+                        pc["host_transfer_bytes_per_chunk"],
+                    "unexpected_transfer_bytes":
+                        pc["unexpected_transfer_bytes"],
+                    "boundary_failed": pc["boundary_failed"],
+                }
+            except Exception as e:  # noqa: BLE001 — optional accounting
+                block["boundary_error"] = f"{type(e).__name__}: {e}"
         _static_cache.update(block)
         return _static_cache
 

@@ -313,8 +313,10 @@ def _make_jits():
     import jax
     table = {}
     for variant, fn in (("dense", _dense_fit), ("ragged", _ragged_fit)):
-        table[variant, False] = jax.jit(fn, static_argnums=(0, 1))
-        table[variant, True] = jax.jit(fn, static_argnums=(0, 1),
+        # once-per-process table build behind _jit_lock's memoization
+        # (_jit_for), not a per-dispatch loop
+        table[variant, False] = jax.jit(fn, static_argnums=(0, 1))  # sts: noqa[STS202]
+        table[variant, True] = jax.jit(fn, static_argnums=(0, 1),  # sts: noqa[STS202]
                                        donate_argnums=(2,))
     return table
 
@@ -328,6 +330,31 @@ def _jit_for(variant: str, donate: bool):
         if not _jit_table:
             _jit_table.update(_make_jits())
         return _jit_table[variant, donate]
+
+
+def expected_chunk_result_bytes(family: str, bucket: Tuple[int, int],
+                                dtype: Any = "float32",
+                                variant: str = "dense",
+                                **kwargs) -> int:
+    """Device→host bytes one warmed chunk's *sanctioned*
+    materialization moves: the chunk program's output leaves plus the
+    convergence scalar, from ``jax.eval_shape`` (shape-level only — no
+    compile, no execution).  ``pipeline_contracts()`` pins the
+    engine-counted ``engine.bytes_d2h`` per chunk against exactly this
+    number; any surplus is an unsanctioned crossing."""
+    import jax
+
+    statics = _STATICS_BUILDERS[family](**kwargs)
+    fn = _dense_fit if variant == "dense" else _ragged_fit
+    values = jax.ShapeDtypeStruct(tuple(bucket), np.dtype(dtype))
+    n_real = jax.ShapeDtypeStruct((), np.dtype(np.int32))
+    arrays, conv = jax.eval_shape(
+        lambda v, n: fn(family, statics, v, n), values, n_real)
+    total = sum(int(np.prod(l.shape, dtype=np.int64))
+                * np.dtype(l.dtype).itemsize
+                for l in jax.tree_util.tree_leaves(arrays))
+    return total + int(np.prod(conv.shape, dtype=np.int64)) \
+        * np.dtype(conv.dtype).itemsize
 
 
 # ---------------------------------------------------------------------------
@@ -1246,7 +1273,14 @@ class FitEngine:
 
             def work():
                 with _metrics.span("engine.collect"):
-                    return [np.asarray(a) for a in out[0]], int(out[1])
+                    arrays = [np.asarray(a) for a in out[0]]
+                    # the sanctioned chunk-result crossing: account every
+                    # device→host byte here so pipeline_contracts() can
+                    # pin "no transfers beyond result materialization"
+                    self._reg.inc("engine.bytes_d2h",
+                                  sum(int(a.nbytes) for a in arrays)
+                                  + int(getattr(out[1], "nbytes", 0)))
+                    return arrays, int(out[1])
 
             t0 = time.perf_counter()
             arrays, c = _with_deadline(work, "materialize", start, stop)

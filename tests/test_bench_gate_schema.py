@@ -1,0 +1,197 @@
+"""bench_gate ↔ bench schema-drift pins (ISSUE 19 satellite).
+
+The gate's METRICS table and ``extract_metrics`` map by hand onto the
+keys ``bench.py`` embeds in a headline record — across 25+ gates now.
+A renamed counter or moved block silently turns its gate into a
+permanent skip (``extract_metrics`` never fabricates, so the metric
+just vanishes from every baseline).  Two pins close that hole:
+
+1. a maximal synthetic headline must yield EVERY gated metric — so a
+   METRICS row without a live extraction path fails loudly;
+2. every *source* key ``extract_metrics`` reads (collected from its own
+   AST, not a second hand-written list) must appear somewhere in
+   ``bench.py`` or the package source — so renaming an emitter breaks
+   the build, not the baseline.
+
+Pure-AST + dict plumbing: no JAX import, no bench run.
+"""
+
+import ast
+import inspect
+import os
+
+from tools import bench_gate
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GATED = {name for name, _dir, _thr in bench_gate.METRICS}
+
+# One record exercising every extraction path extract_metrics has.
+# Keys mirror what bench.py emits (blocks per tier; counters inside
+# the metrics snapshot); values are arbitrary but type-correct.
+FULL_HEADLINE = {
+    "value": 1234.5,
+    "platform": "cpu",
+    "long_demo": {"obs_per_s": 9.9e5},
+    "fleet_demo": {
+        "fleet_ticks_per_s": 321.0,
+        "fleet_e2e_p95_ms": 12.5,
+        "shed_lanes": 0,
+        "pump_restarts": 0,
+        "checkpoint_failures": 0,
+    },
+    "backtest_demo": {"champion_smape": 3.1, "champion_mase": 0.9},
+    "serving_demo": {"quality": {"live_smape": 4.2, "drift_alarms": 0}},
+    "engine_attribution": {"host_overhead_frac": 0.07},
+    "metrics": {
+        "compile_s_total": 1.5,
+        "jit_compiles": 7,
+        "spans": {
+            "bench.fit_panel": {"count": 2, "p50_s": 0.8, "mean_s": 0.8},
+            "bench.serving_demo/serving.update": {
+                "count": 64, "p50_s": 0.002, "p95_s": 0.004},
+            "bench.serving_demo/serving.heal": {"count": 1,
+                                                "p50_s": 0.05},
+        },
+        "engine": {
+            "engine.cache_misses": 1,
+            "engine.chunk_failures": 0,
+            "engine.dead_chunks": 0,
+        },
+        "serving": {"serving.diverged": 0},
+        "fit_counters": {"resilience.auto_fallback_dead": 0},
+        "telemetry": {"incidents_written": 0},
+        "static_analysis": {
+            "findings": 0,
+            "contracts_checked": 42,
+            "contracts_failed": 0,
+            "boundary": {
+                "pipeline_programs": 2,
+                "programs_budget": 2,
+                "host_transfer_bytes_per_chunk": 1668,
+                "unexpected_transfer_bytes": 0,
+                "boundary_failed": 0,
+            },
+        },
+    },
+}
+
+
+def test_every_gate_has_a_live_extraction_path():
+    """METRICS rows and extract_metrics must cover each other exactly:
+    a gate the maximal record can't produce is a permanent skip, and an
+    extracted key without a METRICS row is an ungated measurement."""
+    got = bench_gate.extract_metrics(FULL_HEADLINE)
+    assert set(got) == GATED, (
+        f"never extracted: {sorted(GATED - set(got))}; "
+        f"extracted but not gated: {sorted(set(got) - GATED)}")
+
+
+def _source_keys():
+    """String keys extract_metrics READS, from its own AST: `.get(k)`
+    first args, `k (not) in block` probes, `_leaf_span(spans, k)`, and
+    the src half of the (src, dst) pair loops.  `out[...]` writes are
+    gate names, not source keys, and are excluded by construction."""
+    tree = ast.parse(inspect.getsource(bench_gate.extract_metrics))
+    keys = set()
+
+    def const_str(n):
+        return n.value if isinstance(n, ast.Constant) \
+            and isinstance(n.value, str) else None
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "get" \
+                    and node.args:
+                k = const_str(node.args[0])
+                if k:
+                    keys.add(k)
+            elif isinstance(f, ast.Name) and f.id == "_leaf_span" \
+                    and len(node.args) == 2:
+                k = const_str(node.args[1])
+                if k:
+                    keys.add(k)
+        elif isinstance(node, ast.Compare) \
+                and isinstance(node.ops[0], (ast.In, ast.NotIn)):
+            k = const_str(node.left)
+            if k:
+                keys.add(k)
+        elif isinstance(node, ast.For) \
+                and isinstance(node.iter, ast.Tuple):
+            for pair in node.iter.elts:
+                if isinstance(pair, ast.Tuple) and len(pair.elts) == 2:
+                    k = const_str(pair.elts[0])
+                    if k:
+                        keys.add(k)
+        elif isinstance(node, ast.Subscript) \
+                and not (isinstance(node.value, ast.Name)
+                         and node.value.id == "out"):
+            k = const_str(node.slice)
+            if k:
+                keys.add(k)
+    return keys
+
+
+def _emitter_text():
+    chunks = [open(os.path.join(REPO, "bench.py"),
+                   encoding="utf-8").read()]
+    for dirpath, _dirs, files in os.walk(
+            os.path.join(REPO, "spark_timeseries_tpu")):
+        for fn in files:
+            if fn.endswith(".py"):
+                chunks.append(open(os.path.join(dirpath, fn),
+                                   encoding="utf-8").read())
+    return "\n".join(chunks)
+
+
+def test_source_keys_exist_in_emitters():
+    """Every key the gate reads must occur verbatim in bench.py or the
+    package source — renaming an emitter (a counter, a span, a block)
+    now fails here instead of silently skipping the gate forever."""
+    keys = _source_keys()
+    # sanity: the collector must keep seeing the known hot mappings —
+    # an over-aggressive filter that returns near-nothing would pass
+    # the loop below vacuously
+    for probe in ("engine.cache_misses", "serving.update", "findings",
+                  "pipeline_programs", "host_transfer_bytes_per_chunk"):
+        assert probe in keys, f"collector lost {probe!r}"
+    text = _emitter_text()
+    missing = sorted(k for k in keys if k not in text)
+    assert not missing, (
+        f"gate reads keys no emitter mentions: {missing} — renamed "
+        f"counter/span/block? update bench_gate.extract_metrics")
+
+
+def test_crashed_subchecks_extract_nothing():
+    """lint_error / contracts_error / boundary_error mean the sub-check
+    CRASHED: its gates must vanish (no fabricated clean zeros)."""
+    h = {"value": 1.0, "metrics": {"static_analysis": {
+        "lint_error": "boom", "findings": 0,
+        "contracts_checked": 42, "contracts_error": "boom",
+        "contracts_failed": 0,
+        "boundary_error": "boom",
+        "boundary": {"pipeline_programs": 2,
+                     "host_transfer_bytes_per_chunk": 1668},
+    }}}
+    got = bench_gate.extract_metrics(h)
+    for name in ("lint_findings", "contracts_failed",
+                 "pipeline_programs", "host_transfer_bytes_per_chunk"):
+        assert name not in got, f"{name} fabricated from a crashed check"
+
+
+def test_boundary_block_absent_extracts_nothing():
+    h = {"value": 1.0,
+         "metrics": {"static_analysis": {"findings": 0,
+                                         "contracts_checked": 42,
+                                         "contracts_failed": 0}}}
+    got = bench_gate.extract_metrics(h)
+    assert "pipeline_programs" not in got
+    assert "host_transfer_bytes_per_chunk" not in got
+    assert got["lint_findings"] == 0.0 and got["contracts_failed"] == 0.0
+
+
+def test_boundary_block_gates_when_present():
+    got = bench_gate.extract_metrics(FULL_HEADLINE)
+    assert got["pipeline_programs"] == 2.0
+    assert got["host_transfer_bytes_per_chunk"] == 1668.0

@@ -1,0 +1,174 @@
+"""Level-2 host-boundary contracts + the fusion audit (ISSUE 19).
+
+The warmed chunk path's device↔host crossings, pinned: programs per
+stage against the budget table, and device→host bytes per warmed chunk
+exactly equal to the result materialization (0 unsanctioned bytes).
+Runs on CPU like the rest of the contract sweep — the boundary
+*structure* (program counts, byte accounting) is platform-independent.
+"""
+
+import numpy as np
+import pytest
+
+from spark_timeseries_tpu.engine import (FitEngine,
+                                         expected_chunk_result_bytes)
+from spark_timeseries_tpu.utils import metrics
+from spark_timeseries_tpu.utils.contracts import (PIPELINE_PROGRAM_BUDGET,
+                                                  pipeline_contracts)
+
+pytestmark = pytest.mark.boundary
+
+
+# ---------------------------------------------------------------------------
+# expected_chunk_result_bytes: the sanctioned-crossing oracle
+# ---------------------------------------------------------------------------
+
+def test_expected_bytes_scale_with_bucket_rows():
+    """Result payload is per-series leaves + one conv scalar, so bytes
+    are affine in the series dimension: equal row increments move equal
+    byte increments (dtype-agnostic — the conftest's x64 flip must not
+    matter here)."""
+    e128 = expected_chunk_result_bytes("ewma", (128, 64))
+    e256 = expected_chunk_result_bytes("ewma", (256, 64))
+    e512 = expected_chunk_result_bytes("ewma", (512, 64))
+    assert 0 < e128 < e256 < e512
+    assert e512 - e256 == 2 * (e256 - e128)
+
+
+def test_expected_bytes_match_live_engine_counter():
+    """The pin itself: a warmed stream's measured engine.bytes_d2h is
+    EXACTLY n_chunks * expected — the eval_shape oracle and the
+    sanctioned collect site account the same crossing."""
+    reg = metrics.MetricsRegistry()
+    eng = FitEngine(registry=reg)
+    n_series, n_obs, chunk = 64, 32, 32
+    values = np.sin(np.arange(n_series * n_obs, dtype=np.float32)
+                    ).reshape(n_series, n_obs) + 2.0
+
+    def bytes_d2h():
+        return reg.snapshot()["counters"].get("engine.bytes_d2h", 0)
+
+    list(eng.stream_fit(values, "ewma", chunk_size=chunk))   # cold
+    b0 = bytes_d2h()
+    list(eng.stream_fit(values, "ewma", chunk_size=chunk))   # warm
+    measured = bytes_d2h() - b0
+    expected = expected_chunk_result_bytes("ewma", (chunk, n_obs),
+                                           dtype=values.dtype)
+    n_chunks = n_series // chunk
+    assert measured == n_chunks * expected, (
+        f"warmed stream moved {measured} B device→host, oracle says "
+        f"{n_chunks} chunks x {expected} B — an unsanctioned crossing "
+        f"(or a result-schema change; update the oracle deliberately)")
+
+
+# ---------------------------------------------------------------------------
+# pipeline_contracts: programs-per-stage + bytes-per-warmed-chunk
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def boundary():
+    return pipeline_contracts()
+
+
+def test_pipeline_program_budget_held(boundary):
+    assert boundary["fit_programs"] <= PIPELINE_PROGRAM_BUDGET["fit"]
+    assert boundary["pipeline_programs"] <= sum(
+        PIPELINE_PROGRAM_BUDGET.values())
+    assert boundary["programs_budget"] == PIPELINE_PROGRAM_BUDGET
+
+
+def test_pipeline_warm_path_compiles_nothing(boundary):
+    assert boundary["fit_warm_compiles"] in (0, None) \
+        or not boundary["jax_hooks"]
+    assert boundary["serving_warm_compiles"] in (0, None) \
+        or not boundary["jax_hooks"]
+
+
+def test_pipeline_transfer_bytes_pinned(boundary):
+    """The warmed-chunk transfer-bytes budget (ISSUE 19 acceptance):
+    bytes per warmed chunk == the expected result materialization, with
+    ZERO bytes beyond it."""
+    assert boundary["unexpected_transfer_bytes"] == 0
+    assert boundary["host_transfer_bytes_per_chunk"] \
+        == boundary["expected_result_bytes"] > 0
+
+
+def test_pipeline_contracts_all_pass(boundary):
+    failed = [r for r in boundary["results"] if not r["ok"]]
+    assert boundary["boundary_failed"] == 0 and boundary["ok"], \
+        [f"{r['contract']}/{r['family']}: {r['detail']}" for r in failed]
+
+
+def test_pipeline_contracts_rejects_ragged_panel():
+    """A ragged tail bucket would add a second legitimate executable —
+    the budget table is defined on the exact-multiple panel, so the
+    sweep refuses to measure anything else."""
+    with pytest.raises(ValueError):
+        pipeline_contracts(n_series=100, chunk=64)
+
+
+# ---------------------------------------------------------------------------
+# fusion_audit: span self-time attribution + chain ranking
+# ---------------------------------------------------------------------------
+
+def test_span_self_times_subtracts_children():
+    from tools.fusion_audit import span_self_times
+    spans = {
+        "fleet.tick": {"total_s": 10.0},
+        "fleet.tick/fleet.coalesced_step": {"total_s": 7.0},
+        "fleet.tick/fleet.coalesced_step/engine.collect":
+            {"total_s": 2.0},
+    }
+    st = span_self_times(spans)
+    assert st["fleet.tick"] == pytest.approx(3.0)
+    assert st["fleet.coalesced_step"] == pytest.approx(5.0)
+    assert st["engine.collect"] == pytest.approx(2.0)
+
+
+def test_span_self_times_aggregates_across_scopes():
+    from tools.fusion_audit import span_self_times
+    spans = {
+        "a/serving.update": {"total_s": 2.0},
+        "b/serving.update": {"total_s": 3.0},
+    }
+    assert span_self_times(spans)["serving.update"] == pytest.approx(5.0)
+
+
+def test_rank_chains_orders_by_span_self_time():
+    from tools.fusion_audit import rank_chains
+
+    class F:
+        def __init__(self, path, symbol, line, msg):
+            self.path, self.symbol = path, symbol
+            self.line, self.message = line, msg
+
+    findings = [
+        F("spark_timeseries_tpu/longseries/combine.py",
+          "combine_segments", 10,
+          "chain (2 dispatch, 1 host-materialize site(s))"),
+        F("spark_timeseries_tpu/statespace/fleet.py",
+          "FleetScheduler.warmup", 20,
+          "chain (4 dispatch, 3 host-materialize site(s))"),
+    ]
+    self_times = {"fleet.warmup": 4.0, "long.combine": 0.5}
+    chains = rank_chains(findings, self_times)
+    assert [c["symbol"] for c in chains] \
+        == ["FleetScheduler.warmup", "combine_segments"]
+    assert chains[0]["span_self_s"] == pytest.approx(4.0)
+    assert chains[0]["dispatch_sites"] == 4
+    assert chains[0]["materialize_sites"] == 3
+
+
+def test_fusion_audit_report_on_head():
+    """ISSUE 19 acceptance: the audit's STS205 chain inventory is
+    non-empty on current HEAD, and the report is gate-consistent
+    (0 gating findings on the shipped tree)."""
+    from tools.fusion_audit import run_audit
+    report = run_audit(with_contracts=False)
+    assert report["version"] == 1 and report["tool"] == "fusion-audit"
+    assert report["lint"]["gating_findings"] == []
+    assert report["chains"], "STS205 inventory empty on HEAD"
+    for c in report["chains"]:
+        assert {"module", "symbol", "line", "dispatch_sites",
+                "materialize_sites", "span_self_s", "spans"} <= set(c)
+    assert report["ok"]

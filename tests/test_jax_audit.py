@@ -1,0 +1,155 @@
+"""jax-audit non-staleness: the inventory vs an independent grep.
+
+The audit (``tools/jax_audit.py``) resolves touchpoints through the
+sts-lint import table — precise, but a category matcher that falls
+behind the tree turns the item-2 upgrade report into stale comfort.
+These tests re-derive the expected touchpoint *file sets* with a much
+dumber oracle (a flat AST walk per file, no import-table resolution)
+and diff both directions: everything the grep sees must be in the
+audit, and every audited site must be grep-visible.
+
+Pure-AST: no JAX import needed.
+"""
+
+import ast
+import os
+
+import pytest
+
+from tools.jax_audit import _BRIDGE_SYMBOLS, CATEGORIES, audit_paths
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "spark_timeseries_tpu")
+
+# direct-jax categories and the dotted-name prefixes that imply them —
+# the oracle's (deliberately coarse) mirror of tools.jax_audit._category
+DIRECT_PREFIXES = {
+    "monitoring": ("jax.monitoring",),
+    "profiler": ("jax.profiler",),
+    "shard_map": ("jax.shard_map", "jax.experimental.shard_map"),
+    "pallas": ("jax.experimental.pallas",),
+}
+
+
+def _dotted(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _walk_package():
+    for dirpath, _dirs, files in os.walk(PKG):
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, REPO).replace(os.sep, "/")
+                tree = ast.parse(open(path, encoding="utf-8").read(),
+                                 filename=path)
+                yield rel, tree
+
+
+def _grep_expected():
+    """{category: set of relpaths} from the flat AST oracle."""
+    expected = {c: set() for c in CATEGORIES}
+    for rel, tree in _walk_package():
+        names = set()
+        attr_tails = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                names.update(a.name for a in node.names)
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                names.update(f"{base}.{a.name}" if base else a.name
+                             for a in node.names)
+            elif isinstance(node, ast.Attribute):
+                d = _dotted(node)
+                if d:
+                    names.add(d)
+                    attr_tails.add(node.attr)
+            elif isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and node.value.startswith("jax_") \
+                    and "cache" in node.value:
+                expected["compilation_cache"].add(rel)
+        for cat, prefixes in DIRECT_PREFIXES.items():
+            if any(n == p or n.startswith(p + ".")
+                   for n in names for p in prefixes):
+                expected[cat].add(rel)
+        if any(n.startswith("jax.experimental")
+               or n == "jax.experimental" for n in names):
+            # pallas/shard_map claim their files too; experimental is
+            # the catch-all so only require membership *somewhere*
+            if rel not in expected["pallas"] \
+                    and rel not in expected["shard_map"]:
+                expected["experimental"].add(rel)
+        if "compilation_cache" in " ".join(names):
+            expected["compilation_cache"].add(rel)
+        # bridge oracle: an aliased metrics module attribute call --
+        # every caller imports `metrics as _metrics` (or `metrics`),
+        # so `<alias>.{span,...}` is exactly an attribute whose tail is
+        # a bridge symbol on a name containing "metrics"
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) \
+                    and node.attr in _BRIDGE_SYMBOLS:
+                base = _dotted(node.value)
+                if base and "metrics" in base.split(".")[-1]:
+                    expected["metrics_bridge"].add(rel)
+    return expected
+
+
+@pytest.fixture(scope="module")
+def audit():
+    return audit_paths([PKG], root=REPO)
+
+
+@pytest.fixture(scope="module")
+def grep_expected():
+    return _grep_expected()
+
+
+def _audited_files(audit, category):
+    return {t["path"] for t in audit["touchpoints"]
+            if t["category"] == category}
+
+
+@pytest.mark.parametrize("category", sorted(CATEGORIES))
+def test_audit_not_stale_vs_grep(audit, grep_expected, category):
+    """Both directions: grep ⊆ audit and audit ⊆ grep, per category.
+
+    A new file touching jax.monitoring/profiler/... that the audit
+    misses fails the first leg; a matcher drifting to claim sites the
+    tree no longer has fails the second.
+    """
+    got = _audited_files(audit, category)
+    want = grep_expected[category]
+    missing = want - got
+    stale = got - want
+    assert not missing, (
+        f"{category}: tree has touchpoints the audit misses: "
+        f"{sorted(missing)}")
+    assert not stale, (
+        f"{category}: audit claims files the grep cannot see: "
+        f"{sorted(stale)}")
+
+
+def test_bridge_covers_fleet_runtime_planes(audit):
+    """PRs 15-18 refresh pin: the fleet/runtime/attribution planes'
+    bridge call sites are inventoried (the upgrade blast radius is the
+    bridge callers, not just the two modules importing jax directly)."""
+    bridged = _audited_files(audit, "metrics_bridge")
+    for rel in ("spark_timeseries_tpu/statespace/fleet.py",
+                "spark_timeseries_tpu/statespace/runtime.py",
+                "spark_timeseries_tpu/engine.py"):
+        assert rel in bridged, f"{rel} lost its metrics_bridge coverage"
+
+
+def test_counts_match_touchpoints(audit):
+    for cat in CATEGORIES:
+        assert audit["counts"][cat] == len(
+            [t for t in audit["touchpoints"] if t["category"] == cat])
+    assert not audit["parse_errors"]

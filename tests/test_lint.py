@@ -17,7 +17,8 @@ import pytest
 
 from tools.sts_lint import lint_paths, load_baseline, write_baseline
 from tools.sts_lint.__main__ import main as lint_main
-from tools.sts_lint.rules import (CONCURRENCY_RULES, RULES,
+from tools.sts_lint.rules import (CONCURRENCY_RULES, EXAMPLES,
+                                  HOST_BOUNDARY_RULES, RULES,
                                   TRACER_SAFETY_RULES)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -940,3 +941,255 @@ def test_real_tree_traced_model_sanity():
     assert "fn" in transformers.get("minimize_bfgs", set())
     assert "residual_fn" in transformers.get("minimize_least_squares",
                                              set())
+
+
+# ---------------------------------------------------------------------------
+# STS201–205: the host-boundary tier (ISSUE 19)
+# ---------------------------------------------------------------------------
+#
+# Hot-path scoping is part of the contract, so these fixtures write to
+# hot-path relpaths ("engine.py", "statespace/serving.py") instead of
+# the ops/ path the other tiers seed.
+
+SEEDED_BOUNDARY = {
+    # unsanctioned float() of a compiled-program output
+    "STS201": HEADER + (
+        "step = jax.jit(lambda x: x * 2)\n"
+        "def drive(x):\n"
+        "    y = step(x)\n"
+        "    return float(y)\n"),
+    # jit construction inside the loop body
+    "STS202": HEADER + (
+        "def sweep(xs):\n"
+        "    outs = []\n"
+        "    for x in xs:\n"
+        "        f = jax.jit(lambda v: v + 1)\n"
+        "        outs.append(f(x))\n"
+        "    return outs\n"),
+    # the pad-slice pattern: per-iteration device-output slice
+    "STS203": HEADER + (
+        "step = jax.jit(lambda x: x)\n"
+        "def gather(xs):\n"
+        "    out = step(xs)\n"
+        "    res = []\n"
+        "    for i in range(4):\n"
+        "        res.append(np.asarray(out[i * 8:(i + 1) * 8]))\n"
+        "    return res\n"),
+    # read of a donated buffer after dispatch
+    "STS204": HEADER + (
+        "upd = jax.jit(lambda s, x: s + x, donate_argnums=(0,))\n"
+        "def tick(state, x):\n"
+        "    out = upd(state, x)\n"
+        "    return out, state.sum()\n"),
+    # dispatch → host transform → dispatch (the fusion inventory); the
+    # unsanctioned np.asarray in the middle is itself an STS201, which
+    # is what makes this seeded tree exit nonzero (STS205 alone is
+    # advice and never gates)
+    "STS205": HEADER + (
+        "f1 = jax.jit(lambda x: x + 1)\n"
+        "f2 = jax.jit(lambda x: x * 2)\n"
+        "def chain(x):\n"
+        "    a = f1(x)\n"
+        "    b = np.asarray(a) * 2\n"
+        "    return f2(jnp.asarray(b))\n"),
+}
+
+
+@pytest.mark.parametrize("code", sorted(SEEDED_BOUNDARY))
+def test_seeded_boundary_violation_fails_lint(tmp_path, code):
+    result, _ = run_fixture(tmp_path,
+                            {"engine.py": SEEDED_BOUNDARY[code]})
+    found = codes(result) + sorted({f.code for f in result.advice})
+    assert code in found, \
+        f"rule {code} missed its seeded violation; found {found}"
+    assert result.exit_code == 1
+
+
+def test_boundary_rules_scope_to_hot_path(tmp_path):
+    """The same violations OFF the hot path (an ops/ module) are out of
+    the STS200 tier's domain — host orchestration there is someone
+    else's business."""
+    for code, src in SEEDED_BOUNDARY.items():
+        result, _ = run_fixture(tmp_path, {"ops/host_tools.py": src},
+                                select=list(HOST_BOUNDARY_RULES))
+        assert codes(result) == [], \
+            f"{code} fired off the hot path: {codes(result)}"
+
+
+def test_sts205_is_advice_severity(tmp_path):
+    """STS205 never gates and never baselines: a chain inside a
+    sanctioned site lints green, but the inventory still lists it."""
+    src = HEADER + (
+        "f1 = jax.jit(lambda x: x + 1)\n"
+        "f2 = jax.jit(lambda x: x * 2)\n"
+        "class FitEngine:\n"
+        "    def stream_fit(self, x):\n"
+        "        a = f1(x)\n"
+        "        b = np.asarray(a) * 2\n"
+        "        return f2(jnp.asarray(b))\n")
+    result, sources = run_fixture(tmp_path, {"engine.py": src})
+    assert result.exit_code == 0
+    assert codes(result) == []
+    assert {f.code for f in result.advice} == {"STS205"}
+    assert result.summary()["advice"] == 1
+    # advice must not be written into the debt ledger
+    bl_path = str(tmp_path / "bl.json")
+    write_baseline(bl_path, result, sources)
+    assert load_baseline(bl_path) == {}
+
+
+def test_sanctioned_materialize_sites_are_clean(tmp_path):
+    """FP boundary: the places results are SUPPOSED to land on the host
+    (engine chunk collection, serving delivery) — including host-side
+    slicing of an already-materialized array outside a loop."""
+    src = HEADER + (
+        "step = jax.jit(lambda x: x)\n"
+        "class FitEngine:\n"
+        "    def stream_fit(self, xs, n):\n"
+        "        out = step(xs)\n"
+        "        host = np.asarray(out)\n"
+        "        return host[:n]\n")
+    result, _ = run_fixture(tmp_path, {"engine.py": src},
+                            select=["STS201", "STS202", "STS203",
+                                    "STS204"])
+    assert codes(result) == []
+
+
+def test_device_slice_outside_loop_not_sts203(tmp_path):
+    """FP boundary: a ONE-TIME device slice outside any loop is the
+    pad-strip idiom, not the per-iteration pad-slice regression —
+    STS203 stays quiet (STS201 still governs where it lands)."""
+    src = HEADER + (
+        "step = jax.jit(lambda x: x)\n"
+        "def deliver(xs, n):\n"
+        "    out = step(xs)\n"
+        "    return np.asarray(out[:n])\n")
+    result, _ = run_fixture(tmp_path, {"engine.py": src},
+                            select=["STS203"])
+    assert codes(result) == []
+
+
+def test_tuple_indexing_not_sts203(tmp_path):
+    """FP boundary: integer/tuple indexing of a compiled result
+    (``out[0]``) is structure access, not the pad-slice pattern."""
+    src = HEADER + (
+        "step = jax.jit(lambda x: (x, x.sum()))\n"
+        "def unpack(xs):\n"
+        "    res = []\n"
+        "    for x in xs:\n"
+        "        out = step(x)\n"
+        "        res.append(np.asarray(out[0]))\n"
+        "    return res\n")
+    result, _ = run_fixture(tmp_path, {"engine.py": src},
+                            select=["STS203"])
+    assert codes(result) == []
+
+
+def test_block_until_ready_in_bench_timing_clean(tmp_path):
+    """FP boundary: `.block_until_ready()` in timing/bench code off the
+    hot path is the CORRECT idiom (async dispatch would otherwise lie
+    to the clock) — no STS201."""
+    src = HEADER + (
+        "fit = jax.jit(lambda x: x * 2)\n"
+        "def time_fit(x):\n"
+        "    t0 = time.perf_counter()\n"
+        "    fit(x).block_until_ready()\n"
+        "    return time.perf_counter() - t0\n")
+    result, _ = run_fixture(tmp_path, {"benchmarks/timing.py": src},
+                            select=list(HOST_BOUNDARY_RULES))
+    assert codes(result) == []
+
+
+def test_host_loop_over_host_values_clean(tmp_path):
+    """FP boundary: loops over plain host arrays in a hot-path module
+    carry no device taint — nothing to flag."""
+    src = HEADER + (
+        "def plan(groups):\n"
+        "    total = 0\n"
+        "    for g in groups:\n"
+        "        total += int(np.asarray(g).sum())\n"
+        "    return total\n")
+    result, _ = run_fixture(tmp_path, {"statespace/serving.py": src},
+                            select=list(HOST_BOUNDARY_RULES))
+    assert codes(result) == []
+
+
+def test_boundary_noqa_suppression(tmp_path):
+    src = HEADER + (
+        "step = jax.jit(lambda x: x * 2)\n"
+        "def drive(x):\n"
+        "    y = step(x)\n"
+        "    return float(y)  # sts: noqa[STS201] — proven cold path\n")
+    result, _ = run_fixture(tmp_path, {"engine.py": src},
+                            select=["STS201"])
+    assert codes(result) == []
+    assert len(result.suppressed) == 1
+
+
+def test_shipped_tree_boundary_tier_clean_and_inventory_nonempty():
+    """ISSUE 19 acceptance: 0 gating STS200 findings on the shipped
+    tree (the fleet per-tenant slice regression is FIXED, not
+    baselined) and a NON-EMPTY STS205 inventory (the fusion evidence
+    base for ROADMAP item 1)."""
+    from tools.sts_lint import DEFAULT_BASELINE
+    baseline = load_baseline(DEFAULT_BASELINE)
+    for fp in baseline:
+        assert not fp.startswith(tuple(HOST_BOUNDARY_RULES)), \
+            f"host-boundary finding in baseline: {fp}"
+    result, _ = lint_paths([os.path.join(REPO, "spark_timeseries_tpu")],
+                           root=REPO, baseline=baseline,
+                           select=list(HOST_BOUNDARY_RULES))
+    assert result.parse_errors == []
+    assert result.new == [], [f.render() for f in result.new]
+    inventory = {(f.path, f.symbol) for f in result.advice}
+    assert inventory, "STS205 fusion inventory is empty on HEAD"
+
+
+def test_fleet_dispatch_slice_regression_pinned():
+    """The real finding this PR fixed: per-tenant device-output slicing
+    inside _dispatch_group/warmup loops.  Scope the sweep to fleet.py
+    so a reintroduction fails here by name."""
+    path = os.path.join(REPO, "spark_timeseries_tpu", "statespace",
+                        "fleet.py")
+    result, _ = lint_paths([path], root=REPO, baseline={},
+                           select=["STS201", "STS203"])
+    assert result.new == [], [f.render() for f in result.new]
+
+
+# ---------------------------------------------------------------------------
+# --explain: the self-documenting catalogue (ISSUE 19 satellite)
+# ---------------------------------------------------------------------------
+
+def test_every_rule_has_an_example_pair():
+    assert set(EXAMPLES) == set(RULES)
+    for code, (bad, good) in EXAMPLES.items():
+        assert bad.strip() and good.strip(), f"{code} example empty"
+
+
+@pytest.mark.parametrize("code", ["STS001", "STS101", "STS203",
+                                  "STS205"])
+def test_cli_explain_all_tiers(code, capsys):
+    rc = lint_main(["--explain", code])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert code in out
+    assert RULES[code].name in out
+    assert "Violates:" in out and "Fixed:" in out
+    bad, good = EXAMPLES[code]
+    assert bad.splitlines()[0].strip() in out
+    assert good.splitlines()[0].strip() in out
+
+
+def test_cli_explain_reports_severity(capsys):
+    assert lint_main(["--explain", "sts205"]) == 0   # case-insensitive
+    out = capsys.readouterr().out
+    assert "[advice]" in out
+    assert lint_main(["--explain", "STS203"]) == 0
+    assert "[error]" in capsys.readouterr().out
+
+
+def test_cli_explain_unknown_code_errors(capsys):
+    with pytest.raises(SystemExit) as e:
+        lint_main(["--explain", "STS999"])
+    assert e.value.code == 2
+    capsys.readouterr()

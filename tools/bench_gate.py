@@ -209,6 +209,8 @@ METRICS = [
     ("engine_host_overhead_frac", "lower_better", 25.0),
     ("lint_findings", "lower_better", 50.0),
     ("contracts_failed", "lower_better", 50.0),
+    ("pipeline_programs", "lower_better", 50.0),
+    ("host_transfer_bytes_per_chunk", "lower_better", 25.0),
 ]
 
 
@@ -456,6 +458,17 @@ def extract_metrics(headline: Optional[dict]) -> Dict[str, float]:
                 v = sa.get("contracts_failed", 0)
                 if isinstance(v, (int, float)):
                     out["contracts_failed"] = float(v)
+            # boundary sub-block (PR 19): absent or crashed → no keys,
+            # same absence-of-evidence rule as lint/contracts above.
+            b = sa.get("boundary")
+            if isinstance(b, dict) and "boundary_error" not in sa:
+                for src, dst in (
+                        ("pipeline_programs", "pipeline_programs"),
+                        ("host_transfer_bytes_per_chunk",
+                         "host_transfer_bytes_per_chunk")):
+                    v = b.get(src)
+                    if isinstance(v, (int, float)):
+                        out[dst] = float(v)
     return out
 
 

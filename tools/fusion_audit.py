@@ -1,0 +1,218 @@
+"""fusion-audit: the machine-readable evidence base for ROADMAP item 1.
+
+Whole-pipeline fusion (Flare, arXiv 1703.08219) only pays where the
+orchestration between compiled programs actually spends time.  This tool
+joins the three planes that know:
+
+- **lint** (level 1): the STS200 host-boundary tier's findings, in
+  particular the STS205 advice inventory — every
+  compiled-call → host transform → compiled-call chain in the hot-path
+  modules (``tools/sts_lint``);
+- **contracts** (level 2): :func:`pipeline_contracts` — measured
+  programs-per-stage against the budget table and device→host bytes
+  per warmed chunk (``spark_timeseries_tpu.utils.contracts``);
+- **attribution** (runtime): per-span *self* time from the newest
+  comparable ``BENCH_r*.json`` round (the PR 17 attribution plane),
+  used to rank the STS205 chains by how much wall the host work
+  between their dispatches actually burns.
+
+Output is one JSON document (``--json``, default ``-`` = stdout):
+``chains`` ranked by span self-time, the ``boundary`` contract block,
+and the lint summary.  ``make fusion-audit`` writes
+``FUSION_AUDIT.json``; the fusion PR consumes it and claws back
+against the pinned baseline.
+
+Exit code is 0 unless a *gating* STS200 finding or a boundary contract
+failure surfaces — the audit is an inventory, but it refuses to bless a
+tree the gate itself would fail.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# which span-name prefixes carry a hot-path module's runtime (the span
+# taxonomy is per-tier, the lint model is per-file)
+_MODULE_SPAN_PREFIXES: Dict[str, tuple] = {
+    "engine": ("engine.",),
+    "serving": ("serving.",),
+    "fleet": ("fleet.",),
+    "runtime": ("fleet.", "runtime."),
+    "kalman": ("serving.", "backtest."),
+    "combine": ("long.",),
+    "segment": ("long.",),
+    "evaluate": ("backtest.",),
+}
+
+_CHAIN_COUNTS_RE = re.compile(
+    r"\((\d+) dispatch, (\d+) host-materialize")
+
+
+def span_self_times(spans: Dict[str, Any]) -> Dict[str, float]:
+    """Per-leaf *self* seconds aggregated over every nested span path:
+    a path's self time is its total minus its immediate children's
+    totals (the attribution plane's oracle, recomputed from the bench
+    artifact's span stats)."""
+    totals = {k: float(v.get("total_s", 0.0))
+              for k, v in spans.items() if isinstance(v, dict)}
+    child_sum: Dict[str, float] = {}
+    for k, t in totals.items():
+        if "/" in k:
+            parent = k.rsplit("/", 1)[0]
+            child_sum[parent] = child_sum.get(parent, 0.0) + t
+    out: Dict[str, float] = {}
+    for k, t in totals.items():
+        leaf = k.rsplit("/", 1)[-1]
+        self_s = max(0.0, t - child_sum.get(k, 0.0))
+        out[leaf] = out.get(leaf, 0.0) + self_s
+    return out
+
+
+def newest_round_spans(directory: str = _REPO
+                       ) -> tuple:
+    """``(spans, round_path)`` from the newest bench round that has a
+    metrics block; ``({}, None)`` when no artifact qualifies."""
+    from tools.bench_gate import load_history
+    for rnd in reversed(load_history(directory)):
+        h = rnd.get("headline")
+        if not isinstance(h, dict):
+            continue
+        spans = (h.get("metrics") or {}).get("spans")
+        if isinstance(spans, dict) and spans:
+            return spans, rnd["path"]
+    return {}, None
+
+
+def _modbase(path: str) -> str:
+    name = os.path.basename(path)
+    return name[:-3] if name.endswith(".py") else name
+
+
+def rank_chains(findings: List[Any], self_times: Dict[str, float]
+                ) -> List[Dict[str, Any]]:
+    """STS205 findings → chain records ranked by the self time of the
+    spans their module's runtime books (descending; chains with no span
+    evidence rank by dispatch count at the bottom)."""
+    chains = []
+    for f in findings:
+        base = _modbase(f.path)
+        prefixes = _MODULE_SPAN_PREFIXES.get(base, (base + ".",))
+        span_hits = {leaf: round(s, 6)
+                     for leaf, s in self_times.items()
+                     if any(leaf.startswith(p) for p in prefixes)}
+        mo = _CHAIN_COUNTS_RE.search(f.message)
+        dispatches, mats = (int(mo.group(1)), int(mo.group(2))) \
+            if mo else (0, 0)
+        chains.append({
+            "module": f.path,
+            "symbol": f.symbol,
+            "line": f.line,
+            "dispatch_sites": dispatches,
+            "materialize_sites": mats,
+            "span_self_s": round(sum(span_hits.values()), 6),
+            "spans": dict(sorted(span_hits.items(),
+                                 key=lambda kv: -kv[1])[:6]),
+        })
+    chains.sort(key=lambda c: (-c["span_self_s"], -c["dispatch_sites"]))
+    return chains
+
+
+def run_audit(paths: Optional[List[str]] = None,
+              with_contracts: bool = True,
+              bench_dir: str = _REPO) -> Dict[str, Any]:
+    from tools.sts_lint import (DEFAULT_BASELINE, HOST_BOUNDARY_RULES,
+                                lint_paths, load_baseline)
+
+    result, _src = lint_paths(
+        paths or [os.path.join(_REPO, "spark_timeseries_tpu")],
+        root=_REPO, baseline=load_baseline(DEFAULT_BASELINE),
+        select=list(HOST_BOUNDARY_RULES))
+    spans, round_path = newest_round_spans(bench_dir)
+    self_times = span_self_times(spans)
+    chains = rank_chains(result.advice, self_times)
+
+    boundary: Dict[str, Any] = {}
+    if with_contracts:
+        from spark_timeseries_tpu.utils.contracts import \
+            pipeline_contracts
+        try:
+            boundary = pipeline_contracts()
+        except Exception as e:  # noqa: BLE001 — report, don't crash
+            boundary = {"error": f"{type(e).__name__}: {e}"}
+
+    gating = [f.to_json() for f in result.new]
+    return {
+        "version": 1,
+        "tool": "fusion-audit",
+        "bench_round": round_path,
+        "lint": {
+            "summary": result.summary(),
+            "gating_findings": gating,
+        },
+        "chains": chains,
+        "boundary": boundary,
+        "ok": (not gating
+               and not boundary.get("error")
+               and not boundary.get("boundary_failed", 0)),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.fusion_audit",
+        description="Host-boundary fusion audit: STS205 chain inventory "
+                    "ranked by span self-time + pipeline program/"
+                    "transfer contracts (ROADMAP item 1 evidence base).")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to lint "
+                         "(default: spark_timeseries_tpu)")
+    ap.add_argument("--json", dest="json_out", default="-",
+                    help="write the JSON report here (default '-' = "
+                         "stdout)")
+    ap.add_argument("--no-contracts", action="store_true",
+                    help="skip pipeline_contracts() (lint + span "
+                         "ranking only; no compiles)")
+    ap.add_argument("--bench-dir", default=_REPO,
+                    help="directory holding BENCH_r*.json artifacts")
+    args = ap.parse_args(argv)
+
+    report = run_audit(args.paths or None,
+                       with_contracts=not args.no_contracts,
+                       bench_dir=args.bench_dir)
+
+    human = sys.stderr if args.json_out == "-" else sys.stdout
+    print(f"fusion-audit: {len(report['chains'])} STS205 chain(s), "
+          f"{len(report['lint']['gating_findings'])} gating finding(s), "
+          f"bench round: {report['bench_round'] or 'none'}", file=human)
+    for c in report["chains"]:
+        print(f"  {c['span_self_s']:9.3f}s  {c['module']}:{c['line']} "
+              f"{c['symbol']} ({c['dispatch_sites']} dispatch / "
+              f"{c['materialize_sites']} materialize)", file=human)
+    b = report["boundary"]
+    if b.get("error"):
+        print(f"  boundary contracts ERROR: {b['error']}", file=human)
+    elif b:
+        print(f"  boundary: {b['pipeline_programs']} pipeline "
+              f"program(s), {b['host_transfer_bytes_per_chunk']} "
+              f"B/chunk, {b['unexpected_transfer_bytes']:+d} B "
+              f"unsanctioned, {b['boundary_failed']} contract "
+              f"failure(s)", file=human)
+
+    payload = json.dumps(report, indent=1)
+    if args.json_out == "-":
+        print(payload)
+    else:
+        with open(args.json_out, "w", encoding="utf-8") as fh:
+            fh.write(payload + "\n")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

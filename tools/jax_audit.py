@@ -25,6 +25,14 @@ category              what is matched
                       (``ops/pallas_arma.py``)
 ``experimental``      any other ``jax.experimental.*`` reference — the
                       namespace with no stability promise at all
+``metrics_bridge``    call sites of the ``utils.metrics`` APIs that
+                      forward to ``jax.profiler``/``jax.monitoring``
+                      (``span`` → ``TraceAnnotation``;
+                      ``install_jax_hooks``/``jax_stats`` → the event
+                      listeners).  PRs 15–18 (fleet runtime, lineage,
+                      attribution plane) lean on these everywhere, so
+                      the upgrade blast radius is the *bridge callers*,
+                      not just the two files importing jax directly
 ====================  =====================================================
 
 Usage: ``python -m tools.jax_audit`` (or ``make jax-audit``); ``--json
@@ -45,7 +53,15 @@ from .sts_lint.analysis import ModuleModel, canonical_tail
 from .sts_lint.engine import _iter_py_files
 
 CATEGORIES = ("monitoring", "profiler", "compilation_cache", "shard_map",
-              "pallas", "experimental")
+              "pallas", "experimental", "metrics_bridge")
+
+# utils.metrics symbols that forward into jax.profiler / jax.monitoring;
+# a caller of one of these breaks (or goes dark) when those APIs move.
+# trace_instant rides along: its markers share the TraceBuffer clock
+# with the profiler-annotated spans, so the runtime/lineage plane's
+# timeline goes incoherent if the span side moves without it.
+_BRIDGE_SYMBOLS = frozenset({"span", "install_jax_hooks", "jax_stats",
+                             "trace_instant"})
 
 
 def _category(tail: str) -> Optional[str]:
@@ -61,6 +77,9 @@ def _category(tail: str) -> Optional[str]:
         return "pallas"
     if tail.startswith("jax.experimental."):
         return "experimental"
+    if ("utils.metrics." in tail or tail.startswith("metrics.")) \
+            and tail.rsplit(".", 1)[-1] in _BRIDGE_SYMBOLS:
+        return "metrics_bridge"
     return None
 
 

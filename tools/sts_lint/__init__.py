@@ -38,6 +38,36 @@ acquisition-order graph actually exercised (cross-checking STS102) and
 a seeded deterministic scheduler adversarially permutes thread
 interleavings at instrumented boundaries (``make verify-races``).
 
+The STS200 series is the *host-boundary* tier (ISSUE 19): a dataflow
+model over the hot-path modules (``engine.py``,
+``statespace/{serving,fleet,runtime,kalman}.py``, ``longseries/``,
+``backtest/evaluate.py``) taints values returned by jitted /
+engine-cached executables as device-resident, then polices where they
+cross back to the host:
+
+- ``STS201`` implicit device→host materialization of a device-tainted
+  value (``np.asarray``/``float()``/``.item()``/``.tolist()``/
+  ``__iter__``/``.block_until_ready()``) outside the sanctioned
+  materialize sites — the complement of STS001, which only covers
+  *inside* traced code;
+- ``STS202`` ``jax.jit`` / ``.lower().compile()`` call sites inside a
+  loop body on the hot path (per-iteration trace/compile hazard);
+- ``STS203`` device-output slicing materialized per loop iteration
+  (the per-chunk pad-slice regression engine.py already fixed once,
+  now pinned tree-wide);
+- ``STS204`` read of a buffer after donating it to a compiled call
+  (``donate_argnums`` use-after-donate);
+- ``STS205`` (advice severity — inventory, never fails the gate)
+  compiled-call → host transform → compiled-call chains: the
+  fusion-opportunity evidence base for ROADMAP item 1, ranked by span
+  self-time in ``make fusion-audit``.
+
+Level 2 of the host-boundary tier is
+``spark_timeseries_tpu.utils.contracts.pipeline_contracts()``: it runs
+the warmed chunk path and pins distinct-compiled-programs-per-stage
+against a budget table plus device→host transferred bytes per warmed
+chunk (0 unexpected bytes beyond result materialization).
+
 Suppression: append ``# sts: noqa[STS0xx]`` (or bare ``# sts: noqa``)
 to the offending line.  Known-and-accepted findings live in the
 checked-in baseline (``tools/sts_lint/baseline.json``); only *new*
@@ -50,8 +80,10 @@ in-source with a justification, never carried as debt).
 
 from .engine import (Finding, LintResult, lint_paths, load_baseline,
                      write_baseline, DEFAULT_BASELINE)
-from .rules import CONCURRENCY_RULES, RULES, TRACER_SAFETY_RULES
+from .rules import (CONCURRENCY_RULES, EXAMPLES, HOST_BOUNDARY_RULES,
+                    RULES, TRACER_SAFETY_RULES)
 
 __all__ = ["Finding", "LintResult", "lint_paths", "load_baseline",
-           "write_baseline", "DEFAULT_BASELINE", "RULES",
-           "TRACER_SAFETY_RULES", "CONCURRENCY_RULES"]
+           "write_baseline", "DEFAULT_BASELINE", "RULES", "EXAMPLES",
+           "TRACER_SAFETY_RULES", "CONCURRENCY_RULES",
+           "HOST_BOUNDARY_RULES"]

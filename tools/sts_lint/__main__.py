@@ -15,7 +15,7 @@ import sys
 
 from .engine import (DEFAULT_BASELINE, lint_paths, load_baseline,
                      write_baseline)
-from .rules import RULES
+from .rules import EXAMPLES, RULES
 
 
 def main(argv=None) -> int:
@@ -44,13 +44,34 @@ def main(argv=None) -> int:
                     help="write the JSON report here ('-' = stdout)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalogue and exit")
+    ap.add_argument("--explain", metavar="STSxxx", default=None,
+                    help="print one rule's catalogue entry plus a "
+                         "minimal violating/fixed example pair and exit")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="suppress per-finding lines (summary only)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for code, rule in sorted(RULES.items()):
-            print(f"{code}  {rule.name:24s} {rule.summary}")
+            sev = " (advice)" if rule.severity == "advice" else ""
+            print(f"{code}  {rule.name:24s} {rule.summary}{sev}")
+        return 0
+
+    if args.explain:
+        code = args.explain.strip().upper()
+        rule = RULES.get(code)
+        if rule is None:
+            ap.error(f"unknown rule code: {args.explain} "
+                     f"(see --list-rules)")
+        print(f"{code} — {rule.name} [{rule.severity}]")
+        print(f"  {rule.summary}")
+        bad, good = EXAMPLES[code]
+        print("\nViolates:")
+        for line in bad.splitlines():
+            print(f"    {line}")
+        print("\nFixed:")
+        for line in good.splitlines():
+            print(f"    {line}")
         return 0
 
     select = None
@@ -85,6 +106,8 @@ def main(argv=None) -> int:
     if not args.quiet:
         for f in result.new:
             print(f.render(), file=human_out)
+        for f in result.advice:
+            print(f.render(), file=human_out)
         for e in result.parse_errors:
             print(f"PARSE ERROR: {e}", file=sys.stderr)
 
@@ -101,7 +124,8 @@ def main(argv=None) -> int:
     s = result.summary()
     print(f"sts-lint: {s['files_scanned']} files, "
           f"{s['findings']} new finding(s), "
-          f"{s['suppressed']} suppressed, {s['baselined']} baselined"
+          f"{s['suppressed']} suppressed, {s['baselined']} baselined, "
+          f"{s['advice']} advice"
           + (f"; by code: {s['by_code']}" if s["by_code"] else ""),
           file=human_out)
     return result.exit_code

@@ -1321,3 +1321,300 @@ def _const_ints(node: ast.AST) -> List[int]:
                 and not isinstance(n.value, bool):
             out.append(n.value)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Host-boundary model (the STS200 series): where do compiled-program
+# outputs cross back to the host on the hot path?
+# ---------------------------------------------------------------------------
+
+# The modules *between* the compiled programs — the orchestration layer
+# where a stray device→host crossing taxes every chunk/tick rather than
+# one call.  Matched by relpath suffix so the same scoping works when
+# linting the package directory, the repo root, or a test fixture tree.
+HOT_PATH_FILES = frozenset({
+    "engine.py",
+    "statespace/serving.py",
+    "statespace/fleet.py",
+    "statespace/runtime.py",
+    "statespace/kalman.py",
+    "backtest/evaluate.py",
+})
+HOT_PATH_DIRS = ("longseries",)
+
+
+def hot_path_module(mod: ModuleModel) -> bool:
+    """Is this module part of the chunk/tick hot path?"""
+    rel = mod.relpath
+    parts = rel.split("/")
+    # the lint package's own engine.py (and anything under tools/tests)
+    # is host tooling, not the pipeline
+    if "tools" in parts or "tests" in parts or "sts_lint" in parts:
+        return False
+    for f in HOT_PATH_FILES:
+        if rel == f or rel.endswith("/" + f):
+            return True
+    return any(d in parts[:-1] for d in HOT_PATH_DIRS)
+
+
+def _is_jit_call(mod: ModuleModel, node: ast.AST) -> bool:
+    """A Call expression that *produces a compiled callable*:
+    ``jax.jit(...)`` or an AOT ``<...>.lower(...).compile()`` chain."""
+    if not isinstance(node, ast.Call):
+        return False
+    canon = mod.resolve(node.func)
+    if canon and canonical_tail(canon) == "jax.jit":
+        return True
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "compile"
+            and isinstance(f.value, ast.Call)
+            and isinstance(f.value.func, ast.Attribute)
+            and f.value.func.attr == "lower")
+
+
+def _donated_positions(node: ast.Call) -> Tuple[int, ...]:
+    """``donate_argnums`` constants of a ``jax.jit(...)`` call site."""
+    for kw in node.keywords:
+        if kw.arg == "donate_argnums":
+            return tuple(_const_ints(kw.value))
+    return ()
+
+
+def _bind_names(targets, into: Set[str]) -> None:
+    for t in targets:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                into.add(n.id)
+
+
+class HostBoundaryModel:
+    """Device-taint dataflow for the hot-path modules.
+
+    Two taint kinds, both proven from the source rather than assumed:
+
+    - **executable taint** — names holding a compiled callable: a
+      module-level ``name = jax.jit(...)`` binding, the result of a
+      ``.lower(...).compile()`` chain, a call to a *jit factory* (a
+      function whose own body creates such a callable and returns a
+      value — ``serving._jitted``, ``engine.FitEngine._entry``), or an
+      attribute read off an executable-tainted value (``entry.compiled``).
+    - **device taint** — values returned by *calling* an
+      executable-tainted callable.  Flows through the same local walk
+      the tracer model uses (tuple unpacks, subscripts, non-static
+      attributes, arithmetic); ``jnp.*``/``jax.*`` calls preserve it;
+      any call the model cannot prove device-preserving launders it.
+
+    Same modeling stance as the tracer and concurrency models: misses
+    under-report, over-reporting is bounded because taint only starts at
+    proven compiled-callable bindings, never arbitrary data.
+    """
+
+    # known host-materializing callees: taint does NOT flow through
+    # these (their result is a host value) — the rules flag them instead
+    MATERIALIZE_TAILS = frozenset({
+        "numpy.asarray", "numpy.array", "numpy.ascontiguousarray",
+        "numpy.copyto", "numpy.save", "numpy.savetxt",
+        "jax.device_get",
+    })
+    MATERIALIZE_BUILTINS = frozenset({"float", "int", "bool", "complex",
+                                      "list", "tuple"})
+    MATERIALIZE_METHODS = frozenset({"item", "tolist",
+                                     "block_until_ready"})
+
+    def __init__(self, project: Project):
+        self.project = project
+        # relpath -> {module-level jit-handle name: donated positions}
+        self.module_jit_names: Dict[str, Dict[str, Tuple[int, ...]]] = {}
+        # FuncInfos whose call result is a compiled callable
+        self.jit_factories: Set[FuncInfo] = set()
+        self._scan()
+
+    # -- whole-project scan -------------------------------------------------
+
+    def _scan(self) -> None:
+        for mod in self.project.modules:
+            names: Dict[str, Tuple[int, ...]] = {}
+            stack: List[ast.AST] = list(mod.tree.body)
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                    continue
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and _is_jit_call(mod, node.value):
+                    names[node.targets[0].id] = \
+                        _donated_positions(node.value)
+                stack.extend(ast.iter_child_nodes(node))
+            if names:
+                self.module_jit_names[mod.relpath] = names
+        # jit factories: a function whose own scope builds a compiled
+        # callable and returns a value.  Two rounds close one level of
+        # wrapping (a function returning a factory's result).
+        for _ in range(2):
+            for mod in self.project.modules:
+                for fi in mod.functions:
+                    if fi in self.jit_factories or fi.is_lambda:
+                        continue
+                    builds = returns = False
+                    for node in iter_scope(fi.node):
+                        if _is_jit_call(mod, node):
+                            builds = True
+                        elif isinstance(node, ast.Call):
+                            callee = self._resolve_callee(mod, fi,
+                                                          node.func)
+                            if callee in self.jit_factories:
+                                builds = True
+                        elif isinstance(node, ast.Return) \
+                                and node.value is not None:
+                            returns = True
+                    if builds and returns:
+                        self.jit_factories.add(fi)
+
+    def _resolve_callee(self, mod: ModuleModel, scope: Optional[FuncInfo],
+                        func: ast.AST) -> Optional[FuncInfo]:
+        """Callee FuncInfo for a call expression, including the
+        ``self.method()`` form (resolved within the same module)."""
+        if isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name) \
+                and func.value.id == "self":
+            for fi in mod.functions:
+                if fi.name == func.attr and "." in fi.qualname:
+                    return fi
+            return None
+        canon = mod.resolve(func)
+        if canon is None:
+            return None
+        return self.project.lookup(canon, scope, mod)
+
+    # -- per-function taint -------------------------------------------------
+
+    def is_exec_expr(self, mod: ModuleModel, fi: FuncInfo, node: ast.AST,
+                     execn: Set[str]) -> bool:
+        """Does this expression evaluate to a compiled callable?"""
+        jit_names = self.module_jit_names.get(mod.relpath, {})
+        if isinstance(node, ast.Name):
+            return node.id in execn or node.id in jit_names
+        if isinstance(node, ast.Attribute):
+            # entry.compiled — the executable hangs off the handle
+            return self.is_exec_expr(mod, fi, node.value, execn)
+        if isinstance(node, ast.Call):
+            if _is_jit_call(mod, node):
+                return True
+            callee = self._resolve_callee(mod, fi, node.func)
+            return callee in self.jit_factories
+        return False
+
+    def is_device_expr(self, mod: ModuleModel, fi: FuncInfo,
+                       node: ast.AST, dev: Set[str],
+                       execn: Set[str]) -> bool:
+        """Does this expression evaluate to a device-resident value?"""
+        if isinstance(node, ast.Name):
+            return node.id in dev
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.is_device_expr(mod, fi, node.value, dev, execn)
+        if isinstance(node, ast.Subscript):
+            return self.is_device_expr(mod, fi, node.value, dev, execn)
+        if isinstance(node, ast.Starred):
+            return self.is_device_expr(mod, fi, node.value, dev, execn)
+        if isinstance(node, ast.BinOp):
+            return self.is_device_expr(mod, fi, node.left, dev, execn) \
+                or self.is_device_expr(mod, fi, node.right, dev, execn)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_device_expr(mod, fi, node.operand, dev, execn)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.is_device_expr(mod, fi, e, dev, execn)
+                       for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return self.is_device_expr(mod, fi, node.body, dev, execn) \
+                or self.is_device_expr(mod, fi, node.orelse, dev, execn)
+        if isinstance(node, ast.Call):
+            # calling a compiled callable: the output lives on device
+            if self.is_exec_expr(mod, fi, node.func, execn):
+                return True
+            canon = mod.resolve(node.func)
+            tail = canonical_tail(canon) if canon else ""
+            base = tail.split(".")[-1] if tail else ""
+            if tail in self.MATERIALIZE_TAILS \
+                    or tail in self.MATERIALIZE_BUILTINS:
+                return False            # the result is a host value now
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in self.MATERIALIZE_METHODS:
+                return False
+            if tail.startswith("jax.") or tail.startswith("jnp."):
+                # device ops keep device operands on device
+                return any(self.is_device_expr(mod, fi, a, dev, execn)
+                           for a in node.args)
+            _ = base
+            return False                # unknown call launders
+        return False
+
+    def function_taints(self, mod: ModuleModel, fi: FuncInfo
+                        ) -> Tuple[Set[str], Set[str],
+                                   Dict[str, Tuple[int, ...]]]:
+        """``(exec_names, device_names, donated)`` for one function,
+        grown through two local-flow passes (use-before-def in loops).
+        ``donated`` maps local jit-handle names to their
+        ``donate_argnums`` positions."""
+        execn: Set[str] = set()
+        dev: Set[str] = set()
+        donated: Dict[str, Tuple[int, ...]] = dict(
+            self.module_jit_names.get(mod.relpath, {}))
+        for _ in range(2):
+            for node in iter_scope(fi.node):
+                if isinstance(node, ast.Assign):
+                    val = node.value
+                    if self.is_exec_expr(mod, fi, val, execn):
+                        _bind_names(node.targets, execn)
+                        if isinstance(val, ast.Call) \
+                                and _is_jit_call(mod, val) \
+                                and len(node.targets) == 1 \
+                                and isinstance(node.targets[0], ast.Name):
+                            pos = _donated_positions(val)
+                            if pos:
+                                donated[node.targets[0].id] = pos
+                    elif self.is_device_expr(mod, fi, val, dev, execn):
+                        _bind_names(node.targets, dev)
+                elif isinstance(node, ast.AugAssign):
+                    if self.is_device_expr(mod, fi, node.value, dev,
+                                           execn):
+                        _bind_names([node.target], dev)
+                elif isinstance(node, ast.NamedExpr):
+                    if self.is_device_expr(mod, fi, node.value, dev,
+                                           execn) \
+                            and isinstance(node.target, ast.Name):
+                        dev.add(node.target.id)
+                elif isinstance(node, ast.For):
+                    if self.is_device_expr(mod, fi, node.iter, dev,
+                                           execn):
+                        _bind_names([node.target], dev)
+        return execn, dev, donated
+
+
+def loop_node_ids(fi: FuncInfo) -> Set[int]:
+    """``id()`` of every node lexically inside a loop body of this
+    function's own scope (nested defs excluded, matching iter_scope)."""
+    out: Set[int] = set()
+    for node in iter_scope(fi.node):
+        if isinstance(node, (ast.For, ast.While)):
+            stack: List[ast.AST] = list(node.body)
+            while stack:
+                n = stack.pop()
+                out.add(id(n))
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                    continue
+                stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def host_boundary_model(project: Project) -> HostBoundaryModel:
+    """The per-run cached host-boundary model (built on first use)."""
+    model = getattr(project, "_host_boundary_model", None)
+    if model is None:
+        model = HostBoundaryModel(project)
+        project._host_boundary_model = model
+    return model

@@ -42,7 +42,7 @@ class Finding:
     col: int
     symbol: str
     message: str
-    status: str = "new"  # new | suppressed | baselined
+    status: str = "new"  # new | suppressed | baselined | advice
 
     def fingerprint(self, line_text: str) -> str:
         h = hashlib.sha1(line_text.strip().encode()).hexdigest()[:10]
@@ -55,8 +55,9 @@ class Finding:
 
     def render(self) -> str:
         where = f" [in {self.symbol}]" if self.symbol else ""
-        return (f"{self.path}:{self.line}:{self.col + 1}: {self.code} "
-                f"{self.message}{where}")
+        tag = " advice" if self.status == "advice" else ""
+        return (f"{self.path}:{self.line}:{self.col + 1}: {self.code}"
+                f"{tag} {self.message}{where}")
 
 
 @dataclass
@@ -78,6 +79,10 @@ class LintResult:
         return [f for f in self.findings if f.status == "baselined"]
 
     @property
+    def advice(self) -> List[Finding]:
+        return [f for f in self.findings if f.status == "advice"]
+
+    @property
     def exit_code(self) -> int:
         return 1 if (self.new or self.parse_errors) else 0
 
@@ -89,6 +94,7 @@ class LintResult:
             "findings": len(self.new),
             "suppressed": len(self.suppressed),
             "baselined": len(self.baselined),
+            "advice": len(self.advice),
             "files_scanned": self.files_scanned,
             "by_code": dict(sorted(by_code.items())),
         }
@@ -151,7 +157,7 @@ def write_baseline(path: str, result: "LintResult",
     entries: Dict[str, int] = {}
     context: Dict[str, str] = {}
     for f in result.findings:
-        if f.status == "suppressed":
+        if f.status in ("suppressed", "advice"):
             continue
         line_text = _line_of(sources.get(f.path, ""), f.line)
         fp = f.fingerprint(line_text)
@@ -214,6 +220,9 @@ def lint_paths(paths: Sequence[str], root: Optional[str] = None,
                 if codes is not False and (codes is None
                                            or raw.code in codes):
                     f.status = "suppressed"
+                elif rule.severity == "advice":
+                    # inventory, not debt: never gates, never baselines
+                    f.status = "advice"
                 else:
                     fp = f.fingerprint(_line_of(mod.source, raw.line))
                     if remaining.get(fp, 0) > 0:

@@ -31,10 +31,12 @@ import ast
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterator, Optional
 
-from .analysis import (ConcurrencyModel, FuncInfo, ModuleModel, Project,
-                       _self_attr_target, canonical_tail,
-                       concurrency_model, iter_scope, local_tainted_names,
-                       locally_bound, taint_expr)
+from .analysis import (ConcurrencyModel, FuncInfo, HostBoundaryModel,
+                       ModuleModel, Project, _donated_positions, _is_jit_call,
+                       _modbase, _self_attr_target, canonical_tail,
+                       concurrency_model, host_boundary_model,
+                       hot_path_module, iter_scope, local_tainted_names,
+                       locally_bound, loop_node_ids, taint_expr)
 
 
 @dataclass
@@ -52,6 +54,9 @@ class Rule:
     name: str
     summary: str
     check: Callable[[Project, ModuleModel], Iterator[RawFinding]]
+    # "error" findings fail the gate when new; "advice" findings are
+    # inventory only — reported, never baselined, never exit-nonzero
+    severity: str = "error"
 
 
 # ---------------------------------------------------------------------------
@@ -720,6 +725,252 @@ def _check_thread_lifecycle(project: Project, mod: ModuleModel
 
 
 # ---------------------------------------------------------------------------
+# STS201–STS205 — the host-boundary tier (hot-path modules only)
+# ---------------------------------------------------------------------------
+#
+# These rules run on the orchestration layer *between* compiled programs
+# — the complement of STS001, which polices code *inside* the trace.
+# Device taint starts only at proven compiled-callable call results (see
+# HostBoundaryModel), so a finding always names a value that really did
+# come off an executable.
+
+# Sanctioned device→host materialize sites: the places where results are
+# *supposed* to land on the host (chunk-result collection, serving tick
+# delivery, segment combination).  Matched against the whole enclosing
+# scope chain, so nested helpers of a sanctioned function are covered.
+# Additions here are reviewed policy — see docs/design.md §6d.
+SANCTIONED_MATERIALIZE = frozenset({
+    # engine: the one chunk-result collection point + pad-slice rebuild
+    ("engine", "FitEngine._rebuild"),
+    ("engine", "FitEngine.fit"),
+    ("engine", "FitEngine.stream_fit"),
+    # serving: tick/forecast delivery back to the caller
+    ("serving", "ServingSession.update"),
+    ("serving", "ServingSession.update_batch"),
+    ("serving", "ServingSession.forecast"),
+    ("serving", "ServingSession.warmup"),
+    ("serving", "ServingSession.heal"),
+    # fleet: coalesced-tick scatter-back (hoisted; regression-pinned)
+    ("fleet", "FleetScheduler._dispatch_group"),
+    ("fleet", "FleetScheduler.warmup"),
+    # longseries: deliberate f64 host accumulation at segment boundaries
+    ("combine", "combine_segments"),
+    # backtest: metric-table delivery at the end of a sweep
+    ("evaluate", "evaluate_candidate"),
+})
+
+
+def _sanctioned(mod: ModuleModel, fi: FuncInfo) -> bool:
+    base = _modbase(mod)
+    return any((base, f.qualname) in SANCTIONED_MATERIALIZE
+               for f in fi.scope_chain())
+
+
+_COMPREHENSIONS = (ast.ListComp, ast.SetComp, ast.GeneratorExp)
+
+
+def _materialize_site(model: HostBoundaryModel, mod: ModuleModel,
+                      fi: FuncInfo, node: ast.AST, dev, execn):
+    """``(kind, device_arg)`` when ``node`` is a host-materialization of
+    a device-tainted value; None otherwise."""
+    if isinstance(node, ast.Call):
+        canon = mod.resolve(node.func)
+        tail = canonical_tail(canon) if canon else ""
+        if (tail in model.MATERIALIZE_TAILS
+                or tail in model.MATERIALIZE_BUILTINS) and node.args \
+                and model.is_device_expr(mod, fi, node.args[0], dev, execn):
+            return f"{tail}()", node.args[0]
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in model.MATERIALIZE_METHODS \
+                and model.is_device_expr(mod, fi, node.func.value, dev,
+                                         execn):
+            return f".{node.func.attr}()", node.func.value
+    elif isinstance(node, ast.For):
+        if model.is_device_expr(mod, fi, node.iter, dev, execn):
+            return "__iter__ (for-loop over a device array)", node.iter
+    elif isinstance(node, _COMPREHENSIONS):
+        for gen in node.generators:
+            if model.is_device_expr(mod, fi, gen.iter, dev, execn):
+                return "__iter__ (comprehension over a device array)", \
+                    gen.iter
+    return None
+
+
+def _has_dev_slice(model: HostBoundaryModel, mod: ModuleModel,
+                   fi: FuncInfo, expr: ast.AST, dev, execn) -> bool:
+    """Does ``expr`` contain a *slice* subscript of a device value?
+    Plain integer/tuple indexing (``out[0]``) is not the pad-slice
+    pattern and stays out of STS203's domain."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Subscript) \
+                and any(isinstance(s, ast.Slice) for s in ast.walk(n.slice)) \
+                and model.is_device_expr(mod, fi, n.value, dev, execn):
+            return True
+    return False
+
+
+def _boundary_functions(project: Project, mod: ModuleModel):
+    """Hot-path functions the STS200 rules inspect, with their taints.
+    Traced functions are STS001's domain; lambdas carry no useful
+    qualname and their params are never device-tainted by this model."""
+    if not hot_path_module(mod):
+        return
+    model = host_boundary_model(project)
+    for fi in mod.functions:
+        if fi.traced or fi.is_lambda:
+            continue
+        execn, dev, donated = model.function_taints(mod, fi)
+        if not dev and not execn and not donated:
+            continue
+        yield model, fi, execn, dev, donated
+
+
+def _check_implicit_materialize(project: Project, mod: ModuleModel
+                                ) -> Iterator[RawFinding]:
+    for model, fi, execn, dev, _donated in _boundary_functions(project,
+                                                               mod):
+        loops = loop_node_ids(fi)
+        in_sanctioned = _sanctioned(mod, fi)
+        for node in iter_scope(fi.node):
+            hit = _materialize_site(model, mod, fi, node, dev, execn)
+            if hit is None:
+                continue
+            kind, arg = hit
+            if id(node) in loops and _has_dev_slice(model, mod, fi,
+                                                    arg, dev, execn):
+                continue          # STS203's finding, not this one
+            if in_sanctioned:
+                continue
+            yield RawFinding(
+                "STS201", node.lineno, node.col_offset, fi.qualname,
+                f"implicit device→host materialization via {kind} of a "
+                f"compiled-program output on the hot path: each crossing "
+                f"blocks on the device and serializes the pipeline — "
+                f"move it to a sanctioned materialize site (or extend "
+                f"the sanctioned table in a reviewed change)")
+
+
+def _check_jit_in_loop(project: Project, mod: ModuleModel
+                       ) -> Iterator[RawFinding]:
+    if not hot_path_module(mod):
+        return
+    for fi in mod.functions:
+        loops = loop_node_ids(fi)
+        if not loops:
+            continue
+        for node in iter_scope(fi.node):
+            if id(node) not in loops or not _is_jit_call(mod, node):
+                continue
+            if _has_cache_decorator(fi):
+                continue
+            what = "jax.jit(...)" if not (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "compile") else ".lower().compile()"
+            yield RawFinding(
+                "STS202", node.lineno, node.col_offset, fi.qualname,
+                f"{what} inside a loop body on the hot path: every "
+                f"iteration pays trace+compile (or at best a cache "
+                f"probe) — hoist the compiled callable out of the loop "
+                f"or route it through the engine's executable cache")
+
+
+def _check_device_slice_in_loop(project: Project, mod: ModuleModel
+                                ) -> Iterator[RawFinding]:
+    for model, fi, execn, dev, _donated in _boundary_functions(project,
+                                                               mod):
+        loops = loop_node_ids(fi)
+        if not loops:
+            continue
+        for node in iter_scope(fi.node):
+            if id(node) not in loops:
+                continue
+            hit = _materialize_site(model, mod, fi, node, dev, execn)
+            if hit is None:
+                continue
+            kind, arg = hit
+            if not _has_dev_slice(model, mod, fi, arg, dev, execn):
+                continue
+            yield RawFinding(
+                "STS203", node.lineno, node.col_offset, fi.qualname,
+                f"per-iteration device-output slice materialized via "
+                f"{kind} inside a loop: each iteration compiles/launches "
+                f"a slice program and blocks on its transfer (the "
+                f"per-chunk pad-slice regression engine.py already fixed "
+                f"once) — materialize the whole array once before the "
+                f"loop and slice on the host")
+
+
+def _check_use_after_donate(project: Project, mod: ModuleModel
+                            ) -> Iterator[RawFinding]:
+    for model, fi, execn, dev, donated in _boundary_functions(project,
+                                                              mod):
+        if not donated:
+            continue
+        # (donated argument name, dispatch line) per dispatch site
+        dispatches = []
+        for node in iter_scope(fi.node):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Name):
+                continue
+            positions = donated.get(node.func.id)
+            if not positions:
+                continue
+            for p in positions:
+                if p < len(node.args) and isinstance(node.args[p],
+                                                     ast.Name):
+                    dispatches.append((node.args[p].id, node.lineno,
+                                       node.col_offset, node.func.id))
+        if not dispatches:
+            continue
+        for name, line, col, callee in dispatches:
+            for node in iter_scope(fi.node):
+                if isinstance(node, ast.Name) and node.id == name \
+                        and isinstance(node.ctx, ast.Load) \
+                        and node.lineno > line:
+                    yield RawFinding(
+                        "STS204", node.lineno, node.col_offset,
+                        fi.qualname,
+                        f"use of {name!r} after it was donated to "
+                        f"{callee}() (donate_argnums) at line {line}: "
+                        f"the buffer is deleted on dispatch — reading "
+                        f"it raises or returns garbage.  Rebind the "
+                        f"result or copy before donating")
+                    break
+
+
+def _check_fusion_chain(project: Project, mod: ModuleModel
+                        ) -> Iterator[RawFinding]:
+    """STS205 (advice): jitted-call → host transform → jitted-call —
+    the fusion-opportunity inventory for ROADMAP item 1.  One finding
+    per function; ranked by span self-time in `make fusion-audit`."""
+    for model, fi, execn, dev, _donated in _boundary_functions(project,
+                                                               mod):
+        loops = loop_node_ids(fi)
+        mats = []           # (lineno, in_loop) of host materializations
+        disps = []          # (lineno, in_loop) of compiled dispatches
+        for node in iter_scope(fi.node):
+            if _materialize_site(model, mod, fi, node, dev, execn):
+                mats.append((node.lineno, id(node) in loops))
+            if isinstance(node, ast.Call) \
+                    and model.is_exec_expr(mod, fi, node.func, execn):
+                disps.append((node.lineno, id(node) in loops))
+        if not mats or not disps:
+            continue
+        chained = any(d > m for m, _ in mats for d, _ in disps) \
+            or (any(il for _, il in mats) and any(il for _, il in disps))
+        if not chained:
+            continue
+        first = min(m for m, _ in mats)
+        yield RawFinding(
+            "STS205", first, 0, fi.qualname,
+            f"fusion opportunity: compiled-call → host transform → "
+            f"compiled-call chain ({len(disps)} dispatch, {len(mats)} "
+            f"host-materialize site(s)) — candidate for whole-pipeline "
+            f"fusion (ROADMAP item 1); see `make fusion-audit` for the "
+            f"ranked inventory")
+
+
+# ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
 
@@ -755,6 +1006,23 @@ RULES: Dict[str, Rule] = {r.code: r for r in [
          "Thread-lifecycle hygiene: unjoined non-daemon threads, "
          "waiterless Events, raise-through thread targets",
          _check_thread_lifecycle),
+    Rule("STS201", "implicit-materialize",
+         "Implicit device→host materialization of a compiled-program "
+         "output outside sanctioned sites (hot path)",
+         _check_implicit_materialize),
+    Rule("STS202", "jit-in-loop",
+         "jax.jit / .lower().compile() call site inside a loop body on "
+         "the hot path", _check_jit_in_loop),
+    Rule("STS203", "device-slice-in-loop",
+         "Device-output slice materialized per loop iteration (the "
+         "per-chunk pad-slice pattern)", _check_device_slice_in_loop),
+    Rule("STS204", "use-after-donate",
+         "Read of a buffer after donating it to a compiled call "
+         "(donate_argnums)", _check_use_after_donate),
+    Rule("STS205", "fusion-chain",
+         "Compiled-call → host transform → compiled-call chain "
+         "(fusion-opportunity inventory; advice only)",
+         _check_fusion_chain, severity="advice"),
 ]}
 
 TRACER_SAFETY_RULES = ("STS001", "STS002", "STS005", "STS006")
@@ -763,3 +1031,132 @@ DTYPE_RULES = ("STS003", "STS004")
 # baselined — every real finding is fixed or suppressed in-source with a
 # written justification
 CONCURRENCY_RULES = ("STS101", "STS102", "STS103", "STS104")
+# the host-boundary tier: STS201–204 are correctness/perf gates (empty
+# baseline, same policy as above); STS205 is advice severity — it feeds
+# the fusion audit and never fails the gate
+HOST_BOUNDARY_RULES = ("STS201", "STS202", "STS203", "STS204", "STS205")
+
+
+# ---------------------------------------------------------------------------
+# --explain examples: one minimal violating / fixed pair per rule
+# ---------------------------------------------------------------------------
+
+EXAMPLES: Dict[str, tuple] = {
+    "STS001": (
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    t = time.time()          # baked in at trace time\n"
+        "    return x * t",
+        "def step(x, t):               # pass host values as arguments\n"
+        "    return x * t\n"
+        "step_j = jax.jit(step)\n"
+        "out = step_j(x, time.time())",
+    ),
+    "STS002": (
+        "@jax.jit\n"
+        "def fit(y):\n"
+        "    with metrics.span(\"fit\"):   # fires at trace time only\n"
+        "        return solve(y)",
+        "def fit(y):\n"
+        "    with metrics.span(\"fit\"):   # span around the traced call\n"
+        "        return fit_jit(y)",
+    ),
+    "STS003": (
+        "def init(n):\n"
+        "    return jnp.zeros((n,))        # f32 today, f64 under x64",
+        "def init(n, dtype):\n"
+        "    return jnp.zeros((n,), dtype=dtype)",
+    ),
+    "STS004": (
+        "scale = np.float64(2.0)           # strong f64, promotes jnp\n"
+        "y = x * scale",
+        "scale = 2.0                       # weak Python float\n"
+        "y = x * scale",
+    ),
+    "STS005": (
+        "@jax.jit\n"
+        "def clip(x, lo):\n"
+        "    if x < lo:                    # tracer in a Python branch\n"
+        "        return lo\n"
+        "    return x",
+        "@jax.jit\n"
+        "def clip(x, lo):\n"
+        "    return jnp.where(x < lo, lo, x)",
+    ),
+    "STS006": (
+        "def fit(y, order):\n"
+        "    f = jax.jit(lambda y: solve(y, order))   # fresh per call\n"
+        "    return f(y)",
+        "_solve_j = jax.jit(solve, static_argnums=(1,))  # module scope\n"
+        "def fit(y, order):\n"
+        "    return _solve_j(y, order)",
+    ),
+    "STS101": (
+        "def put(self, k, v):\n"
+        "    self._cache[k] = v            # mutated under lock elsewhere",
+        "def put(self, k, v):\n"
+        "    with self._lock:\n"
+        "        self._cache[k] = v",
+    ),
+    "STS102": (
+        "# thread 1: with a: with b: ...\n"
+        "# thread 2: with b: with a: ...   # opposite order → ABBA",
+        "# pick one global order (design.md §6d table) and take both\n"
+        "# locks in that order everywhere:\n"
+        "# with a: with b: ...",
+    ),
+    "STS103": (
+        "with self._lock:\n"
+        "    arr.block_until_ready()       # every waiter stalls",
+        "with self._lock:\n"
+        "    arr = self._pending\n"
+        "arr.block_until_ready()           # blocking wait outside",
+    ),
+    "STS104": (
+        "t = threading.Thread(target=work)\n"
+        "t.start()                         # never joined, non-daemon",
+        "t = threading.Thread(target=work, daemon=True)\n"
+        "t.start()                         # or: join on every exit path",
+    ),
+    "STS201": (
+        "out = compiled(batch)\n"
+        "for row in np.asarray(out):       # implicit D2H crossing\n"
+        "    publish(row)",
+        "# materialize once, at the sanctioned collection site:\n"
+        "host = collect(out)               # engine._materialize\n"
+        "for row in host:\n"
+        "    publish(row)",
+    ),
+    "STS202": (
+        "for chunk in chunks:\n"
+        "    f = jax.jit(step)             # per-iteration cache probe\n"
+        "    out = f(chunk)",
+        "f = jax.jit(step)                 # hoisted: compile once\n"
+        "for chunk in chunks:\n"
+        "    out = f(chunk)",
+    ),
+    "STS203": (
+        "out = compiled(batch)\n"
+        "for lo in offsets:\n"
+        "    part = np.asarray(out[lo:lo + n])   # slice program + D2H\n"
+        "    deliver(part)",
+        "host = np.asarray(out)            # one transfer\n"
+        "for lo in offsets:\n"
+        "    deliver(host[lo:lo + n])      # host-side slicing is free",
+    ),
+    "STS204": (
+        "f = jax.jit(step, donate_argnums=(0,))\n"
+        "out = f(state)\n"
+        "print(state.sum())                # state was deleted on dispatch",
+        "f = jax.jit(step, donate_argnums=(0,))\n"
+        "state = f(state)                  # rebind: old buffer is gone",
+    ),
+    "STS205": (
+        "x = f_jit(a)\n"
+        "h = np.asarray(x) * w             # host hop between programs\n"
+        "y = g_jit(h)",
+        "# fuse the host transform into one compiled program\n"
+        "# (ROADMAP item 1):\n"
+        "y = fg_jit(a, w)",
+    ),
+}
