@@ -16,7 +16,7 @@ STS_COMPILE_CACHE ?=
 .PHONY: help verify compileall tier1 verify-faults verify-durability \
 	verify-perf verify-serving verify-long verify-telemetry verify-fleet \
 	verify-backtest verify-quality verify-races verify-attribution \
-	verify-runtime verify-lineage gate \
+	verify-runtime verify-lineage verify-fused gate \
 	bench-diff trace lint lint-baseline contracts verify-static \
 	jax-audit fusion-audit warmup
 
@@ -66,8 +66,11 @@ help:
 	@echo "                exactly-once lineage under pump_crash + drain/adopt, cache-serve"
 	@echo "                detours, ring bounds, 0-recompile pin armed), plain and under"
 	@echo "                STS_FAULT_INJECT=1"
-	@echo "  verify-perf   attribution suite + perf gate: newest BENCH_r*.json vs"
-	@echo "                trailing-median baseline"
+	@echo "  verify-perf   attribution + fused suites + perf gate: newest BENCH_r*.json"
+	@echo "                vs trailing-median baseline"
+	@echo "  verify-fused  whole-pipeline-fusion suite (fused vs staged publish"
+	@echo "                equivalence, fit_long in-graph combine, journal agnosticism,"
+	@echo "                warmup pin), plain and under STS_FAULT_INJECT=1"
 	@echo "  verify-attribution attribution-plane suite (span self-time oracle, stream_fit"
 	@echo "                phase accounting, bench-diff golden, 0-recompile pin armed)"
 	@echo "  gate          perf gate alone (tools/bench_gate.py; exit 1 on regression)"
@@ -307,13 +310,26 @@ verify-attribution:
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly
 
+# whole-pipeline-fusion gate (ISSUE 20): the `fused`-marked suite —
+# fused-vs-staged publish equivalence (bitwise dense / 1e-6 ragged +
+# fit_long), journal fused-agnosticism, the warmup burn-down pin —
+# plain and again under fault injection (faults must degrade the fused
+# path onto the same staged oracle, never diverge from it)
+verify-fused:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m fused \
+		--continue-on-collection-errors -p no:cacheprovider \
+		-p no:xdist -p no:randomly
+	STS_FAULT_INJECT=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
+		-m fused --continue-on-collection-errors \
+		-p no:cacheprovider -p no:xdist -p no:randomly
+
 # perf regression gate over the recorded BENCH_r*.json trajectory: the
 # newest round is compared per headline metric (throughput, fit wall
 # time, compile seconds, recompiles, engine host-overhead fraction)
 # against the trailing median of comparable prior rounds; exits nonzero
 # past the thresholds (see tools/bench_gate.py --help;
 # BENCH_GATE_THRESHOLD overrides).
-verify-perf: verify-attribution gate
+verify-perf: verify-attribution verify-fused gate
 
 gate:
 	$(PY) tools/bench_gate.py

@@ -449,6 +449,61 @@ class _Entry(NamedTuple):
     donate: bool
 
 
+class _PublishPlan(NamedTuple):
+    """Fused-path publish recipe (docs/design.md §6e): the skeleton walk
+    — static-leaf placement plus the padding-cut decision per output —
+    resolved ONCE per (bucket, variant, n_real) key and replayed for
+    every chunk in the bucket.  The staged path re-walks the skeleton
+    per chunk and re-uploads every cut leaf through ``jnp.asarray``
+    (one D2H+H2D round trip per padded output); the plan instead takes
+    zero-copy numpy views of the already-materialized arrays, so a warm
+    fused chunk's host traffic is exactly the sanctioned result
+    materialization."""
+    template: Tuple[Any, ...]     # n_leaves slots, static leaves filled
+    array_pos: Tuple[int, ...]
+    cuts: Tuple[Optional[Tuple[int, int]], ...]  # per output: (c0, c1)
+    treedef: Any
+
+    def rebuild(self, arrays: Sequence[Any]):
+        import jax
+
+        leaves = list(self.template)
+        for i, arr, cut in zip(self.array_pos, arrays, self.cuts):
+            if cut is not None:
+                c0, c1 = cut
+                if c0:
+                    arr = arr[:c0]
+                if c1:
+                    arr = arr[:, :c1]
+            leaves[i] = arr
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+
+def _build_publish_plan(skeleton: _Skeleton, arrays: Sequence[Any],
+                        n_series: int, n_obs: int,
+                        bucket: Tuple[int, int]) -> _PublishPlan:
+    """Resolve the per-bucket publish plan from the first materialized
+    chunk's output shapes — the same cut policy as :meth:`FitEngine.
+    _rebuild` (leading dims at the series bucket shrink to ``n_series``,
+    second dims at an expanded obs bucket shrink to ``n_obs``), decided
+    once instead of per chunk."""
+    bs, bt = bucket
+    template: List[Any] = [None] * skeleton.n_leaves
+    for i, val in skeleton.static_leaves:
+        template[i] = val
+    cuts: List[Optional[Tuple[int, int]]] = []
+    for arr in arrays:
+        cut = None
+        if hasattr(arr, "ndim") and arr.ndim >= 1:
+            cut0 = arr.shape[0] == bs and bs != n_series
+            cut1 = arr.ndim >= 2 and bt != n_obs and arr.shape[1] == bt
+            if cut0 or cut1:
+                cut = (n_series if cut0 else 0, n_obs if cut1 else 0)
+        cuts.append(cut)
+    return _PublishPlan(tuple(template), skeleton.array_pos,
+                        tuple(cuts), skeleton.treedef)
+
+
 # ---------------------------------------------------------------------------
 # the engine
 # ---------------------------------------------------------------------------
@@ -512,10 +567,15 @@ class FitEngine:
         jitted = _jit_for(variant, donate)
         spec_v = jax.ShapeDtypeStruct(bucket, dtype)
         spec_n = jax.ShapeDtypeStruct((), np.int32)
+        from .models.base import unroll_hint
+
         slot: Dict[str, Any] = {}
         _skeleton_capture.slot = slot
         try:
-            with _metrics.span("engine.compile"):
+            # the bucket width is the scan-unroll policy's amortization
+            # signal (models.base.scan_unroll): wide bench buckets trace
+            # unrolled, narrow test/interactive buckets stay compile-lean
+            with _metrics.span("engine.compile"), unroll_hint(bucket[0]):
                 compiled = jitted.lower(family, statics, spec_v,
                                         spec_n).compile()
         finally:
@@ -526,10 +586,12 @@ class FitEngine:
             # one abstract re-trace recovers the skeleton
             _skeleton_capture.slot = slot
             try:
-                jax.eval_shape(
-                    lambda v, n: (_dense_fit if variant == "dense"
-                                  else _ragged_fit)(family, statics, v, n),
-                    spec_v, spec_n)
+                with unroll_hint(bucket[0]):
+                    jax.eval_shape(
+                        lambda v, n: (_dense_fit if variant == "dense"
+                                      else _ragged_fit)(family, statics,
+                                                        v, n),
+                        spec_v, spec_n)
             finally:
                 _skeleton_capture.slot = None
             skeleton = slot["skeleton"]
@@ -843,10 +905,29 @@ class FitEngine:
                    degrade: bool = True,
                    degrade_floor: Optional[int] = None,
                    resilient: bool = False,
+                   fused: Optional[bool] = None,
                    on_progress: Optional[Callable[[Any], None]] = None,
                    job_label: Optional[str] = None,
                    **kwargs) -> StreamResult:
         """Fit a panel larger than device memory by streaming chunks.
+
+        ``fused`` (default: on, except under ``resilient=True`` which is
+        host-orchestrated by design) publishes each chunk through the
+        per-bucket :class:`_PublishPlan` — the whole-pipeline-fusion
+        contract (docs/design.md §6e): a warm chunk dispatches exactly
+        ONE donated executable, and its only host crossing is the
+        sanctioned result materialization
+        (:func:`expected_chunk_result_bytes`); skeleton reattach work
+        is resolved once per (bucket, variant, n_real) instead of per
+        chunk, and padded outputs are cut as zero-copy numpy views
+        instead of the staged path's slice + device re-upload.
+        ``fused=False`` keeps the staged per-chunk :meth:`_rebuild`
+        path — the oracle the fused-vs-staged equivalence tests pin
+        against (bitwise for the dense variant: both paths run the SAME
+        cached executable, they differ only in host-side publish).
+        Journals are fused-agnostic: the job spec does not include the
+        flag, so a journal written by either path resumes under the
+        other with the same spec hash.
 
         Pipelining: each chunk's H2D transfer + fit is dispatched (JAX
         dispatch is async) while earlier chunks' results are still being
@@ -973,6 +1054,12 @@ class FitEngine:
         chunk = max(1, min(int(chunk_size), n_series))
         depth = self.prefetch if prefetch is None else max(1, int(prefetch))
         don = self.donate_default() if donate is None else bool(donate)
+        use_fused = (not resilient) if fused is None else bool(fused)
+        # per-stream publish-plan cache: one skeleton walk per
+        # (bucket, variant, n_real) key, replayed for every chunk in
+        # that bucket (every full chunk shares one plan; the tail and
+        # any OOM-degraded sub-ranges get their own)
+        publish_plans: Dict[tuple, _PublishPlan] = {}
         before = self.cache_stats()
         partition = [(s, min(s + chunk, n_series))
                      for s in range(0, n_series, chunk)]
@@ -1300,8 +1387,18 @@ class FitEngine:
             model = None
             if keep_models:
                 t0 = time.perf_counter()
-                model = self._rebuild(entry.skeleton, arrays, n_real,
-                                      n_obs, entry.bucket)
+                if use_fused:
+                    pkey = (entry.bucket, entry.variant, n_real)
+                    plan = publish_plans.get(pkey)
+                    if plan is None:
+                        plan = _build_publish_plan(
+                            entry.skeleton, arrays, n_real, n_obs,
+                            entry.bucket)
+                        publish_plans[pkey] = plan
+                    model = plan.rebuild(arrays)
+                else:
+                    model = self._rebuild(entry.skeleton, arrays, n_real,
+                                          n_obs, entry.bucket)
                 if rec is not None:
                     rec["reattach_s"] = time.perf_counter() - t0
             if jr is not None:
@@ -1712,6 +1809,8 @@ class FitEngine:
             "cache_misses": after["cache_misses"] - before["cache_misses"],
             "executables": after["executables"],
             "donated": don,
+            "fused": use_fused,
+            "publish_plans": len(publish_plans),
             "prefetch": depth,
             "chunk_size": chunk,
             "deadline_s": deadline,

@@ -38,7 +38,17 @@ from ..utils import metrics as _metrics
 from . import combine as _combine
 from . import split as _split
 
-__all__ = ["fit_long", "LongSeriesFit"]
+__all__ = ["fit_long", "LongSeriesFit", "FusedDurabilityError"]
+
+
+class FusedDurabilityError(ValueError):
+    """``fused=True`` was combined with a durability/streaming knob the
+    fused fit→combine program cannot honor (``journal``, ``deadline_s``,
+    ``chunk_retry``, ``engine``, ``degrade=False`` — or ``auto=True``,
+    which is its own fused dispatch).  The fused path never touches
+    ``stream_fit``, so a journal would never commit and a deadline would
+    never arm; refusing loudly here beats a resume that silently refits
+    (docs/design.md §6e)."""
 
 # default AR-truncation length when the order carries an MA part: the
 # tail decays at the MA root rate, so 12 terms put the truncation error
@@ -219,6 +229,7 @@ def fit_long(ts, order: Tuple[int, int, int] = (2, 1, 2),
              journal: Optional[str] = None,
              deadline_s: Optional[float] = None,
              chunk_retry=None, degrade: bool = True,
+             fused: Optional[bool] = None,
              combine_chunk: int = 256,
              warm: int = 512, origin_chunk: int = 65536,
              **fit_kwargs) -> LongSeriesFit:
@@ -255,6 +266,22 @@ def fit_long(ts, order: Tuple[int, int, int] = (2, 1, 2),
     for chunk-level retries — ``chunk_retry`` is this tier's failure
     recovery, and a failed segment already combines at weight zero).
 
+    ``fused`` selects the whole-pipeline-fusion path (docs/design.md
+    §6e): segment fit AND WLS combination as ONE donated XLA program
+    per segment chunk (``combine.fused_fit_combine``) — the per-segment
+    coefficients never cross the host.  Default (``None``): fused
+    whenever no durability/streaming knob is in play and ``auto`` is
+    off; any such knob (``journal``, ``deadline_s``, ``chunk_retry``,
+    ``engine``, ``degrade=False``) keeps the staged ``stream_fit`` →
+    ``combine_segments`` path, which remains the bitwise oracle and the
+    only journaling path.  ``fused=True`` plus such a knob raises
+    :class:`FusedDurabilityError` — loudly, because a journal the fused
+    path will never commit must not fail at the post-crash resume.
+    ``fused=False`` forces the staged path.  A journal written by the
+    staged path resumes fine under the default-fused engine: the
+    journal spec never hashes the fusion flag, and passing ``journal=``
+    itself selects the staged path.
+
     Returns a :class:`LongSeriesFit` whose ``model`` is the combined
     AR(``n_ar``) :class:`~spark_timeseries_tpu.models.arima.ARIMAModel`
     (original ``d`` reattached) and whose :meth:`~LongSeriesFit.forecast`
@@ -286,6 +313,34 @@ def fit_long(ts, order: Tuple[int, int, int] = (2, 1, 2),
     include_intercept = bool(fit_kwargs.get("include_intercept", True))
     icpt = 1 if include_intercept else 0
 
+    # fused-path resolution: any durability/streaming knob forces the
+    # staged path (it is the only journaling/deadline/retry path);
+    # asking for BOTH is a contradiction that must fail loudly now
+    forcing = [name for name, on in (
+        ("journal", journal is not None),
+        ("deadline_s", deadline_s is not None),
+        ("chunk_retry", chunk_retry is not None),
+        ("engine", engine is not None),
+        ("degrade", degrade is not True)) if on]
+    if fused is None:
+        use_fused = not auto and not forcing
+    elif fused:
+        if auto:
+            raise FusedDurabilityError(
+                "fused=True with auto=True: the auto path is already "
+                "one fused auto_fit_panel dispatch — drop fused= or "
+                "use auto=False")
+        if forcing:
+            raise FusedDurabilityError(
+                f"fused=True cannot honor the durability/streaming "
+                f"knobs {forcing}: the fused fit→combine program never "
+                f"touches stream_fit, so a journal would never commit "
+                f"and a deadline would never arm — drop them or pass "
+                f"fused=False for the staged (durable) path")
+        use_fused = True
+    else:
+        use_fused = False
+
     reg = _metrics.get_registry()
     with _metrics.span("longseries.fit_long"):
         diffed = _split.difference(host, d)
@@ -295,8 +350,16 @@ def fit_long(ts, order: Tuple[int, int, int] = (2, 1, 2),
         panel = _split.segment_panel(diffed, plan)
         K = plan.n_segments
 
+        if n_ar is None:
+            if auto:
+                n_ar = max(max_p + max_q, DEFAULT_MA_TRUNCATION)
+            else:
+                n_ar = p if q == 0 else max(p + q, DEFAULT_MA_TRUNCATION)
+        n_ar = int(n_ar)
+
         segment_orders = None
         stream_stats = None
+        combined = None
         if auto:
             import jax.numpy as jnp
 
@@ -340,6 +403,26 @@ def fit_long(ts, order: Tuple[int, int, int] = (2, 1, 2),
             # the stream path's failed chunks
             coefs[~conv] = np.nan
             segment_orders = pf.orders
+        elif use_fused:
+            bad_kw = set(fit_kwargs) - {"method", "max_iter",
+                                        "include_intercept", "objective"}
+            if bad_kw:
+                raise ValueError(
+                    f"the fused fit→combine program takes only "
+                    f"method/max_iter/include_intercept/objective; got "
+                    f"{sorted(bad_kw)} (pass fused=False to route "
+                    f"other fit kwargs through the staged path)")
+            cp, cq, c_icpt = p, q, include_intercept
+            step = max(1, min(int(chunk_segments), K))
+            combined = _combine.fused_fit_combine(
+                panel, p=p, q=q, include_intercept=include_intercept,
+                n_ar=n_ar, overlap=plan.overlap, chunk_segments=step,
+                method=str(fit_kwargs.get("method", "css-lm")),
+                max_iter=fit_kwargs.get("max_iter"),
+                objective=str(fit_kwargs.get("objective", "css")))
+            stream_stats = {"fused": True, "n_segments": K,
+                            "chunk_segments": step,
+                            "n_chunks": -(-K // step)}
         else:
             from ..engine import default_engine
             eng = engine if engine is not None else default_engine()
@@ -361,17 +444,11 @@ def fit_long(ts, order: Tuple[int, int, int] = (2, 1, 2),
             coefs, conv = _collect_segment_coefs(
                 result, K, icpt + p + q, panel.dtype)
 
-        if n_ar is None:
-            if auto:
-                n_ar = max(max_p + max_q, DEFAULT_MA_TRUNCATION)
-            else:
-                n_ar = p if q == 0 else max(p + q, DEFAULT_MA_TRUNCATION)
-        n_ar = int(n_ar)
-
-        combined = _combine.combine_segments(
-            panel, coefs, conv, p=cp, q=cq,
-            include_intercept=bool(c_icpt), n_ar=n_ar,
-            overlap=plan.overlap, chunk_segments=int(combine_chunk))
+        if combined is None:
+            combined = _combine.combine_segments(
+                panel, coefs, conv, p=cp, q=cq,
+                include_intercept=bool(c_icpt), n_ar=n_ar,
+                overlap=plan.overlap, chunk_segments=int(combine_chunk))
 
         import jax.numpy as jnp
 

@@ -24,9 +24,14 @@ the DLSA scheme) is adopted here in two moves, both **in-graph**:
 
 Everything per-segment is one jitted program over a *chunk* of segments
 (:func:`_combine_chunk_impl` — the ``long_combine`` cost/contract
-family): the host only crosses between chunks, accumulating the ``(D,D)``
-information sum and ``(D,)`` weighted-estimate sum, then performs one
-final ridge-guarded solve.  Segments with non-finite estimates, grams,
+family); the ``(D,D)`` information sum and ``(D,)`` weighted-estimate
+sum ride across chunks **device-resident** (:func:`_combine_chunk_acc`),
+so the host crosses exactly once — the final accumulator
+materialization before the ridge-guarded solve.  The fused path
+(:func:`fused_fit_combine`, docs/design.md §6e) goes one step further
+and traces the segment *fit* into the same per-chunk program, so
+``fit_long``'s whole fit→combine round trip is one executable per
+chunk.  Segments with non-finite estimates, grams,
 or variances get weight zero; if nothing is weightable the result falls
 back to the plain mean of finite segment estimates, mirroring
 ``arima.fit_long``'s quarantine-to-init behavior.
@@ -45,7 +50,21 @@ import numpy as np
 
 from ..utils import metrics as _metrics
 
-__all__ = ["combine_segments", "CombinedResult"]
+__all__ = ["combine_segments", "fused_fit_combine",
+           "expected_combine_acc_bytes", "CombinedResult"]
+
+
+def expected_combine_acc_bytes(n_ar: int, include_intercept: bool = True,
+                               dtype=np.float32) -> int:
+    """Bytes of the ONE sanctioned device→host crossing of a fused
+    combination — the final accumulator pull (``A (D,D)``, ``b (D,)``,
+    ``theta_sum (D,)`` and ``sig_sum`` in the panel dtype; three int32
+    counters).  The ``fit_long`` analogue of
+    ``engine.expected_chunk_result_bytes``: what
+    ``longseries.fused_bytes_d2h`` must count per combination, exactly."""
+    D = (1 if include_intercept else 0) + int(n_ar)
+    it = np.dtype(dtype).itemsize
+    return (D * D + 2 * D + 1) * it + 3 * 4
 
 
 class CombinedResult(NamedTuple):
@@ -126,8 +145,52 @@ def _combine_chunk_impl(segs, coefs, conv, p: int, q: int, icpt: int,
             n_conv)
 
 
-# module-level jit (STS006): every chunk of every combination shares one
-# function object, so same-shape chunks hit the jit cache
+def _combine_chunk_acc(segs, coefs, conv, acc, p: int, q: int, icpt: int,
+                       n_ar: int, burn: int):
+    """One chunk's combination pieces folded into the device-resident
+    accumulators — the whole-pipeline-fusion form (docs/design.md §6e):
+    the cross-chunk reduction happens in-graph, so the host crosses
+    ONCE per combination (the final accumulator materialization)
+    instead of seven times per chunk."""
+    out = _combine_chunk_impl(segs, coefs, conv, p, q, icpt, n_ar, burn)
+    # pin each lane to the accumulator's dtype: under x64 the impl's
+    # counter reductions come back int64 and would promote the int32
+    # counters, shifting the pinned accumulator byte budget
+    return tuple((a + o).astype(a.dtype) for a, o in zip(acc, out))
+
+
+def _fused_chunk_impl(segs, n_real, acc, p: int, q: int, icpt: int,
+                      n_ar: int, burn: int, method: str,
+                      max_iter, objective: str):
+    """ONE program per segment chunk: fit the chunk's segments AND fold
+    their combination pieces into the device-resident accumulators —
+    the fused fit→combine path (docs/design.md §6e).  The per-segment
+    coefficients never cross the host; ``n_real`` masks zero-padded tail
+    lanes in-graph (their fits run but combine at weight zero, exactly
+    like the stream tier's pad lanes)."""
+    import jax.numpy as jnp
+
+    from ..models.arima import segment_fit_outputs
+
+    coefs, conv = segment_fit_outputs(
+        p, q, segs, include_intercept=icpt != 0, method=method,
+        max_iter=max_iter, objective=objective)
+    lane = jnp.arange(segs.shape[0], dtype=jnp.int32) < n_real
+    coefs = jnp.where(lane[:, None], coefs,
+                      jnp.asarray(jnp.nan, coefs.dtype))
+    conv = jnp.logical_and(conv, lane)
+    out = _combine_chunk_impl(segs, coefs, conv, p, q, icpt, n_ar, burn)
+    # pin each lane to the accumulator's dtype: under x64 the impl's
+    # counter reductions come back int64 and would promote the int32
+    # counters, shifting the pinned accumulator byte budget
+    return tuple((a + o).astype(a.dtype) for a, o in zip(acc, out))
+
+
+# module-level jits (STS006): every chunk of every combination shares one
+# function object, so same-shape chunks hit the jit cache.  The
+# accumulator argument is donated on accelerators (successive chunks
+# update the same buffers in place); XLA CPU cannot alias donated
+# buffers, so the CPU jits skip donation instead of warning per call.
 def _jitted_chunk():
     import jax
 
@@ -136,6 +199,82 @@ def _jitted_chunk():
         fn = jax.jit(_combine_chunk_impl, static_argnums=(3, 4, 5, 6, 7))
         _jitted_chunk.fn = fn
     return fn
+
+
+def _jitted_chunk_acc():
+    import jax
+
+    fn = _jitted_chunk_acc.__dict__.get("fn")
+    if fn is None:
+        donate = (3,) if jax.default_backend() != "cpu" else ()
+        fn = jax.jit(_combine_chunk_acc,
+                     static_argnums=(4, 5, 6, 7, 8),
+                     donate_argnums=donate)
+        _jitted_chunk_acc.fn = fn
+    return fn
+
+
+def _jitted_fused():
+    import jax
+
+    fn = _jitted_fused.__dict__.get("fn")
+    if fn is None:
+        donate = (2,) if jax.default_backend() != "cpu" else ()
+        fn = jax.jit(_fused_chunk_impl,
+                     static_argnums=(3, 4, 5, 6, 7, 8, 9, 10),
+                     donate_argnums=donate)
+        _jitted_fused.fn = fn
+    return fn
+
+
+def _zero_acc(D: int, dtype):
+    """Fresh device-resident accumulators in the combine layout:
+    ``(A, b, n_ok, theta_sum, n_finite, sig_sum, n_conv)``.  Float
+    pieces accumulate in the compute dtype in-graph; the staged host
+    path's f64 cross-chunk order is gone on both paths (documented —
+    docs/design.md §6e; the final solve still runs in f64 on host)."""
+    import jax.numpy as jnp
+
+    i32 = jnp.int32
+    return (jnp.zeros((D, D), dtype), jnp.zeros((D,), dtype),
+            jnp.zeros((), i32), jnp.zeros((D,), dtype),
+            jnp.zeros((), i32), jnp.zeros((), dtype),
+            jnp.zeros((), i32))
+
+
+def _finalize(acc_host, *, D: int, K: int, dtype,
+              ridge: float) -> CombinedResult:
+    """Shared tail of both combine paths: the one sanctioned
+    materialization already happened — ``acc_host`` is the 7-tuple of
+    numpy accumulators — so this is pure host arithmetic: the
+    ridge-guarded f64 WLS solve, the mean-of-finite fallback, and the
+    counter bookkeeping."""
+    A = np.asarray(acc_host[0], np.float64)
+    b = np.asarray(acc_host[1], np.float64)
+    n_ok = int(acc_host[2])
+    theta_sum = np.asarray(acc_host[3], np.float64)
+    n_finite = int(acc_host[4])
+    sig_sum = float(acc_host[5])
+    n_conv = int(acc_host[6])
+
+    used_wls = False
+    combined = np.zeros((D,), np.float64)
+    if n_ok:
+        scale = max(float(np.max(np.abs(np.diag(A)))), 1.0)
+        solved = np.linalg.solve(A + ridge * scale * np.eye(D), b)
+        if np.all(np.isfinite(solved)):
+            combined = solved
+            used_wls = True
+    if not used_wls and n_finite:
+        combined = theta_sum / n_finite
+    sigma2 = sig_sum / n_ok if n_ok else float("nan")
+    reg = _metrics.get_registry()
+    reg.inc("longseries.segments_combined", n_ok)
+    reg.inc("longseries.segments_dropped", K - n_ok)
+    return CombinedResult(
+        coefficients=combined.astype(dtype),
+        sigma2=sigma2, n_segments=K, n_finite=n_finite,
+        n_weighted=n_ok, n_converged=n_conv, used_wls=used_wls)
 
 
 def combine_segments(segs: np.ndarray, coefs: np.ndarray,
@@ -151,8 +290,11 @@ def combine_segments(segs: np.ndarray, coefs: np.ndarray,
     (K, icpt+p+q)`` per-segment estimates in the fit layout (NaN rows =
     dead segments — weight 0), ``converged (K,)`` optional per-segment
     convergence flags (reporting only).  ``chunk_segments`` bounds how
-    many segments one jitted accumulation dispatch sees — the only
-    host crossings are between chunks.
+    many segments one jitted accumulation dispatch sees — the ONLY host
+    crossing is the final accumulator materialization after the last
+    chunk (docs/design.md §6e): the cross-chunk reduction stays
+    device-resident in the panel dtype, folded in-graph by
+    :func:`_combine_chunk_acc`.
     """
     segs = np.asarray(segs)
     coefs = np.asarray(coefs, segs.dtype)
@@ -170,44 +312,85 @@ def combine_segments(segs: np.ndarray, coefs: np.ndarray,
         else np.asarray(converged, bool).reshape(K)
     burn = max(n_ar, int(overlap))
     D = icpt + n_ar
-    fn = _jitted_chunk()
+    fn = _jitted_chunk_acc()
 
-    # host-side accumulators in f64: chunk sums arrive in the panel
-    # dtype, but the cross-chunk reduction is host arithmetic
-    A = np.zeros((D, D), np.float64)
-    b = np.zeros((D,), np.float64)
-    theta_sum = np.zeros((D,), np.float64)
-    n_ok = n_finite = n_conv = 0
-    sig_sum = 0.0
     step = max(1, int(chunk_segments))
+    acc = _zero_acc(D, segs.dtype)
     with _metrics.span("longseries.combine"):
         for s in range(0, K, step):
             part = segs[s:s + step]
-            out = fn(part, coefs[s:s + step], conv[s:s + step],
+            acc = fn(part, coefs[s:s + step], conv[s:s + step], acc,
                      int(p), int(q), icpt, n_ar, burn)
-            A += np.asarray(out[0], np.float64)
-            b += np.asarray(out[1], np.float64)
-            n_ok += int(out[2])
-            theta_sum += np.asarray(out[3], np.float64)
-            n_finite += int(out[4])
-            sig_sum += float(out[5])
-            n_conv += int(out[6])
+        acc_host = tuple(np.asarray(a) for a in acc)
+    return _finalize(acc_host, D=D, K=K, dtype=segs.dtype, ridge=ridge)
 
-    used_wls = False
-    combined = np.zeros((D,), np.float64)
-    if n_ok:
-        scale = max(float(np.max(np.abs(np.diag(A)))), 1.0)
-        solved = np.linalg.solve(A + ridge * scale * np.eye(D), b)
-        if np.all(np.isfinite(solved)):
-            combined = solved
-            used_wls = True
-    if not used_wls and n_finite:
-        combined = theta_sum / n_finite
-    sigma2 = sig_sum / n_ok if n_ok else float("nan")
+
+def fused_fit_combine(panel: np.ndarray, *, p: int, q: int,
+                      include_intercept: bool = True, n_ar: int,
+                      overlap: int = 0, chunk_segments: int = 256,
+                      ridge: float = 1e-8, method: str = "css-lm",
+                      max_iter: Optional[int] = None,
+                      objective: str = "css") -> CombinedResult:
+    """The fused ``fit_long`` hot path: segment fit AND WLS combination
+    in ONE donated XLA program per segment chunk (docs/design.md §6e).
+
+    ``panel (K, L)`` is the segment panel from ``split.segment_panel``.
+    Where the staged path runs ``stream_fit`` over the segments (one
+    fit program per chunk, per-segment coefficients materialized to the
+    host) and then :func:`combine_segments` (one combine program per
+    chunk), this traces :func:`~spark_timeseries_tpu.models.arima.\
+segment_fit_outputs` straight into :func:`_combine_chunk_impl`: the
+    per-segment coefficients never leave the device, the accumulators
+    ride across chunks device-resident, and the host sees exactly one
+    materialization — the final 7-tuple of sums.
+
+    Every chunk is padded with zero lanes to the ``chunk_segments``
+    width so the whole combination compiles exactly one executable;
+    ``n_real`` masks the pad lanes in-graph (NaN-poisoned coefficients
+    + convergence False → combination weight zero).  Accumulation order
+    matches :func:`combine_segments`'s device path chunk-for-chunk, so
+    fused-vs-staged differences come only from the fit fusing with the
+    combine in one program (≤1e-6 at f32 bench scale — the equivalence
+    tests pin this).
+
+    Counters: ``longseries.fused_programs`` (dispatches) and
+    ``longseries.fused_bytes_d2h`` (bytes of the one materialization) —
+    the boundary contract for the ``fit_long`` budget row.
+    """
+    panel = np.asarray(panel)
+    K, L = panel.shape
+    icpt = 1 if include_intercept else 0
+    n_ar = int(n_ar)
+    if L <= max(n_ar, overlap) + n_ar + icpt:
+        raise ValueError(
+            f"segment window {L} too short for an AR({n_ar}) design "
+            f"with burn-in {max(n_ar, overlap)}")
+    burn = max(n_ar, int(overlap))
+    D = icpt + n_ar
+    step = max(1, min(int(chunk_segments), K))
+    mi = None if max_iter is None else int(max_iter)
+    fn = _jitted_fused()
+
+    from ..models.base import unroll_hint
+
+    acc = _zero_acc(D, panel.dtype)
+    programs = 0
+    # the chunk width is the scan-unroll amortization signal, exactly as
+    # in engine._entry (models.base.scan_unroll)
+    with _metrics.span("longseries.fused_fit_combine"), \
+            unroll_hint(step):
+        for s in range(0, K, step):
+            part = panel[s:s + step]
+            n_real = part.shape[0]
+            if n_real < step:
+                part = np.concatenate(
+                    [part, np.zeros((step - n_real, L), panel.dtype)])
+            acc = fn(part, np.int32(n_real), acc, int(p), int(q), icpt,
+                     n_ar, burn, str(method), mi, str(objective))
+            programs += 1
+        acc_host = tuple(np.asarray(a) for a in acc)
     reg = _metrics.get_registry()
-    reg.inc("longseries.segments_combined", n_ok)
-    reg.inc("longseries.segments_dropped", K - n_ok)
-    return CombinedResult(
-        coefficients=combined.astype(segs.dtype),
-        sigma2=sigma2, n_segments=K, n_finite=n_finite,
-        n_weighted=n_ok, n_converged=n_conv, used_wls=used_wls)
+    reg.inc("longseries.fused_programs", programs)
+    reg.inc("longseries.fused_bytes_d2h",
+            sum(int(a.nbytes) for a in acc_host))
+    return _finalize(acc_host, D=D, K=K, dtype=panel.dtype, ridge=ridge)

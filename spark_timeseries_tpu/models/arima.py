@@ -1159,6 +1159,26 @@ def fit(p: int, d: int, q: int, ts: jnp.ndarray,
 _fit_unrecorded = fit.__wrapped__
 
 
+def segment_fit_outputs(p: int, q: int, segs, *,
+                        include_intercept: bool = True,
+                        method: str = "css-lm",
+                        max_iter: Optional[int] = None,
+                        objective: str = "css"):
+    """Traced fit entry point for the fused longseries fit→combine
+    program (docs/design.md §6e/§8): fit one chunk of already-
+    differenced segment windows and return exactly the two pieces the
+    WLS combiner consumes — ``(coefficients (K, icpt+p+q),
+    converged (K,))`` — with no model pytree and no host crossing in
+    between.  Meant to run under an enclosing ``jax.jit`` trace, hence
+    the undecorated ``fit.__wrapped__`` underneath (spans/counters are
+    host-side and must not leak into a compiled program)."""
+    m = _fit_unrecorded(p, 0, q, segs,
+                        include_intercept=include_intercept,
+                        method=method, max_iter=max_iter, warn=False,
+                        objective=objective)
+    return m.coefficients, jnp.reshape(m.diagnostics.converged, (-1,))
+
+
 def _exact_refine(base: ARIMAModel, ts: jnp.ndarray,
                   n_valid: Optional[jnp.ndarray] = None,
                   max_iter: Optional[int] = None) -> ARIMAModel:

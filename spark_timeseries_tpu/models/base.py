@@ -16,6 +16,8 @@ changes:
 
 from __future__ import annotations
 
+import threading
+from contextlib import contextmanager
 from typing import Any, NamedTuple, Optional
 
 import jax.numpy as jnp
@@ -38,6 +40,32 @@ def on_accelerator() -> bool:
     return jax.default_backend() != "cpu"
 
 
+# CPU unroll only pays off when the traced bucket is wide enough to
+# amortize the 4x-larger scan body's compile time: the engine's bench
+# buckets (8192+ lanes) are loop-overhead-bound at runtime, while the
+# test/interactive tier (8-256 lanes) is compile-bound — unrolling it
+# would multiply suite compile time for nothing
+UNROLL_LANES_MIN = 1024
+
+_unroll_hint = threading.local()
+
+
+@contextmanager
+def unroll_hint(n_lanes: Optional[int]):
+    """Trace-scoped lane-width hint for :func:`scan_unroll` — set by the
+    ENGINE around lowering, because inside the ``vmap`` the batch width
+    is not visible to the model code.  The hint is a pure function of
+    the engine's padding bucket (which is part of both the engine's
+    executable key and jax's aval-keyed jit cache), so a given shape
+    always traces with the same unroll — no cache inconsistency."""
+    prev = getattr(_unroll_hint, "n", None)
+    _unroll_hint.n = None if n_lanes is None else int(n_lanes)
+    try:
+        yield
+    finally:
+        _unroll_hint.n = prev
+
+
 def scan_unroll() -> int:
     """Unroll factor for the model tier's time-axis ``lax.scan``s.
 
@@ -47,11 +75,23 @@ def scan_unroll() -> int:
     fit's fused residual+Jacobian pass at bench scale (4.1ms -> 2.0ms,
     32768x128 float32, v5e) and nearly triples the EWMA fit (298k -> 842k
     series/sec at 65536x128; 16 was measured *worse* there — 389k — the
-    wider body spills).  On CPU (the test mesh) runtime is FLOP-bound and
-    larger scan bodies only inflate compile time, so the factor stays 1.
-    Evaluated lazily at trace time — importing the package must not
-    initialize a JAX backend.  ``STS_SCAN_UNROLL`` overrides the default
-    (tuning knob; re-jit after changing it — traces cache the value)."""
+    wider body spills).  On CPU the scan body is a swarm of small
+    vector ops over the lane axis, so runtime is loop-overhead-bound at
+    bench width: unroll=4 lifts the 8192x128 ARIMA(2,1,2) css-lm chunk
+    program 2332 -> 2904 series/s on the 1-core bench box (unroll=2:
+    2640; unroll=8 *regresses* to 2290 — the wider body blows the
+    cache).  But compile time scales with the unrolled body too, and
+    the test/interactive tier is compile-bound — a global CPU unroll=4
+    blew the tier-1 suite past its wall budget — so CPU unrolls ONLY
+    when the enclosing trace carries a wide-bucket :func:`unroll_hint`
+    (≥ ``UNROLL_LANES_MIN`` lanes; the engine sets it from its padding
+    bucket).  Unrolling reorders XLA's fusion choices, so results are
+    NOT bitwise against unroll=1 — both engine paths (staged and fused)
+    trace through this one policy, which is what keeps the
+    fused-vs-staged bitwise oracle intact.  Evaluated lazily at trace
+    time — importing the package must not initialize a JAX backend.
+    ``STS_SCAN_UNROLL`` overrides everything (tuning knob; re-jit after
+    changing it — traces cache the value)."""
     import os
     env = os.environ.get("STS_SCAN_UNROLL")
     if env:
@@ -65,7 +105,10 @@ def scan_unroll() -> int:
             raise ValueError(
                 f"STS_SCAN_UNROLL must be >= 1, got {env!r}")
         return val
-    return 8 if on_accelerator() else 1
+    if on_accelerator():
+        return 8
+    hint = getattr(_unroll_hint, "n", None)
+    return 4 if hint is not None and hint >= UNROLL_LANES_MIN else 1
 
 
 class FitDiagnostics(NamedTuple):

@@ -637,72 +637,75 @@ class FleetScheduler:
         shed-restore run.  After this, submit/pump/restore trigger zero
         XLA compiles at any group size — the scheduler-armed equivalent
         of ``ServingSession.warmup`` (pinned by test, partial flush
-        included).  Result delivery slices on the host after one
-        whole-array transfer per output (see ``_dispatch_group``), so
-        per-tenant widths need no per-width result programs."""
+        included).
+
+        Zero host round-trips (the old warmup was the rank-1 STS205
+        fusion chain, 4.58 s span self-time in FUSION_AUDIT r08): every
+        per-width dispatch and every scatter-back slice program runs
+        **async** — jit dispatch blocks on *compile* but not on
+        *execution*, and it is the compiles this pass exists to front-
+        load.  (AOT ``.lower().compile()`` would skip the executions
+        entirely, but on this jax it does not populate the jit call
+        cache — the first real call would compile again — so one real
+        async call per width stays.)  D2H transfers compile nothing, so
+        the dispatch path's whole-array materializations need no
+        warming.  One terminal ``jax.block_until_ready`` keeps warmup
+        synchronous — the wall-time pin measures finished work, and no
+        warmup execution can overhang into the first pump."""
         import jax
         import jax.numpy as jnp
 
         fn = _jitted("update")
-        for key, labels in self._groups.items():
-            bucket, _dtype, meta, policy, quality = key
-            members = [self._tenants[la] for la in labels]
-            members[0].session.warmup()         # the replay-lane program
-            sizes = {len(members)}
-            w = 1
-            while w < len(members):
-                sizes.add(w)
-                w *= 2
-            for G in sorted(sizes):
-                slots = _slots_for(G)
+        pending = []
+        with _metrics.span("fleet.warmup"):
+            for key, labels in self._groups.items():
+                bucket, _dtype, meta, policy, quality = key
+                members = [self._tenants[la] for la in labels]
+                members[0].session.warmup()     # the replay-lane program
+                sizes = {len(members)}
+                w = 1
+                while w < len(members):
+                    sizes.add(w)
+                    w *= 2
+                for G in sorted(sizes):
+                    slots = _slots_for(G)
 
-                def gather(*leaves):
-                    parts = (list(leaves)
-                             + [leaves[0]] * (slots - len(leaves)))
-                    return jnp.concatenate(
-                        [jnp.asarray(p) for p in parts])
+                    def gather(*leaves):
+                        parts = (list(leaves)
+                                 + [leaves[0]] * (slots - len(leaves)))
+                        return jnp.concatenate(
+                            [jnp.asarray(p) for p in parts])
 
-                srcs = members[:G]
-                ssm = jax.tree_util.tree_map(
-                    gather, *(m.session._ssm for m in srcs))
-                state = jax.tree_util.tree_map(
-                    gather, *(m.session._state for m in srcs))
-                health = jax.tree_util.tree_map(
-                    gather, *(m.session._health for m in srcs))
-                qstate = None
-                if quality is not None:
-                    qstate = jax.tree_util.tree_map(
-                        gather, *(m.session._qstate for m in srcs))
-                y = np.full((slots * bucket,), np.nan,
-                            srcs[0].session._dtype)
-                off = np.zeros_like(y)
-                with _metrics.span("fleet.warmup"):
+                    srcs = members[:G]
+                    ssm = jax.tree_util.tree_map(
+                        gather, *(m.session._ssm for m in srcs))
+                    state = jax.tree_util.tree_map(
+                        gather, *(m.session._state for m in srcs))
+                    health = jax.tree_util.tree_map(
+                        gather, *(m.session._health for m in srcs))
+                    qstate = None
+                    if quality is not None:
+                        qstate = jax.tree_util.tree_map(
+                            gather, *(m.session._qstate for m in srcs))
+                    y = np.full((slots * bucket,), np.nan,
+                                srcs[0].session._dtype)
+                    off = np.zeros_like(y)
                     state2, health2, q2, v, f, ll, anom = fn(
                         meta, policy, quality, ssm, state, health,
                         qstate, y, off)
-                    # the dispatch path materializes each result array
-                    # whole and slices on the host (_dispatch_group) —
-                    # warm exactly those whole-array transfers
-                    for a in (v, f, ll, anom, health2.status,
-                              health2.ew):
-                        np.asarray(a)
-                    if quality is not None:
-                        for a in (q2.ew_smape, q2.ew_mase, q2.ew_cover,
-                                  q2.n_scored):
-                            np.asarray(a)
-                    for i, m in enumerate(srcs):
+                    for i in range(G):
                         lo = i * bucket
-                        # the scatter-back slice programs
-                        jax.tree_util.tree_map(
-                            lambda leaf, lo=lo: np.asarray(
-                                leaf[lo:lo + bucket]), state2)
-                        jax.tree_util.tree_map(
-                            lambda leaf, lo=lo: np.asarray(
-                                leaf[lo:lo + bucket]), health2)
+                        # the scatter-back slice programs (static start
+                        # offsets — one program per member position)
+                        pending.append(jax.tree_util.tree_map(
+                            lambda leaf, lo=lo: leaf[lo:lo + bucket],
+                            (state2, health2)))
                         if quality is not None:
-                            jax.tree_util.tree_map(
-                                lambda leaf, lo=lo: np.asarray(
-                                    leaf[lo:lo + bucket]), q2)
+                            pending.append(jax.tree_util.tree_map(
+                                lambda leaf, lo=lo: leaf[lo:lo + bucket],
+                                q2))
+                    pending.append((v, f, ll, anom))
+            jax.block_until_ready(pending)
 
     # -- SLO shedding -------------------------------------------------------
 

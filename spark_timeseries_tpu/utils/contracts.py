@@ -317,6 +317,11 @@ def check_all(families: Optional[Sequence[str]] = None,
 PIPELINE_PROGRAM_BUDGET: Dict[str, int] = {
     "fit": 1,
     "serving": 1,
+    # the fused fit_long combination (docs/design.md §6e): every segment
+    # chunk is padded to one width, so the whole fit→combine runs ONE
+    # executable — enforced as warm-compiles-nothing below (all warm
+    # dispatches share a single (shape, statics) jit key)
+    "fit_long": 1,
 }
 
 
@@ -373,10 +378,10 @@ def pipeline_contracts(family: str = "ewma", n_series: int = 256,
         # --- fit stage: cold stream (compiles), then warm stream ------
         grid = np.arange(n_series * n_obs, dtype=np.float32)
         values = np.sin(grid).reshape(n_series, n_obs) + 2.0
-        eng.stream_fit(values, family, chunk_size=chunk)
+        eng.stream_fit(values, family, chunk_size=chunk, fused=True)
         c0 = counters()
         fit_programs = c0.get("engine.cache_misses", 0)
-        eng.stream_fit(values, family, chunk_size=chunk)
+        eng.stream_fit(values, family, chunk_size=chunk, fused=True)
         c1 = counters()
 
         n_chunks = n_series // chunk
@@ -405,6 +410,47 @@ def pipeline_contracts(family: str = "ewma", n_series: int = 256,
             f"{per_chunk} B/chunk materialized over {n_chunks} warmed "
             f"chunk(s), expected {expected} B "
             f"({unexpected:+d} B unsanctioned)"))
+
+        # --- fit_long stage: fused fit→combine, cold then warm --------
+        # (docs/design.md §6e) every chunk padded to one width → one
+        # executable; the warm repeat must compile nothing and the ONLY
+        # crossing is the final accumulator pull, byte-exact
+        from ..longseries.combine import (expected_combine_acc_bytes,
+                                          fused_fit_combine)
+        greg = _metrics.get_registry()
+
+        def gcounters() -> Dict[str, int]:
+            return {k: int(v) for k, v in
+                    greg.snapshot()["counters"].items()}
+
+        seg_panel = np.sin(
+            np.arange(8 * 64, dtype=np.float32)).reshape(8, 64) + 2.0
+        long_kw = dict(p=1, q=0, n_ar=1, chunk_segments=4, max_iter=8)
+        fused_fit_combine(seg_panel, **long_kw)
+        l0, g0 = counters(), gcounters()
+        fused_fit_combine(seg_panel, **long_kw)
+        l1, g1 = counters(), gcounters()
+        long_warm_compiles = l1.get("jax.jit_compiles", 0) \
+            - l0.get("jax.jit_compiles", 0)
+        long_programs = g1.get("longseries.fused_programs", 0) \
+            - g0.get("longseries.fused_programs", 0)
+        long_bytes = g1.get("longseries.fused_bytes_d2h", 0) \
+            - g0.get("longseries.fused_bytes_d2h", 0)
+        long_expected = expected_combine_acc_bytes(
+            1, True, seg_panel.dtype)
+        results.append(ContractResult(
+            "pipeline-warm-nocompile", "fit_long",
+            (not hooks or long_warm_compiles == 0) and long_programs == 2,
+            f"warm fused fit→combine: {long_warm_compiles} backend "
+            f"compile(s) over {long_programs} chunk dispatch(es) — one "
+            f"executable serves every chunk (budget "
+            f"{PIPELINE_PROGRAM_BUDGET['fit_long']})"))
+        results.append(ContractResult(
+            "pipeline-transfer-bytes", "fit_long",
+            long_bytes == long_expected,
+            f"{long_bytes} B materialized by the warm fused "
+            f"combination, expected {long_expected} B (the one "
+            f"accumulator pull)"))
 
         # --- serving stage: cold warmup compiles, warm repeat doesn't -
         s0 = counters()
@@ -439,6 +485,10 @@ def pipeline_contracts(family: str = "ewma", n_series: int = 256,
         "fit_warm_compiles": int(warm_compiles),
         "serving_cold_compiles": int(serving_cold),
         "serving_warm_compiles": int(serving_warm),
+        "fit_long_warm_compiles": int(long_warm_compiles),
+        "fit_long_programs": int(long_programs),
+        "fit_long_bytes_d2h": int(long_bytes),
+        "fit_long_expected_bytes": int(long_expected),
         "jax_hooks": bool(hooks),
         "transfer_events": jax_stats(reg)["transfers"],
         "boundary_checked": len(results),

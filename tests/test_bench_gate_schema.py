@@ -43,6 +43,13 @@ FULL_HEADLINE = {
     "backtest_demo": {"champion_smape": 3.1, "champion_mase": 0.9},
     "serving_demo": {"quality": {"live_smape": 4.2, "drift_alarms": 0}},
     "engine_attribution": {"host_overhead_frac": 0.07},
+    "fused_vs_staged": {
+        "n_series": 8192, "chunk": 8192,
+        "fused": {"rate": 3000.0, "programs_compiled": 0,
+                  "programs_dispatched": 1, "publish_plans": 1},
+        "staged": {"rate": 2900.0, "programs_compiled": 0,
+                   "programs_dispatched": 1, "publish_plans": 0},
+    },
     "metrics": {
         "compile_s_total": 1.5,
         "jit_compiles": 7,

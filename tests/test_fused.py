@@ -1,0 +1,270 @@
+"""Whole-pipeline fusion tier (ISSUE 20).
+
+The fused publish path (per-bucket :class:`_PublishPlan`, one donated
+executable per warm chunk, zero-copy result views) against the staged
+per-chunk skeleton walk it replaced — the staged path is kept as the
+bitwise oracle behind ``fused=False``:
+
+- dense streams: fused == staged **bitwise** (both paths run the SAME
+  cached executable; only host-side publish differs);
+- ragged tails: fused == staged to ≤1e-6 (pad-cut views vs slice +
+  re-upload may round differently at the boundary);
+- ``fit_long``: device-resident WLS accumulators vs the staged
+  fit→combine round trip to ≤1e-6 (the staged path used to sum the
+  normal equations on host in f64 across chunks; both paths now
+  accumulate in panel dtype in-graph on the segment axis, and the
+  final ridge-guarded solve stays f64 — docs/design.md §6e);
+- durability: a journal written by the staged path resumes under the
+  fused engine (the job spec excludes the flag, same hash) with zero
+  refits; ``fit_long(fused=True)`` with a durability knob refuses
+  loudly with :class:`FusedDurabilityError`, never silently refits;
+- fleet warmup: the rank-1 STS205 chain burn-down — a second warmup
+  compiles nothing and completes inside a pinned wall budget.
+
+Run via ``make verify-fused`` (plain + ``STS_FAULT_INJECT=1``); the
+whole module is tier-1-fast (small shapes, warm caches).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_timeseries_tpu import longseries
+from spark_timeseries_tpu.engine import FitEngine
+from spark_timeseries_tpu.longseries.api import FusedDurabilityError
+from spark_timeseries_tpu.models import arima
+from spark_timeseries_tpu import statespace as ss
+from spark_timeseries_tpu.statespace.fleet import FleetScheduler
+from spark_timeseries_tpu.utils import metrics
+from spark_timeseries_tpu.utils.durability import JournalSpecMismatch
+
+pytestmark = pytest.mark.fused
+
+
+def _panel(n_series, n_obs, seed=7):
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=(n_series, n_obs + 8))
+    y = np.zeros_like(e)
+    for t in range(1, y.shape[1]):
+        y[:, t] = 0.2 + 0.6 * y[:, t - 1] + e[:, t]
+    return np.asarray(y[:, 8:], np.float32)
+
+
+def _collect(eng, values, family, *, chunk, fused, **kw):
+    res = eng.stream_fit(values, family, chunk_size=chunk,
+                         collect=True, fused=fused, **kw)
+    assert res.stats["fused"] is fused
+    assert not res.chunk_failures
+    return res
+
+
+def _assert_models_equal(a, b, *, exact):
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb, "fused and staged publish different pytree shapes"
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        if exact:
+            np.testing.assert_array_equal(x, y)
+        else:
+            np.testing.assert_allclose(x, y, rtol=0, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# fused vs staged stream publish
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("family,kw", [
+    ("ewma", {}),
+    ("arima", {"p": 1, "d": 0, "q": 1}),
+])
+def test_dense_stream_fused_matches_staged_bitwise(family, kw):
+    """Exact-multiple panel: every chunk is a full bucket, the publish
+    plan cuts nothing — fused must be BITWISE the staged oracle."""
+    eng = FitEngine(registry=metrics.MetricsRegistry())
+    values = _panel(64, 32)
+    staged = _collect(eng, values, family, chunk=32, fused=False, **kw)
+    fused = _collect(eng, values, family, chunk=32, fused=True, **kw)
+    assert fused.n_chunks == staged.n_chunks == 2
+    assert fused.stats["publish_plans"] >= 1
+    assert staged.stats["publish_plans"] == 0
+    _assert_models_equal(fused.models, staged.models, exact=True)
+    assert (fused.n_fitted, fused.n_converged) \
+        == (staged.n_fitted, staged.n_converged)
+
+
+def test_ragged_tail_fused_matches_staged():
+    """Tail chunk pads to its own bucket; fused cuts the pad rows as
+    views where staged slices + re-uploads — ≤1e-6 across the seam."""
+    eng = FitEngine(registry=metrics.MetricsRegistry())
+    values = _panel(40, 32, seed=11)
+    staged = _collect(eng, values, "arima", chunk=16, fused=False,
+                      p=1, d=0, q=1)
+    fused = _collect(eng, values, "arima", chunk=16, fused=True,
+                     p=1, d=0, q=1)
+    assert fused.n_chunks == staged.n_chunks == 3
+    _assert_models_equal(fused.models, staged.models, exact=False)
+
+
+def test_fused_warm_rerun_compiles_nothing():
+    """The fusion contract's cheap half, pinned at test scale: once a
+    bucket is warm, a fused re-stream dispatches cached executables
+    only (the boundary tier pins the byte half)."""
+    eng = FitEngine(registry=metrics.MetricsRegistry())
+    values = _panel(64, 32, seed=3)
+    _collect(eng, values, "ewma", chunk=32, fused=True)     # cold
+    warm = _collect(eng, values, "ewma", chunk=32, fused=True)
+    assert warm.stats["cache_misses"] == 0
+    assert warm.stats["cache_hits"] >= warm.n_chunks
+
+
+# ---------------------------------------------------------------------------
+# durability: journals are fused-agnostic
+# ---------------------------------------------------------------------------
+
+def test_staged_journal_resumes_under_fused_engine(tmp_path):
+    """The job spec excludes the ``fused`` flag, so a journal written
+    by the staged path resumes under the fused engine with the same
+    spec hash — every chunk a journal hit, results bitwise."""
+    eng = FitEngine(registry=metrics.MetricsRegistry())
+    values = _panel(64, 32, seed=5)
+    jr = str(tmp_path / "journal")
+    staged = _collect(eng, values, "ewma", chunk=16, fused=False,
+                      journal=jr)
+    assert staged.stats["journal_commits"] == staged.n_chunks == 4
+    fused = _collect(eng, values, "ewma", chunk=16, fused=True,
+                     journal=jr)
+    assert fused.stats["journal_hits"] == 4, \
+        "fused engine refit chunks a staged journal already committed"
+    assert fused.stats["journal_commits"] == 0
+    _assert_models_equal(fused.models, staged.models, exact=True)
+
+
+def test_spec_mismatch_refuses_loudly_never_refits(tmp_path):
+    """A journal from a different job spec must raise the named error —
+    silently refitting under the fused engine would be data loss."""
+    eng = FitEngine(registry=metrics.MetricsRegistry())
+    values = _panel(32, 32, seed=9)
+    jr = str(tmp_path / "journal")
+    _collect(eng, values, "arima", chunk=16, fused=False,
+             journal=jr, p=1, d=0, q=1)
+    with pytest.raises(JournalSpecMismatch):
+        eng.stream_fit(values, "arima", chunk_size=16, fused=True,
+                       journal=jr, p=2, d=0, q=1)
+
+
+# ---------------------------------------------------------------------------
+# fit_long: device-resident fused fit->combine
+# ---------------------------------------------------------------------------
+
+N_LONG = 2048
+
+
+def _long_series(seed=13):
+    rng = np.random.default_rng(seed)
+    e = rng.normal(size=N_LONG + 16)
+    y = np.zeros_like(e)
+    for t in range(1, y.size):
+        y[t] = 0.5 * y[t - 1] + e[t] + 0.3 * e[t - 1]
+    return np.asarray(y[16:], np.float32)
+
+
+def test_fit_long_fused_matches_staged():
+    ts = _long_series()
+    kw = dict(order=(1, 0, 1), seg_len=256, n_ar=3, chunk_segments=4,
+              max_iter=8)
+    staged = longseries.fit_long(ts, fused=False, **kw)
+    fused = longseries.fit_long(ts, fused=True, **kw)
+    assert fused.stream_stats["fused"] is True
+    assert fused.stream_stats["n_chunks"] == 2
+    np.testing.assert_allclose(np.asarray(fused.coefficients),
+                               np.asarray(staged.coefficients),
+                               rtol=0, atol=1e-6)
+    np.testing.assert_allclose(fused.sigma2, staged.sigma2,
+                               rtol=0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(fused.forecast(8)),
+                               np.asarray(staged.forecast(8)),
+                               rtol=0, atol=1e-5)
+
+
+def test_fit_long_default_is_fused_unless_forced():
+    ts = _long_series(seed=17)
+    fit = longseries.fit_long(ts, order=(1, 0, 1), seg_len=256,
+                              n_ar=3, max_iter=8)
+    assert fit.stream_stats["fused"] is True
+
+
+@pytest.mark.parametrize("knob", [
+    {"journal": "SOME/PATH"},
+    {"deadline_s": 5.0},
+    {"chunk_retry": 2},
+    {"degrade": False},
+    {"auto": True},
+])
+def test_fit_long_fused_refuses_durability_knobs(knob):
+    """fused=True never touches stream_fit, so a journal would never
+    commit and a deadline would never arm — refuse loudly up front."""
+    ts = _long_series(seed=19)
+    with pytest.raises(FusedDurabilityError):
+        longseries.fit_long(ts, order=(1, 0, 1), seg_len=256, n_ar=3,
+                            fused=True, **knob)
+
+
+def test_fit_long_journal_forces_staged_path_and_resumes(tmp_path):
+    """fused=None + journal resolves to the staged stream (the knob
+    must keep working, not silently no-op under a fused default): the
+    journal commits every chunk, and a re-run with the same geometry
+    resumes on journal hits instead of refitting."""
+    ts = _long_series(seed=23)
+    jr = str(tmp_path / "journal")
+    kw = dict(order=(1, 0, 1), seg_len=256, n_ar=3, max_iter=8,
+              chunk_segments=4, journal=jr)
+    fit = longseries.fit_long(ts, **kw)
+    # the staged stream, not the fused in-graph combine (whose stats
+    # carry n_segments and never a journal)
+    assert "n_segments" not in fit.stream_stats
+    assert fit.stream_stats["journal_commits"] == 2
+    fit2 = longseries.fit_long(ts, **kw)
+    assert fit2.stream_stats["journal_hits"] == 2, \
+        "same-geometry fit_long refit journaled chunks"
+    assert fit2.stream_stats["journal_commits"] == 0
+    np.testing.assert_array_equal(np.asarray(fit2.coefficients),
+                                  np.asarray(fit.coefficients))
+
+
+# ---------------------------------------------------------------------------
+# fleet warmup burn-down (the rank-1 STS205 chain)
+# ---------------------------------------------------------------------------
+
+def test_fleet_warmup_warm_pass_compiles_nothing_and_is_fast():
+    """Warmup now dispatches async per width with ONE terminal block
+    and zero host materializations.  Once the executables exist, a
+    second warmup is pure cached dispatch: zero compiles, wall pinned
+    (the old per-width dispatch+materialize round-trips held 4.58s of
+    span self-time at fleet scale)."""
+    reg = metrics.MetricsRegistry()
+    hists = [_panel(4, 120, seed=31 + i) for i in range(3)]
+    models = [arima.fit(2, 0, 0, jnp.asarray(h), warn=False)
+              for h in hists]
+    sched = FleetScheduler(registry=reg, auto_pump=False)
+    for i, (m, h) in enumerate(zip(models, hists)):
+        sched.attach(ss.ServingSession.start(m, h, label=f"t{i}",
+                                             registry=reg))
+    metrics.install_jax_hooks()
+    sched.warmup()                                           # cold
+    before = metrics.jax_stats()["jit_compiles"]
+    t0 = time.perf_counter()
+    sched.warmup()                                           # warm
+    wall = time.perf_counter() - t0
+    assert metrics.jax_stats()["jit_compiles"] - before == 0, \
+        "a warm fleet warmup compiled"
+    assert wall < 2.0, f"warm warmup took {wall:.2f}s (pinned < 2s)"
+    # the span lands in the default registry like the other fleet spans
+    # (fleet.coalesced_step) so the fusion audit can attribute it
+    spans = metrics.get_registry().snapshot()["spans"]
+    assert any(k.split("/")[-1] == "fleet.warmup" for k in spans), \
+        "warmup no longer records its span"

@@ -1126,11 +1126,13 @@ def test_boundary_noqa_suppression(tmp_path):
     assert len(result.suppressed) == 1
 
 
-def test_shipped_tree_boundary_tier_clean_and_inventory_nonempty():
-    """ISSUE 19 acceptance: 0 gating STS200 findings on the shipped
-    tree (the fleet per-tenant slice regression is FIXED, not
-    baselined) and a NON-EMPTY STS205 inventory (the fusion evidence
-    base for ROADMAP item 1)."""
+def test_shipped_tree_boundary_tier_clean_and_inventory_burned_down():
+    """ISSUE 19 pinned 0 gating STS200 findings and a NON-EMPTY STS205
+    inventory (the fusion evidence base); ISSUE 20 consumed that
+    inventory — the whole-pipeline-fusion PR eliminated every ranked
+    chain (device-resident combine accumulators, async no-materialize
+    warmup), so HEAD now pins the inventory EMPTY and names the two
+    burned-down chains so a reintroduction fails by symbol."""
     from tools.sts_lint import DEFAULT_BASELINE
     baseline = load_baseline(DEFAULT_BASELINE)
     for fp in baseline:
@@ -1142,7 +1144,11 @@ def test_shipped_tree_boundary_tier_clean_and_inventory_nonempty():
     assert result.parse_errors == []
     assert result.new == [], [f.render() for f in result.new]
     inventory = {(f.path, f.symbol) for f in result.advice}
-    assert inventory, "STS205 fusion inventory is empty on HEAD"
+    gone = {"combine_segments", "FleetScheduler.warmup"}
+    assert not gone & {s for _, s in inventory}, \
+        "a burned-down STS205 chain reappeared"
+    assert not inventory, \
+        f"new STS205 chain(s) on the hot path: {sorted(inventory)}"
 
 
 def test_fleet_dispatch_slice_regression_pinned():

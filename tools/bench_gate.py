@@ -211,6 +211,8 @@ METRICS = [
     ("contracts_failed", "lower_better", 50.0),
     ("pipeline_programs", "lower_better", 50.0),
     ("host_transfer_bytes_per_chunk", "lower_better", 25.0),
+    ("fused_ab_rate", "higher_better", 25.0),
+    ("staged_ab_rate", "higher_better", 25.0),
 ]
 
 
@@ -365,6 +367,20 @@ def extract_metrics(headline: Optional[dict]) -> Dict[str, float]:
             and isinstance(ea.get("host_overhead_frac"), (int, float)):
         out["engine_host_overhead_frac"] = \
             float(ea["host_overhead_frac"])
+    # fused vs staged A/B (ISSUE 20): both publish paths through the
+    # one cached executable, timed at a FIXED panel point (8192 or
+    # n_target, whichever is smaller) so the comparison is stable even
+    # when best_n moves.  The staged oracle path is no longer the
+    # headline, so without its own gate it could rot silently.
+    # Tolerated-absent in rounds that predate the fusion PR.
+    ab = headline.get("fused_vs_staged")
+    if isinstance(ab, dict):
+        for key, name in (("fused", "fused_ab_rate"),
+                          ("staged", "staged_ab_rate")):
+            side = ab.get(key)
+            if isinstance(side, dict) \
+                    and isinstance(side.get("rate"), (int, float)):
+                out[name] = float(side["rate"])
     m = headline.get("metrics")
     if isinstance(m, dict):
         spans = m.get("spans")

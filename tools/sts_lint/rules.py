@@ -753,8 +753,11 @@ SANCTIONED_MATERIALIZE = frozenset({
     # fleet: coalesced-tick scatter-back (hoisted; regression-pinned)
     ("fleet", "FleetScheduler._dispatch_group"),
     ("fleet", "FleetScheduler.warmup"),
-    # longseries: deliberate f64 host accumulation at segment boundaries
+    # longseries: the one post-loop accumulator pull per combination
+    # (device-resident cross-chunk reduction, docs/design.md §6e) —
+    # same policy for the staged and the fused fit→combine drivers
     ("combine", "combine_segments"),
+    ("combine", "fused_fit_combine"),
     # backtest: metric-table delivery at the end of a sweep
     ("evaluate", "evaluate_candidate"),
 })
